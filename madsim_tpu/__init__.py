@@ -1,0 +1,55 @@
+"""madsim_tpu — TPU-native deterministic simulation testing for distributed systems.
+
+A brand-new framework with the capabilities of madsim (the Rust DST framework):
+a drop-in deterministic async runtime in which all time, randomness,
+scheduling, network, and I/O are virtualized into a seeded discrete-event
+simulation — plus a batched backend that fuzzes thousands of seeds
+concurrently on TPU via JAX (vmap/pjit over a [seed, node] state tensor).
+
+Layout:
+    core/     deterministic runtime: RNG, virtual time, executor, nodes
+    net/      network simulation: chaos, endpoints, RPC, TCP/UDP, DNS, IPVS
+    sims/     ecosystem facades: grpc, etcd, kafka, s3 (in-sim servers)
+    tpu/      the batched TPU engine: lane states, vmapped step, sharding
+    native/   C++ fast path for the host executor core
+    fs/signal/testing: filesystem sim, signals, the test harness
+"""
+
+from .core import (  # noqa: F401
+    Config,
+    DeadlockError,
+    DeterminismError,
+    Future,
+    GlobalRng,
+    Handle,
+    JoinError,
+    JoinHandle,
+    NetConfig,
+    NodeBuilder,
+    NodeHandle,
+    Runtime,
+    TimeLimitError,
+    buggify,
+    check_determinism,
+    plugin,
+)
+from .core import task  # noqa: F401
+from .core import vtime as time  # noqa: F401
+from .core.buggify import buggify_with_prob  # noqa: F401
+from .core.task import spawn, yield_now  # noqa: F401
+from . import fs, signal  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def rand() -> float:
+    """Deterministic uniform [0,1) from the current simulation's RNG."""
+    from .core import context
+
+    return context.current_handle().rng.random()
+
+
+def randrange(start: int, stop=None) -> int:
+    from .core import context
+
+    return context.current_handle().rng.randrange(start, stop)

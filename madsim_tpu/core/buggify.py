@@ -1,0 +1,39 @@
+"""Cooperative fault injection — FoundationDB-style buggify
+(reference madsim/src/sim/buggify.rs:8-32).
+
+User code sprinkles `if buggify():` at interesting fault points; when enabled
+(test harness decision, per-seed), each point independently fires with
+probability 0.25 (or an explicit probability). All draws come from the
+simulation's global RNG, so firings are seed-deterministic.
+"""
+
+from __future__ import annotations
+
+from . import context
+
+DEFAULT_PROB = 0.25
+
+
+def buggify() -> bool:
+    """Fire with probability 0.25 when buggify is enabled."""
+    return buggify_with_prob(DEFAULT_PROB)
+
+
+def buggify_with_prob(prob: float) -> bool:
+    handle = context.try_current_handle()
+    if handle is None or not handle.rng.buggify_enabled:
+        return False
+    return handle.rng.gen_bool(prob)
+
+
+def enable() -> None:
+    context.current_handle().rng.buggify_enabled = True
+
+
+def disable() -> None:
+    context.current_handle().rng.buggify_enabled = False
+
+
+def is_enabled() -> bool:
+    handle = context.try_current_handle()
+    return handle is not None and handle.rng.buggify_enabled

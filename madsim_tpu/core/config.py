@@ -1,0 +1,76 @@
+"""Simulation configuration (reference madsim/src/sim/config.rs:15-48).
+
+`Config` holds per-simulation knobs — today the network chaos parameters
+(`NetConfig`: packet loss rate + latency range, reference
+net/network.rs:69-97) and a TCP section. Parses from TOML text, dumps back,
+and hashes stably for cache keying (config.rs:27-31).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NetConfig:
+    """Network chaos knobs (reference net/network.rs:69-89).
+
+    Defaults mirror the reference: zero loss, 1-10 ms one-way latency.
+    """
+
+    packet_loss_rate: float = 0.0
+    send_latency_min: float = 0.001
+    send_latency_max: float = 0.010
+
+    def to_toml(self) -> str:
+        return (
+            "[net]\n"
+            f"packet_loss_rate = {self.packet_loss_rate}\n"
+            f'send_latency = "{self.send_latency_min}s..{self.send_latency_max}s"\n'
+        )
+
+
+@dataclass
+class TcpConfig:
+    """TCP section — empty in the reference too (net/tcp/config.rs)."""
+
+
+@dataclass
+class Config:
+    net: NetConfig = field(default_factory=NetConfig)
+    tcp: TcpConfig = field(default_factory=TcpConfig)
+
+    @staticmethod
+    def parse(text: str) -> "Config":
+        data = tomllib.loads(text)
+        cfg = Config()
+        net = data.get("net", {})
+        if "packet_loss_rate" in net:
+            cfg.net.packet_loss_rate = float(net["packet_loss_rate"])
+        if "send_latency" in net:
+            lat = net["send_latency"]
+            if isinstance(lat, str):
+                lo, _, hi = lat.partition("..")
+                cfg.net.send_latency_min = _parse_dur(lo)
+                cfg.net.send_latency_max = _parse_dur(hi or lo)
+            else:
+                cfg.net.send_latency_min = cfg.net.send_latency_max = float(lat)
+        return cfg
+
+    def to_toml(self) -> str:
+        return self.net.to_toml()
+
+    def hash(self) -> int:
+        """Stable 64-bit hash of the config (analog of ahash config-hash)."""
+        digest = hashlib.sha256(self.to_toml().encode()).digest()
+        return int.from_bytes(digest[:8], "little")
+
+
+def _parse_dur(s: str) -> float:
+    s = s.strip()
+    for suffix, scale in (("ms", 1e-3), ("us", 1e-6), ("ns", 1e-9), ("s", 1.0)):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * scale
+    return float(s)

@@ -1,0 +1,39 @@
+"""Runtime metrics: task/node censuses for leak hunting
+(reference madsim/src/sim/runtime/metrics.rs:6-40, task/mod.rs:142-160).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, Optional
+
+if TYPE_CHECKING:
+    from .task import Executor
+
+
+class RuntimeMetrics:
+    def __init__(self, executor: "Executor") -> None:
+        self._executor = executor
+
+    def num_nodes(self) -> int:
+        return len(self._executor.nodes)
+
+    def num_tasks(self) -> int:
+        return sum(len(n.info.tasks) for n in self._executor.nodes.values())
+
+    def num_tasks_by_node(self) -> Dict[int, int]:
+        return {
+            id: len(n.info.tasks)
+            for id, n in sorted(self._executor.nodes.items())
+            if n.info.tasks
+        }
+
+    def num_tasks_by_node_by_spawn(self) -> Dict[int, Dict[str, int]]:
+        return {
+            id: dict(n.info.spawn_counts)
+            for id, n in sorted(self._executor.nodes.items())
+            if n.info.spawn_counts
+        }
+
+    def num_tasks_of(self, node_id: int) -> int:
+        node = self._executor.nodes.get(node_id)
+        return len(node.info.tasks) if node else 0

@@ -1,0 +1,161 @@
+"""Filesystem simulation (reference madsim/src/sim/fs.rs:24-296).
+
+Each node owns an in-memory map of path -> inode. Files support positional
+reads/writes (`read_at` / `write_all_at`), truncation, metadata, and fsync.
+State survives node restarts (it models a disk, not memory); `power_fail`
+models crash-induced loss of unsynced data by truncating every file back to
+its last synced length.
+
+The reference leaves `power_fail` as a TODO stub (fs.rs:51-53); here it is
+implemented, tracking the synced length per inode.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .core import context
+from .core.plugin import Simulator
+from .core.task import NodeId
+
+
+class _INode:
+    __slots__ = ("data", "synced_len")
+
+    def __init__(self) -> None:
+        self.data = bytearray()
+        self.synced_len = 0
+
+
+class FsSim(Simulator):
+    """Per-node in-memory filesystem."""
+
+    def __init__(self, rng, time, config) -> None:
+        super().__init__(rng, time, config)
+        self._fs: Dict[NodeId, Dict[str, _INode]] = {}
+
+    def create_node(self, node_id: NodeId) -> None:
+        self._fs.setdefault(node_id, {})
+
+    def reset_node(self, node_id: NodeId) -> None:
+        # a kill/restart does NOT wipe the disk; it only loses unsynced data
+        self.power_fail(node_id)
+
+    # -- chaos / inspection API --
+
+    def power_fail(self, node_id: NodeId) -> None:
+        """Lose all unsynced data on the node's disk."""
+        for inode in self._fs.get(node_id, {}).values():
+            del inode.data[inode.synced_len:]
+
+    def get_file_size(self, node_id: NodeId, path: str) -> Optional[int]:
+        inode = self._fs.get(node_id, {}).get(str(path))
+        return len(inode.data) if inode is not None else None
+
+    def _node_fs(self, node_id: NodeId) -> Dict[str, _INode]:
+        return self._fs.setdefault(node_id, {})
+
+
+def _sim() -> FsSim:
+    from .core.plugin import simulator
+
+    return simulator(FsSim)
+
+
+def _here() -> NodeId:
+    return context.current_task().node.id
+
+
+class Metadata:
+    __slots__ = ("_len",)
+
+    def __init__(self, length: int) -> None:
+        self._len = length
+
+    def len(self) -> int:
+        return self._len
+
+    def is_file(self) -> bool:
+        return True
+
+
+class File:
+    """Positional-IO file handle (reference fs.rs:148-229)."""
+
+    def __init__(self, sim: FsSim, node_id: NodeId, path: str, inode: _INode) -> None:
+        self._sim = sim
+        self._node_id = node_id
+        self._path = path
+        self._inode = inode
+
+    @staticmethod
+    async def open(path: str) -> "File":
+        sim, node_id = _sim(), _here()
+        inode = sim._node_fs(node_id).get(str(path))
+        if inode is None:
+            raise FileNotFoundError(f"file not found: {path}")
+        return File(sim, node_id, str(path), inode)
+
+    @staticmethod
+    async def create(path: str) -> "File":
+        sim, node_id = _sim(), _here()
+        inode = _INode()
+        sim._node_fs(node_id)[str(path)] = inode
+        return File(sim, node_id, str(path), inode)
+
+    async def read_at(self, buf_len: int, offset: int) -> bytes:
+        if offset < 0 or buf_len < 0:
+            raise ValueError("negative offset or length")
+        data = self._inode.data
+        return bytes(data[offset : offset + buf_len])
+
+    async def read_exact_at(self, buf_len: int, offset: int) -> bytes:
+        data = await self.read_at(buf_len, offset)
+        if len(data) < buf_len:
+            raise EOFError("failed to fill whole buffer")
+        return data
+
+    async def read_to_end(self) -> bytes:
+        return bytes(self._inode.data)
+
+    async def write_all_at(self, buf: bytes, offset: int) -> None:
+        if offset < 0:
+            raise ValueError("negative offset")
+        data = self._inode.data
+        if offset > len(data):
+            data.extend(b"\x00" * (offset - len(data)))
+        data[offset : offset + len(buf)] = buf
+
+    async def set_len(self, size: int) -> None:
+        data = self._inode.data
+        if size <= len(data):
+            del data[size:]
+        else:
+            data.extend(b"\x00" * (size - len(data)))
+
+    async def sync_all(self) -> None:
+        self._inode.synced_len = len(self._inode.data)
+
+    async def metadata(self) -> Metadata:
+        return Metadata(len(self._inode.data))
+
+
+async def read(path: str) -> bytes:
+    f = await File.open(path)
+    return await f.read_to_end()
+
+
+async def write(path: str, data: bytes) -> None:
+    f = await File.create(path)
+    await f.write_all_at(bytes(data), 0)
+
+
+async def remove_file(path: str) -> None:
+    sim, node_id = _sim(), _here()
+    if sim._node_fs(node_id).pop(str(path), None) is None:
+        raise FileNotFoundError(f"file not found: {path}")
+
+
+async def metadata(path: str) -> Metadata:
+    f = await File.open(path)
+    return await f.metadata()

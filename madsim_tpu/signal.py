@@ -1,0 +1,21 @@
+"""Signal simulation (reference madsim/src/sim/signal.rs:4-8).
+
+`ctrl_c()` completes when the supervisor sends ctrl-c to this node
+(`Handle.send_ctrl_c`). If a node has *never* awaited `ctrl_c()`, a ctrl-c
+kills it outright (reference task/mod.rs:410-425).
+"""
+
+from __future__ import annotations
+
+from .core import context
+from .core.futures import Future
+
+
+async def ctrl_c() -> None:
+    task = context.current_task()
+    info = task.node
+    if info.ctrl_c is None:
+        info.ctrl_c = []
+    fut: Future[None] = Future()
+    info.ctrl_c.append(fut)
+    await fut

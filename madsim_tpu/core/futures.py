@@ -17,16 +17,30 @@ T = TypeVar("T")
 class Future(Generic[T]):
     """One-shot completion cell; awaiting yields it to the executor."""
 
-    __slots__ = ("_done", "_result", "_exc", "_callbacks")
+    __slots__ = ("_done", "_result", "_exc", "_callbacks", "_abandoned")
 
     def __init__(self) -> None:
         self._done = False
         self._result: Optional[T] = None
         self._exc: Optional[BaseException] = None
         self._callbacks: List[Callable[["Future[T]"], None]] = []
+        self._abandoned = False
 
     def done(self) -> bool:
         return self._done
+
+    def abandoned(self) -> bool:
+        return self._abandoned
+
+    def abandon(self) -> None:
+        """Mark that no task will ever consume this future's result.
+
+        Set when the awaiting task is dropped (killed node / abort) so that
+        producers (channels, semaphores, mailboxes) skip it instead of
+        handing a wakeup/message to a dead consumer — otherwise the value
+        would be silently lost (kill() is a chaos primitive; this matters).
+        """
+        self._abandoned = True
 
     def result(self) -> T:
         if not self._done:
@@ -39,6 +53,8 @@ class Future(Generic[T]):
         return self._exc if self._done else None
 
     def set_result(self, result: T) -> None:
+        if self._abandoned:
+            return  # consumer is gone; drop silently
         if self._done:
             raise RuntimeError("future already done")
         self._result = result
@@ -46,6 +62,8 @@ class Future(Generic[T]):
         self._run_callbacks()
 
     def set_exception(self, exc: BaseException) -> None:
+        if self._abandoned:
+            return
         if self._done:
             raise RuntimeError("future already done")
         self._exc = exc
@@ -53,7 +71,7 @@ class Future(Generic[T]):
         self._run_callbacks()
 
     def try_set_result(self, result: T) -> bool:
-        if self._done:
+        if self._done or self._abandoned:
             return False
         self.set_result(result)
         return True

@@ -1,0 +1,236 @@
+"""Deterministic async synchronization primitives (tokio::sync analog).
+
+The reference reuses real tokio `sync` inside the simulation — safe because
+polling is single-threaded and deterministic (madsim-tokio/src/lib.rs:1-51).
+Here the equivalents are built on the simulation's own `Future`: unbounded /
+bounded mpsc channels, oneshot (= `Future`), watch, Notify, Semaphore, Event.
+No locks anywhere — one OS thread by construction.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, Generic, List, Optional, Tuple, TypeVar
+
+from .futures import Future
+
+T = TypeVar("T")
+
+
+class ChannelClosed(Exception):
+    """Receiving on an empty+closed channel, or sending on a closed one."""
+
+
+class Channel(Generic[T]):
+    """MPSC channel. Unbounded by default; bounded if capacity is given."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        self._queue: Deque[T] = deque()
+        self._capacity = capacity
+        self._recv_waiters: Deque[Future[None]] = deque()
+        self._send_waiters: Deque[Future[None]] = deque()
+        self._closed = False
+
+    # -- sender side --
+
+    def try_send(self, value: T) -> bool:
+        if self._closed:
+            raise ChannelClosed("channel closed")
+        if self._capacity is not None and len(self._queue) >= self._capacity:
+            return False
+        self._queue.append(value)
+        self._wake_one(self._recv_waiters)
+        return True
+
+    async def send(self, value: T) -> None:
+        while not self.try_send(value):
+            fut: Future[None] = Future()
+            self._send_waiters.append(fut)
+            await fut
+        return None
+
+    def send_nowait(self, value: T) -> None:
+        """Unbounded send (raises on bounded-full or closed)."""
+        if not self.try_send(value):
+            raise RuntimeError("channel full")
+
+    # -- receiver side --
+
+    def try_recv(self) -> Tuple[bool, Optional[T]]:
+        if self._queue:
+            value = self._queue.popleft()
+            self._wake_one(self._send_waiters)
+            return True, value
+        if self._closed:
+            raise ChannelClosed("channel closed")
+        return False, None
+
+    async def recv(self) -> T:
+        while True:
+            ok, value = self.try_recv()
+            if ok:
+                return value  # type: ignore[return-value]
+            fut: Future[None] = Future()
+            self._recv_waiters.append(fut)
+            await fut
+
+    # -- common --
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._recv_waiters:
+            fut.try_set_result(None)
+        self._recv_waiters.clear()
+        for fut in self._send_waiters:
+            fut.try_set_result(None)
+        self._send_waiters.clear()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @staticmethod
+    def _wake_one(waiters: Deque[Future[None]]) -> None:
+        while waiters:
+            if waiters.popleft().try_set_result(None):
+                break
+
+
+def oneshot() -> Tuple["OneshotSender[T]", Future[T]]:
+    fut: Future[T] = Future()
+    return OneshotSender(fut), fut
+
+
+class OneshotSender(Generic[T]):
+    __slots__ = ("_fut",)
+
+    def __init__(self, fut: Future[T]) -> None:
+        self._fut = fut
+
+    def send(self, value: T) -> bool:
+        return self._fut.try_set_result(value)
+
+
+class Watch(Generic[T]):
+    """Single-value watch channel: receivers see the latest value."""
+
+    def __init__(self, initial: T) -> None:
+        self.value = initial
+        self.version = 0
+        self._waiters: List[Future[None]] = []
+
+    def send(self, value: T) -> None:
+        self.value = value
+        self.version += 1
+        waiters, self._waiters = self._waiters, []
+        for fut in waiters:
+            fut.try_set_result(None)
+
+    async def changed(self, seen_version: Optional[int] = None) -> T:
+        version = self.version if seen_version is None else seen_version
+        while self.version == version:
+            fut: Future[None] = Future()
+            self._waiters.append(fut)
+            await fut
+        return self.value
+
+    def borrow(self) -> T:
+        return self.value
+
+
+class Notify:
+    """Wake one / wake all notification primitive."""
+
+    def __init__(self) -> None:
+        self._waiters: Deque[Future[None]] = deque()
+        self._pending = 0
+
+    async def notified(self) -> None:
+        if self._pending > 0:
+            self._pending -= 1
+            return
+        fut: Future[None] = Future()
+        self._waiters.append(fut)
+        await fut
+
+    def notify_one(self) -> None:
+        while self._waiters:
+            if self._waiters.popleft().try_set_result(None):
+                return
+        self._pending += 1
+
+    def notify_waiters(self) -> None:
+        waiters, self._waiters = self._waiters, deque()
+        for fut in waiters:
+            fut.try_set_result(None)
+
+
+class Semaphore:
+    def __init__(self, permits: int) -> None:
+        self._permits = permits
+        self._waiters: Deque[Future[None]] = deque()
+
+    async def acquire(self) -> None:
+        while self._permits <= 0:
+            fut: Future[None] = Future()
+            self._waiters.append(fut)
+            await fut
+        self._permits -= 1
+
+    def try_acquire(self) -> bool:
+        if self._permits > 0:
+            self._permits -= 1
+            return True
+        return False
+
+    def release(self) -> None:
+        self._permits += 1
+        while self._waiters:
+            if self._waiters.popleft().try_set_result(None):
+                break
+
+    def available_permits(self) -> int:
+        return self._permits
+
+
+class Event:
+    """One-shot broadcast flag."""
+
+    def __init__(self) -> None:
+        self._fut: Future[None] = Future()
+
+    def set(self) -> None:
+        self._fut.try_set_result(None)
+
+    def is_set(self) -> bool:
+        return self._fut.done()
+
+    async def wait(self) -> None:
+        if not self._fut.done():
+            await self._fut
+
+
+class Barrier:
+    def __init__(self, n: int) -> None:
+        if n < 1:
+            raise ValueError("barrier size must be >= 1")
+        self._n = n
+        self._count = 0
+        self._event = Event()
+
+    async def wait(self) -> bool:
+        """Returns True for the leader (last arriver)."""
+        self._count += 1
+        if self._count == self._n:
+            event, self._event = self._event, Event()
+            self._count = 0
+            event.set()
+            return True
+        event = self._event
+        await event.wait()
+        return False

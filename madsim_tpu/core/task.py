@@ -113,6 +113,7 @@ class Task:
         "join_fut",
         "_in_queue",
         "_parked",
+        "_awaiting",
     )
 
     def __init__(
@@ -135,6 +136,7 @@ class Task:
         self.join_fut: Future[Any] = Future()
         self._in_queue = False
         self._parked = False
+        self._awaiting: Optional[Future] = None
         node.tasks.append(self)
         node.spawn_counts[location] = node.spawn_counts.get(location, 0) + 1
 
@@ -142,6 +144,7 @@ class Task:
 
     def step(self) -> None:
         """Poll the coroutine once. Raises on unhandled task exception."""
+        self._awaiting = None
         try:
             yielded = self.coro.send(None)
         except StopIteration as stop:
@@ -156,6 +159,7 @@ class Task:
                 )
             raise
         if isinstance(yielded, Future):
+            self._awaiting = yielded
             yielded.add_done_callback(self._wake)
         elif isinstance(yielded, _YieldNow):
             self.executor.schedule(self)
@@ -175,6 +179,9 @@ class Task:
         if self.finished:
             return
         self._finish()
+        # tell producers this consumer is gone (lost-wakeup prevention)
+        if self._awaiting is not None and not self._awaiting.done():
+            self._awaiting.abandon()
         try:
             self.coro.close()
         except BaseException:  # noqa: BLE001 - a misbehaving finally block must not kill the sim

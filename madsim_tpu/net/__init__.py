@@ -1,0 +1,17 @@
+"""Network simulation (reference madsim/src/sim/net/, ~2.5k LoC)."""
+
+from .addr import SocketAddr, ToSocketAddrs, lookup_host  # noqa: F401
+from .endpoint import Endpoint  # noqa: F401
+from .ipvs import Ipvs, Scheduler, ServiceAddr  # noqa: F401
+from .netsim import NetSim, PayloadReceiver, PayloadSender  # noqa: F401
+from .network import Direction, Network, Stat  # noqa: F401
+from .rpc import (  # noqa: F401
+    add_rpc_handler,
+    add_rpc_handler_with_data,
+    call,
+    call_timeout,
+    call_with_data,
+    rpc_request,
+)
+from .tcp import TcpListener, TcpStream  # noqa: F401
+from .udp import UdpSocket  # noqa: F401

@@ -1,0 +1,166 @@
+"""TCP simulation: listener + byte stream over reliable connections.
+
+Analog of reference madsim/src/sim/net/tcp/ (591 LoC): flush-based delivery
+(written bytes are buffered until `flush()` and travel as one message), EOF on
+close/drop, connection-refused when the peer is clogged or absent
+(tcp/stream.rs:21-175, tcp/listener.rs:8-96).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..core.sync import Channel, ChannelClosed
+from .addr import SocketAddr, ToSocketAddrs, lookup_host
+from .endpoint import BindGuard
+from .netsim import NetSim, PayloadReceiver, PayloadSender
+
+TCP = "tcp"
+
+
+class _TcpListenerSocket:
+    """Socket accepting connections only (datagrams are not TCP)."""
+
+    def __init__(self) -> None:
+        self.conn_chan: Channel = Channel()
+
+    def deliver(self, src: SocketAddr, dst: SocketAddr, msg: object) -> None:
+        pass  # no datagrams on a TCP socket
+
+    def new_connection(
+        self, src: SocketAddr, dst: SocketAddr, tx: PayloadSender, rx: PayloadReceiver
+    ) -> None:
+        try:
+            self.conn_chan.send_nowait((tx, rx, src))
+        except Exception:
+            pass
+
+
+class TcpListener:
+    def __init__(self, guard: BindGuard, socket: _TcpListenerSocket) -> None:
+        self._guard = guard
+        self._socket = socket
+
+    @staticmethod
+    async def bind(addr: ToSocketAddrs) -> "TcpListener":
+        socket = _TcpListenerSocket()
+        guard = await BindGuard.bind(addr, TCP, socket)
+        return TcpListener(guard, socket)
+
+    def local_addr(self) -> SocketAddr:
+        return self._guard.addr
+
+    async def accept(self) -> Tuple["TcpStream", SocketAddr]:
+        try:
+            tx, rx, from_addr = await self._socket.conn_chan.recv()
+        except ChannelClosed:
+            raise OSError("listener closed") from None
+        return TcpStream(tx, rx, self._guard.addr, from_addr), from_addr
+
+    def close(self) -> None:
+        self._guard.close()
+        self._socket.conn_chan.close()
+
+
+class TcpStream:
+    """Byte stream with flush-based delivery."""
+
+    def __init__(
+        self,
+        tx: PayloadSender,
+        rx: PayloadReceiver,
+        local: SocketAddr,
+        peer: SocketAddr,
+        guard: Optional[BindGuard] = None,
+    ) -> None:
+        self._tx = tx
+        self._rx = rx
+        self._local = local
+        self._peer = peer
+        self._guard = guard  # ephemeral bind of a client-side connect
+        self._wbuf = bytearray()
+        self._rbuf = bytearray()
+        self._eof = False
+
+    @staticmethod
+    async def connect(addr: ToSocketAddrs) -> "TcpStream":
+        from ..core import context
+        from ..core.plugin import simulator
+
+        net = simulator(NetSim)
+        node_id = context.current_task().node.id
+        resolved = await lookup_host(addr)
+        # bind an ephemeral local socket so the peer can address us
+        socket = _TcpListenerSocket()
+        guard = await BindGuard.bind(("0.0.0.0", 0), TCP, socket)
+        tx, rx, src = await net.connect1(node_id, guard.addr[1], resolved, TCP)
+        return TcpStream(tx, rx, src, resolved, guard=guard)
+
+    def local_addr(self) -> SocketAddr:
+        return self._local
+
+    def peer_addr(self) -> SocketAddr:
+        return self._peer
+
+    # -- write side --
+
+    def write(self, buf: bytes) -> int:
+        self._wbuf += buf
+        return len(buf)
+
+    async def flush(self) -> None:
+        if self._wbuf:
+            data, self._wbuf = bytes(self._wbuf), bytearray()
+            try:
+                self._tx.send(data)
+            except ChannelClosed:
+                raise BrokenPipeError("connection closed by peer") from None
+
+    async def write_all(self, buf: bytes) -> None:
+        self.write(buf)
+        await self.flush()
+
+    # -- read side --
+
+    async def read(self, max_len: int = 65536) -> bytes:
+        """Up to max_len bytes; b"" at EOF."""
+        if not self._rbuf and not self._eof:
+            try:
+                data = await self._rx.recv()
+            except ChannelClosed:
+                self._eof = True
+                return b""
+            self._rbuf += data
+        out = bytes(self._rbuf[:max_len])
+        del self._rbuf[:max_len]
+        return out
+
+    async def read_exact(self, n: int) -> bytes:
+        while len(self._rbuf) < n and not self._eof:
+            try:
+                data = await self._rx.recv()
+            except ChannelClosed:
+                self._eof = True
+                break
+            self._rbuf += data
+        if len(self._rbuf) < n:
+            raise EOFError("early eof")
+        out = bytes(self._rbuf[:n])
+        del self._rbuf[:n]
+        return out
+
+    def shutdown(self) -> None:
+        """Close the write half; the peer reads EOF."""
+        self._tx.close()
+
+    def close(self) -> None:
+        self._tx.close()
+        self._rx.close()
+        if self._guard is not None:
+            self._guard.close()
+
+    def __enter__(self) -> "TcpStream":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()

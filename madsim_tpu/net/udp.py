@@ -1,0 +1,47 @@
+"""UDP socket sim — thin adapter over Endpoint tag 0 (reference net/udp.rs:9-73)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from .addr import SocketAddr, ToSocketAddrs
+from .endpoint import Endpoint
+
+_TAG = 0
+
+
+class UdpSocket:
+    def __init__(self, ep: Endpoint) -> None:
+        self._ep = ep
+
+    @staticmethod
+    async def bind(addr: ToSocketAddrs) -> "UdpSocket":
+        return UdpSocket(await Endpoint.bind(addr))
+
+    async def connect(self, addr: ToSocketAddrs) -> None:
+        from .addr import lookup_host
+
+        self._ep._peer = await lookup_host(addr)
+
+    def local_addr(self) -> SocketAddr:
+        return self._ep.local_addr()
+
+    def peer_addr(self) -> SocketAddr:
+        return self._ep.peer_addr()
+
+    async def send_to(self, buf: bytes, dst: ToSocketAddrs) -> int:
+        await self._ep.send_to(dst, _TAG, buf)
+        return len(buf)
+
+    async def recv_from(self) -> Tuple[bytes, SocketAddr]:
+        return await self._ep.recv_from(_TAG)
+
+    async def send(self, buf: bytes) -> int:
+        await self._ep.send(_TAG, buf)
+        return len(buf)
+
+    async def recv(self) -> bytes:
+        return await self._ep.recv(_TAG)
+
+    def close(self) -> None:
+        self._ep.close()

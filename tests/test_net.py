@@ -1,0 +1,642 @@
+"""Network sim tests — mirrors reference endpoint.rs:363-583, tcp/mod.rs:57-308,
+ipvs.rs:108-131, rpc.rs doctests."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.net import Endpoint, NetSim, TcpListener, TcpStream, UdpSocket
+from madsim_tpu.net import rpc
+from madsim_tpu.core.sync import ChannelClosed
+
+
+def make_rt(seed=1, **net_kwargs):
+    cfg = ms.Config()
+    for k, v in net_kwargs.items():
+        setattr(cfg.net, k, v)
+    return ms.Runtime(seed=seed, config=cfg)
+
+
+def test_endpoint_send_recv():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        node1 = h.create_node().name("n1").ip("10.0.0.1").build()
+        node2 = h.create_node().name("n2").ip("10.0.0.2").build()
+
+        async def server():
+            ep = await Endpoint.bind("10.0.0.1:5000")
+            data, frm = await ep.recv_from(7)
+            assert data == b"ping"
+            await ep.send_to(frm, 8, b"pong")
+
+        async def client():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            await ep.send_to("10.0.0.1:5000", 7, b"ping")
+            data, frm = await ep.recv_from(8)
+            assert data == b"pong"
+            assert frm == ("10.0.0.1", 5000)
+            return True
+
+        node1.spawn(server())
+        hc = node2.spawn(client())
+        await ms.time.sleep(0.5)
+        return await hc
+
+    assert rt.block_on(main())
+
+
+def test_tag_matching_out_of_order():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().ip("10.0.0.1").build()
+        n2 = h.create_node().ip("10.0.0.2").build()
+
+        async def sender():
+            ep = await Endpoint.bind("10.0.0.1:1000")
+            await ep.send_to("10.0.0.2:1000", 1, b"one")
+            await ep.send_to("10.0.0.2:1000", 2, b"two")
+
+        got = {}
+
+        async def receiver():
+            ep = await Endpoint.bind("10.0.0.2:1000")
+            # receive tag 2 first even though tag 1 was sent first
+            data2, _ = await ep.recv_from(2)
+            data1, _ = await ep.recv_from(1)
+            got["two"], got["one"] = data2, data1
+
+        n1.spawn(sender())
+        hr = n2.spawn(receiver())
+        await hr
+        assert got == {"one": b"one", "two": b"two"}
+
+    rt.block_on(main())
+
+
+def test_rpc_call():
+    rt = make_rt()
+
+    @rpc.rpc_request
+    class Add:
+        def __init__(self, a, b):
+            self.a, self.b = a, b
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            ep = await Endpoint.bind("10.0.0.1:9000")
+
+            async def handle(req):
+                return req.a + req.b
+
+            rpc.add_rpc_handler(ep, Add, handle)
+
+        async def client():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            return await rpc.call(ep, "10.0.0.1:9000", Add(20, 22))
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main()) == 42
+
+
+def test_rpc_with_data():
+    rt = make_rt()
+
+    @rpc.rpc_request
+    class Echo:
+        pass
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            ep = await Endpoint.bind("10.0.0.1:9000")
+
+            async def handle(req, data):
+                return "ok", data[::-1]
+
+            rpc.add_rpc_handler_with_data(ep, Echo, handle)
+
+        async def client():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            return await rpc.call_with_data(ep, "10.0.0.1:9000", Echo(), b"abc")
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main()) == ("ok", b"cba")
+
+
+def test_packet_loss_datagrams_dropped():
+    rt = make_rt(seed=3, packet_loss_rate=1.0)
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().ip("10.0.0.1").build()
+        n2 = h.create_node().ip("10.0.0.2").build()
+
+        async def sender():
+            ep = await Endpoint.bind("10.0.0.1:1000")
+            await ep.send_to("10.0.0.2:1000", 0, b"x")
+
+        got = []
+
+        async def receiver():
+            ep = await Endpoint.bind("10.0.0.2:1000")
+            data, _ = await ep.recv_from(0)
+            got.append(data)
+
+        n1.spawn(sender())
+        n2.spawn(receiver())
+        await ms.time.sleep(5.0)
+        return got
+
+    assert rt.block_on(main()) == []
+
+
+def test_clog_unclog_node():
+    rt = make_rt(seed=2)
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().ip("10.0.0.1").build()
+        n2 = h.create_node().ip("10.0.0.2").build()
+        net = ms.plugin.simulator(NetSim)
+
+        got = []
+
+        async def receiver():
+            ep = await Endpoint.bind("10.0.0.2:1000")
+            while True:
+                data, _ = await ep.recv_from(0)
+                got.append((data, round(ms.time.current().elapsed(), 1)))
+
+        async def sender():
+            ep = await Endpoint.bind("10.0.0.1:1000")
+            await ep.send_to("10.0.0.2:1000", 0, b"a")  # delivered
+            await ms.time.sleep(1.0)
+            net.clog_node(n2.id)
+            await ep.send_to("10.0.0.2:1000", 0, b"b")  # dropped (datagram)
+            await ms.time.sleep(1.0)
+            net.unclog_node(n2.id)
+            await ep.send_to("10.0.0.2:1000", 0, b"c")  # delivered
+
+        n2.spawn(receiver())
+        n1.spawn(sender())
+        await ms.time.sleep(5.0)
+        return got
+
+    got = rt.block_on(main())
+    assert [g[0] for g in got] == [b"a", b"c"]
+
+
+def test_tcp_roundtrip():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            lis = await TcpListener.bind("10.0.0.1:2000")
+            stream, peer = await lis.accept()
+            data = await stream.read_exact(5)
+            await stream.write_all(data.upper())
+
+        async def client():
+            stream = await TcpStream.connect("10.0.0.1:2000")
+            await stream.write_all(b"hello")
+            return await stream.read_exact(5)
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main()) == b"HELLO"
+
+
+def test_tcp_connection_refused():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def client():
+            with pytest.raises(ConnectionRefusedError):
+                await TcpStream.connect("10.0.0.1:2000")  # nothing bound
+            return True
+
+        return await cli.spawn(client())
+
+    assert rt.block_on(main())
+
+
+def test_tcp_survives_clog_with_backoff():
+    # reference tcp/mod.rs: clog mid-connection, data arrives after unclog
+    rt = make_rt(seed=4)
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+        net = ms.plugin.simulator(NetSim)
+
+        async def server():
+            lis = await TcpListener.bind("10.0.0.1:2000")
+            stream, _ = await lis.accept()
+            return await stream.read_exact(4)
+
+        hs = srv.spawn(server())
+        await ms.time.sleep(0.1)
+
+        async def client():
+            stream = await TcpStream.connect("10.0.0.1:2000")
+            net.clog_node(srv.id)
+            await stream.write_all(b"data")  # sent while clogged
+            await ms.time.sleep(3.0)
+            net.unclog_node(srv.id)
+
+        cli.spawn(client())
+        t0 = ms.time.current().elapsed()
+        data = await hs
+        took = ms.time.current().elapsed() - t0
+        assert data == b"data"
+        assert took >= 3.0  # had to wait out the clog
+
+    rt.block_on(main())
+
+
+def test_tcp_eof_on_peer_close():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            lis = await TcpListener.bind("10.0.0.1:2000")
+            stream, _ = await lis.accept()
+            stream.close()
+
+        async def client():
+            stream = await TcpStream.connect("10.0.0.1:2000")
+            return await stream.read()
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main()) == b""
+
+
+def test_kill_node_closes_connections():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            lis = await TcpListener.bind("10.0.0.1:2000")
+            while True:
+                stream, _ = await lis.accept()
+
+        async def client():
+            stream = await TcpStream.connect("10.0.0.1:2000")
+            await ms.time.sleep(1.0)
+            rt.handle.kill(srv.id)
+            # peer killed => EOF
+            return await stream.read()
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main()) == b""
+
+
+def test_udp_socket():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().ip("10.0.0.1").build()
+        n2 = h.create_node().ip("10.0.0.2").build()
+
+        async def a():
+            sock = await UdpSocket.bind("10.0.0.1:3000")
+            data, frm = await sock.recv_from()
+            await sock.send_to(data + b"!", frm)
+
+        async def b():
+            sock = await UdpSocket.bind("10.0.0.2:3000")
+            await sock.send_to(b"hi", "10.0.0.1:3000")
+            data, _ = await sock.recv_from()
+            return data
+
+        n1.spawn(a())
+        await ms.time.sleep(0.1)
+        return await n2.spawn(b())
+
+    assert rt.block_on(main()) == b"hi!"
+
+
+def test_dns_lookup():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+        net = ms.plugin.simulator(NetSim)
+        net.add_dns_record("server.example.com", "10.0.0.1")
+
+        async def server():
+            ep = await Endpoint.bind("10.0.0.1:5000")
+            data, frm = await ep.recv_from(0)
+            await ep.send_to(frm, 1, data)
+
+        async def client():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            await ep.send_to("server.example.com:5000", 0, b"dns works")
+            data, _ = await ep.recv_from(1)
+            return data
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main()) == b"dns works"
+
+
+def test_ipvs_round_robin():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        backends = [
+            h.create_node().ip(f"10.0.0.{i}").build() for i in (1, 2)
+        ]
+        cli = h.create_node().ip("10.0.0.9").build()
+        net = ms.plugin.simulator(NetSim)
+        net.ipvs.add_service(("10.1.0.1", 80, "udp"))
+        net.ipvs.add_server(("10.1.0.1", 80, "udp"), "10.0.0.1:80")
+        net.ipvs.add_server(("10.1.0.1", 80, "udp"), "10.0.0.2:80")
+
+        hits = {1: 0, 2: 0}
+
+        def backend(i):
+            async def run():
+                ep = await Endpoint.bind(f"10.0.0.{i}:80")
+                while True:
+                    await ep.recv_from(0)
+                    hits[i] += 1
+
+            return run
+
+        for i, b in zip((1, 2), backends):
+            b.spawn(backend(i)())
+
+        async def client():
+            ep = await Endpoint.bind("10.0.0.9:0")
+            for _ in range(6):
+                await ep.send_to("10.1.0.1:80", 0, b"req")
+                await ms.time.sleep(0.1)
+
+        cli.spawn(client())
+        await ms.time.sleep(3.0)
+        return hits
+
+    hits = rt.block_on(main())
+    assert hits == {1: 3, 2: 3}
+
+
+def test_rpc_hooks_drop_requests():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().ip("10.0.0.1").build()
+        n2 = h.create_node().ip("10.0.0.2").build()
+        net = ms.plugin.simulator(NetSim)
+
+        got = []
+
+        async def receiver():
+            ep = await Endpoint.bind("10.0.0.2:1000")
+            while True:
+                data, _ = await ep.recv_from(0)
+                got.append(data)
+
+        async def sender():
+            ep = await Endpoint.bind("10.0.0.1:1000")
+            net.hook_rpc_req(n1.id, lambda msg: msg[1] != b"drop-me")
+            await ep.send_to("10.0.0.2:1000", 0, b"keep")
+            await ep.send_to("10.0.0.2:1000", 0, b"drop-me")
+            await ep.send_to("10.0.0.2:1000", 0, b"keep2")
+
+        n2.spawn(receiver())
+        n1.spawn(sender())
+        await ms.time.sleep(2.0)
+        return got
+
+    assert rt.block_on(main()) == [b"keep", b"keep2"]
+
+
+def test_net_stat_counts_messages():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().ip("10.0.0.1").build()
+        n2 = h.create_node().ip("10.0.0.2").build()
+        net = ms.plugin.simulator(NetSim)
+
+        async def sender():
+            ep = await Endpoint.bind("10.0.0.1:1000")
+            for _ in range(5):
+                await ep.send_to("10.0.0.2:1000", 0, b"x")
+
+        async def receiver():
+            ep = await Endpoint.bind("10.0.0.2:1000")
+            while True:
+                await ep.recv_from(0)
+
+        n2.spawn(receiver())
+        n1.spawn(sender())
+        await ms.time.sleep(1.0)
+        return net.stat().msg_count
+
+    assert rt.block_on(main()) == 5
+
+
+def test_addr_in_use():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().ip("10.0.0.1").build()
+
+        async def run():
+            await Endpoint.bind("10.0.0.1:5000")
+            with pytest.raises(OSError, match="address already in use"):
+                await Endpoint.bind("10.0.0.1:5000")
+            return True
+
+        return await n1.spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_deterministic_network_trace():
+    def run(seed):
+        rt = make_rt(seed=seed, packet_loss_rate=0.3)
+        events = []
+
+        async def main():
+            h = rt.handle
+            n1 = h.create_node().ip("10.0.0.1").build()
+            n2 = h.create_node().ip("10.0.0.2").build()
+
+            async def receiver():
+                ep = await Endpoint.bind("10.0.0.2:1000")
+                while True:
+                    data, _ = await ep.recv_from(0)
+                    events.append((data, ms.time.current().now_ns()))
+
+            async def sender():
+                ep = await Endpoint.bind("10.0.0.1:1000")
+                for i in range(20):
+                    await ep.send_to("10.0.0.2:1000", 0, str(i).encode())
+                    await ms.time.sleep(0.05)
+
+            n2.spawn(receiver())
+            n1.spawn(sender())
+            await ms.time.sleep(5.0)
+
+        rt.block_on(main())
+        return events
+
+    a, b, c = run(11), run(11), run(12)
+    assert a == b
+    assert a != c
+    assert 0 < len(a) < 20  # some dropped, some delivered
+
+
+def test_kill_waiter_does_not_lose_channel_message():
+    # regression: a killed task parked on Channel.recv must not swallow wakeups
+    rt = make_rt()
+    from madsim_tpu.core.sync import Channel
+
+    async def main():
+        h = rt.handle
+        n1 = h.create_node().build()
+        n2 = h.create_node().build()
+        chan = Channel()
+        got = []
+
+        async def receiver(tag):
+            v = await chan.recv()
+            got.append((tag, v))
+
+        n1.spawn(receiver("dead"))
+        n2.spawn(receiver("alive"))
+        await ms.time.sleep(0.1)
+        h.kill(n1.id)
+        await ms.time.sleep(0.1)
+        chan.send_nowait("hello")
+        await ms.time.sleep(0.1)
+        return got
+
+    assert rt.block_on(main()) == [("alive", "hello")]
+
+
+def test_auto_ip_skips_user_assigned():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        h.create_node().ip("192.168.0.2").build()  # node 1 takes node 2's auto IP
+        n2 = h.create_node().build()  # must not crash
+        net = ms.plugin.simulator(NetSim)
+        assert net.get_ip(n2.id) not in (None, "192.168.0.2")
+
+    rt.block_on(main())
+
+
+def test_write_to_killed_peer_raises_broken_pipe():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            lis = await TcpListener.bind("10.0.0.1:2000")
+            while True:
+                await lis.accept()
+
+        async def client():
+            stream = await TcpStream.connect("10.0.0.1:2000")
+            await ms.time.sleep(0.5)
+            rt.handle.kill(srv.id)
+            with pytest.raises(BrokenPipeError):
+                for _ in range(3):
+                    await stream.write_all(b"x")
+                    await ms.time.sleep(0.1)
+            return True
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main())
+
+
+def test_tcp_connect_releases_ephemeral_port():
+    rt = make_rt()
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            lis = await TcpListener.bind("10.0.0.1:2000")
+            while True:
+                stream, _ = await lis.accept()
+                stream.close()
+
+        async def client():
+            from madsim_tpu.net.netsim import NetSim as NS
+
+            net = ms.plugin.simulator(NS)
+            for _ in range(50):
+                stream = await TcpStream.connect("10.0.0.1:2000")
+                stream.close()
+            # all ephemeral binds released
+            return len(net.network.nodes[cli.id].sockets)
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main()) == 0

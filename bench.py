@@ -1,0 +1,102 @@
+"""Headline benchmark: seeds/sec fuzzing 5-node Raft (BASELINE.json metric).
+
+Compares the TPU batched engine (thousands of seed lanes per jitted step)
+against the reference execution model: one full simulation per seed on the
+host executor (the thread-per-seed CPU baseline,
+reference runtime/builder.rs:118-136).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "seeds/s", "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_tpu(lanes: int, virtual_secs: float) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu import BatchedSim, SimConfig, make_raft_spec, summarize
+
+    spec = make_raft_spec(n_nodes=5)
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        loss_rate=0.10,
+        crash_interval_lo_us=500_000,
+        crash_interval_hi_us=3_000_000,
+        restart_delay_lo_us=300_000,
+        restart_delay_hi_us=2_000_000,
+    )
+    sim = BatchedSim(spec, cfg)
+    max_steps = int(virtual_secs * 600) + 2000  # generous event budget
+
+    # compile + warm (first run pays tracing/compile)
+    state = sim.run(jnp.arange(lanes), max_steps=max_steps)
+    state.clock.block_until_ready()
+
+    t0 = time.perf_counter()
+    state = sim.run(jnp.arange(lanes, 2 * lanes), max_steps=max_steps)
+    state.clock.block_until_ready()
+    wall = time.perf_counter() - t0
+
+    s = summarize(state)
+    return {
+        "wall_s": wall,
+        "seeds_per_sec": lanes / wall,
+        "events_per_sec": s["total_events"] / wall,
+        "summary": s,
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    }
+
+
+def bench_cpu_baseline(n_seeds: int, virtual_secs: float) -> dict:
+    from madsim_tpu.workloads.raft_host import fuzz_one_seed
+
+    # warm one seed (imports, code paths)
+    fuzz_one_seed(999_983, virtual_secs=virtual_secs)
+    t0 = time.perf_counter()
+    events = 0
+    for seed in range(n_seeds):
+        r = fuzz_one_seed(seed, virtual_secs=virtual_secs)
+        events += r["events"]
+    wall = time.perf_counter() - t0
+    return {
+        "wall_s": wall,
+        "seeds_per_sec": n_seeds / wall,
+        "events_per_sec": events / wall,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lanes", type=int, default=16384)
+    parser.add_argument("--virtual-secs", type=float, default=10.0)
+    parser.add_argument("--cpu-seeds", type=int, default=16)
+    args = parser.parse_args()
+
+    cpu = bench_cpu_baseline(args.cpu_seeds, args.virtual_secs)
+    tpu = bench_tpu(args.lanes, args.virtual_secs)
+
+    result = {
+        "metric": "raft5_fuzz_seeds_per_sec",
+        "value": round(tpu["seeds_per_sec"], 2),
+        "unit": "seeds/s",
+        "vs_baseline": round(tpu["seeds_per_sec"] / cpu["seeds_per_sec"], 2),
+        "lanes": args.lanes,
+        "virtual_secs": args.virtual_secs,
+        "tpu_wall_s": round(tpu["wall_s"], 3),
+        "tpu_events_per_sec": round(tpu["events_per_sec"], 1),
+        "cpu_baseline_seeds_per_sec": round(cpu["seeds_per_sec"], 3),
+        "violations": tpu["summary"]["violations"],
+        "backend": tpu["backend"],
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,8 +37,9 @@ from .core import task  # noqa: F401
 from .core import vtime as time  # noqa: F401
 from .core.buggify import buggify_with_prob  # noqa: F401
 from .core.task import spawn, yield_now  # noqa: F401
-from . import fs, net, signal  # noqa: F401
+from . import fs, net, signal, testing  # noqa: F401
 from .core import sync  # noqa: F401
+from .testing import madsim_test  # noqa: F401
 
 __version__ = "0.1.0"
 

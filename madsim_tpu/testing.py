@@ -1,0 +1,167 @@
+"""Test harness: env-configured seed sweeps (the `#[madsim::test]` analog).
+
+Reference: madsim-macros/src/lib.rs:115-152 rewrites test bodies into
+`Builder::from_env().run(...)`; runtime/builder.rs:55-148 reads
+`MADSIM_TEST_{SEED,NUM,JOBS,CONFIG,TIME_LIMIT,CHECK_DETERMINISM}` and sweeps
+seeds on OS threads, `jobs` at a time. Failures report the repro seed.
+
+Here `@madsim_test` wraps an `async def` test function so pytest (or anything)
+calls it synchronously:
+
+    @madsim_test
+    async def test_my_cluster():
+        ...
+
+Env vars (same names as the reference):
+    MADSIM_TEST_SEED               first seed (default: OS entropy)
+    MADSIM_TEST_NUM                number of seeds to sweep (default 1)
+    MADSIM_TEST_JOBS               concurrent OS threads (default 1)
+    MADSIM_TEST_CONFIG             path to a TOML config file
+    MADSIM_TEST_TIME_LIMIT         virtual-time limit in seconds
+    MADSIM_TEST_CHECK_DETERMINISM  run every seed twice + compare RNG traces
+
+The TPU batched backend (`madsim_tpu.tpu`) replaces exactly this thread
+fan-out for device-expressible workloads.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from pathlib import Path
+from typing import Any, Callable, Coroutine, List, Optional
+
+from .core.config import Config
+from .core.runtime import Runtime, check_determinism
+
+
+class TestFailure(AssertionError):
+    """A seed in the sweep failed; carries the repro seed."""
+
+    def __init__(self, seed: int, cause: BaseException) -> None:
+        super().__init__(
+            f"seed={seed} failed: {type(cause).__name__}: {cause}\n"
+            f"    reproduce with: MADSIM_TEST_SEED={seed}"
+        )
+        self.seed = seed
+        self.__cause__ = cause
+
+
+class Builder:
+    """Seed-sweep runner (reference runtime/builder.rs:7-149)."""
+
+    def __init__(
+        self,
+        seed: Optional[int] = None,
+        count: int = 1,
+        jobs: int = 1,
+        config: Optional[Config] = None,
+        time_limit: Optional[float] = None,
+        check: bool = False,
+    ) -> None:
+        if seed is None:
+            seed = int.from_bytes(os.urandom(8), "little")
+        self.seed = seed
+        self.count = count
+        self.jobs = jobs
+        self.config = config
+        self.time_limit = time_limit
+        self.check = check
+
+    @staticmethod
+    def from_env() -> "Builder":
+        env = os.environ
+        seed = int(env["MADSIM_TEST_SEED"]) if "MADSIM_TEST_SEED" in env else None
+        config = None
+        if "MADSIM_TEST_CONFIG" in env:
+            config = Config.parse(Path(env["MADSIM_TEST_CONFIG"]).read_text())
+        return Builder(
+            seed=seed,
+            count=int(env.get("MADSIM_TEST_NUM", "1")),
+            jobs=int(env.get("MADSIM_TEST_JOBS", "1")),
+            config=config,
+            time_limit=(
+                float(env["MADSIM_TEST_TIME_LIMIT"])
+                if "MADSIM_TEST_TIME_LIMIT" in env
+                else None
+            ),
+            check=env.get("MADSIM_TEST_CHECK_DETERMINISM", "") not in ("", "0", "false"),
+        )
+
+    def run_seed(self, seed: int, make_coro: Callable[[], Coroutine]) -> Any:
+        if self.check:
+            return check_determinism(
+                seed, make_coro, config=self.config, time_limit=self.time_limit
+            )
+        rt = Runtime(seed, self.config)
+        if self.time_limit is not None:
+            rt.set_time_limit(self.time_limit)
+        return rt.block_on(make_coro())
+
+    def run(self, make_coro: Callable[[], Coroutine]) -> Any:
+        """Sweep seeds [seed, seed+count); returns the last seed's result.
+
+        With jobs > 1, seeds run on that many OS threads concurrently
+        (deterministic per seed regardless; the GIL serializes CPU work but
+        semantics match the reference's thread-per-seed model).
+        """
+        seeds = list(range(self.seed, self.seed + self.count))
+        if self.jobs <= 1 or len(seeds) <= 1:
+            result = None
+            for seed in seeds:
+                try:
+                    result = self.run_seed(seed, make_coro)
+                except BaseException as e:  # noqa: BLE001 - annotate with repro seed
+                    raise TestFailure(seed, e) from e
+            return result
+
+        failures: List[TestFailure] = []
+        results: dict = {}
+        lock = threading.Lock()
+        it = iter(seeds)
+
+        def worker() -> None:
+            while True:
+                with lock:
+                    seed = next(it, None)
+                    if seed is None or failures:
+                        return
+                try:
+                    result = self.run_seed(seed, make_coro)
+                except BaseException as e:  # noqa: BLE001
+                    with lock:
+                        failures.append(TestFailure(seed, e))
+                    return
+                with lock:
+                    results[seed] = result
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(min(self.jobs, len(seeds)))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if failures:
+            raise failures[0]
+        return results.get(seeds[-1])
+
+
+def madsim_test(fn: Optional[Callable] = None, **builder_kwargs: Any):
+    """Decorator: run an async test through the env-configured seed sweep."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            builder = Builder.from_env()
+            for k, v in builder_kwargs.items():
+                if not hasattr(builder, k):
+                    raise TypeError(f"madsim_test: unknown option {k!r}")
+                setattr(builder, k, v)
+            return builder.run(lambda: fn(*args, **kwargs))
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco

@@ -1,0 +1,427 @@
+"""The batched discrete-event simulation engine: thousands of seeds per step.
+
+This is the TPU-native re-design of the reference's executor + virtual clock +
+network (SURVEY.md §3.1-3.2, §7): instead of one OS thread per seed
+(runtime/builder.rs:118-136), the whole discrete-event loop is a single jitted
+step function over lane-major state tensors:
+
+    clock        [L]        virtual time per lane (int32 microseconds)
+    key          [L]        per-lane hash-chain PRNG word (see prng.py)
+    alive        [L, N]     node liveness (crash/restart chaos)
+    timer        [L, N]     per-node timer deadline
+    node state   [L, N, ...]protocol pytree
+    message pool [L, S]     in-flight messages with deliver times
+
+One step = (1) advance each lane's clock to its next event, (2) deliver the
+earliest due message per (lane, node) through the protocol's `on_message`,
+(3) fire due timers through `on_timer`, (4) run crash/restart chaos,
+(5) roll loss + latency for every emitted message (the `test_link` analog,
+net/network.rs:261-269) and pack survivors into free pool slots, (6) check
+invariants. Everything is vmapped over lanes and vectorized over nodes; a lane
+whose next event is simultaneous across nodes processes them all in one step.
+
+Lanes are embarrassingly parallel, so the lane axis shards cleanly over a
+device mesh (`shard_state`); the node axis can additionally be sharded for
+large clusters, with XLA inserting collectives for the pool<->node gathers.
+
+Determinism: jitted XLA programs are deterministic, and all randomness comes
+from the per-lane threefry keys derived from the seed — one seed => one
+bit-exact trajectory per backend (the per-backend determinism contract of
+SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .spec import INF_US, Outbox, ProtocolSpec, SimConfig
+
+
+class MsgPool(NamedTuple):
+    valid: Any  # bool [L,S]
+    deliver: Any  # i32 [L,S]
+    src: Any  # i32 [L,S]
+    dst: Any  # i32 [L,S]
+    kind: Any  # i32 [L,S]
+    payload: Any  # i32 [L,S,P]
+
+
+class SimState(NamedTuple):
+    clock: Any  # i32 [L]
+    key: Any  # u32 [L] (hash-chain, prng.py)
+    done: Any  # bool [L]
+    violated: Any  # bool [L]
+    violation_at: Any  # i32 [L]
+    deadlocked: Any  # bool [L]
+    steps: Any  # i32 [L]
+    events: Any  # i32 [L]
+    overflow: Any  # i32 [L] (messages dropped: pool full)
+    alive: Any  # bool [L,N]
+    crashed: Any  # i32 [L] (node id currently down, -1 = none)
+    chaos_at: Any  # i32 [L] (next crash/restart event)
+    timer: Any  # i32 [L,N]
+    node: Any  # protocol pytree, leaves [L,N,...]
+    msgs: MsgPool
+
+
+def _tree_where(mask: jnp.ndarray, a: Any, b: Any) -> Any:
+    """Select pytree leaves by a [L,N]-shaped mask, broadcasting trailing dims."""
+
+    def sel(x, y):
+        m = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim))
+        return jnp.where(m, x, y)
+
+    return jax.tree_util.tree_map(sel, a, b)
+
+
+class BatchedSim:
+    """Vectorized multi-lane simulator for one ProtocolSpec."""
+
+    def __init__(self, spec: ProtocolSpec, config: Optional[SimConfig] = None) -> None:
+        self.spec = spec
+        self.config = config or SimConfig()
+        N = spec.n_nodes
+        # scalar-style handlers -> [L,N] batched
+        self._v_init = jax.vmap(jax.vmap(spec.init, in_axes=(0, 0)), in_axes=(0, None))
+        self._v_on_message = jax.vmap(
+            jax.vmap(spec.on_message, in_axes=(0, 0, 0, 0, 0, None, 0)),
+            in_axes=(0, 0, 0, 0, 0, 0, 0),
+        )
+        self._v_on_timer = jax.vmap(
+            jax.vmap(spec.on_timer, in_axes=(0, 0, None, 0)),
+            in_axes=(0, 0, 0, 0),
+        )
+        self._v_on_restart = jax.vmap(
+            jax.vmap(spec.on_restart, in_axes=(0, 0, None, 0)), in_axes=(0, 0, 0, 0)
+        )
+        self._v_check = jax.vmap(spec.check_invariants, in_axes=(0, 0, 0))
+        self.step = jax.jit(self._step)
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, seeds: jnp.ndarray) -> SimState:
+        """Build lane state for a batch of seeds (int array [L])."""
+        spec, cfg = self.spec, self.config
+        seeds = jnp.asarray(seeds, jnp.uint32)
+        L, N, S = seeds.shape[0], spec.n_nodes, cfg.msg_capacity
+
+        key = prng.key_from(seeds)  # u32 [L]
+        node_keys = prng.fold(key[:, None], jnp.arange(N, dtype=jnp.uint32))
+        node_state, timer = self._v_init(node_keys, jnp.arange(N, dtype=jnp.int32))
+
+        if cfg.chaos_enabled:
+            chaos_at = prng.randint(
+                key, 11, cfg.crash_interval_lo_us, cfg.crash_interval_hi_us
+            )
+        else:
+            chaos_at = jnp.full((L,), INF_US, jnp.int32)
+
+        return SimState(
+            clock=jnp.zeros((L,), jnp.int32),
+            key=key,
+            done=jnp.zeros((L,), jnp.bool_),
+            violated=jnp.zeros((L,), jnp.bool_),
+            violation_at=jnp.full((L,), INF_US, jnp.int32),
+            deadlocked=jnp.zeros((L,), jnp.bool_),
+            steps=jnp.zeros((L,), jnp.int32),
+            events=jnp.zeros((L,), jnp.int32),
+            overflow=jnp.zeros((L,), jnp.int32),
+            alive=jnp.ones((L, N), jnp.bool_),
+            crashed=jnp.full((L,), -1, jnp.int32),
+            chaos_at=chaos_at,
+            timer=jnp.asarray(timer, jnp.int32),
+            node=node_state,
+            msgs=MsgPool(
+                valid=jnp.zeros((L, S), jnp.bool_),
+                deliver=jnp.full((L, S), INF_US, jnp.int32),
+                src=jnp.zeros((L, S), jnp.int32),
+                dst=jnp.zeros((L, S), jnp.int32),
+                kind=jnp.zeros((L, S), jnp.int32),
+                payload=jnp.zeros((L, S, spec.payload_width), jnp.int32),
+            ),
+        )
+
+    # ------------------------------------------------------------------ step
+
+    def _step(self, state: SimState) -> SimState:
+        spec, cfg = self.spec, self.config
+        N, S, E, P = spec.n_nodes, cfg.msg_capacity, spec.max_out, spec.payload_width
+        L = state.clock.shape[0]
+        msgs = state.msgs
+
+        # -- 1. advance each lane to its next event ------------------------
+        # (the advance_to_next_event analog, time/mod.rs:45-60, batched)
+        # NOTE on style: this step avoids gather/scatter ops in favor of
+        # one-hot multiply-reduce — XLA lowers small-domain gathers to slow
+        # serial kernels on TPU, while one-hot forms fuse into fast VPU loops
+        # (measured ~20x difference on this step).
+        dst_oh = msgs.dst[:, :, None] == jnp.arange(N)[None, None, :]  # [L,S,N]
+        alive_dst = (dst_oh & state.alive[:, None, :]).any(-1)  # [L,S]
+        live_msg = msgs.valid & alive_dst
+        t_msg = jnp.where(live_msg, msgs.deliver, INF_US).min(axis=1)
+        t_timer = jnp.where(state.alive, state.timer, INF_US).min(axis=1)
+        t_next = jnp.minimum(jnp.minimum(t_msg, t_timer), state.chaos_at)
+
+        deadlocked = (~state.done) & (t_next >= INF_US)
+        active = (~state.done) & (t_next < INF_US)
+        clock = jnp.where(active, jnp.maximum(state.clock, t_next), state.clock)
+
+        # -- 2. advance per-lane keys (cheap hash chain, see prng.py) ------
+        key = prng.fold(state.key, 1)
+        node_key = prng.fold(key[:, None], jnp.arange(N, dtype=jnp.uint32))  # [L,N]
+        mkeys = prng.fold(node_key, 101)
+        tkeys = prng.fold(node_key, 102)
+        rkeys = prng.fold(node_key, 103)
+        ckey = prng.fold(key, 104)  # [L]
+
+        # -- 3. deliver earliest due message per (lane, node) --------------
+        due = live_msg & (msgs.deliver <= clock[:, None])  # [L,S]
+        due_ln = (
+            due[:, None, :]
+            & dst_oh.transpose(0, 2, 1)
+            & state.alive[:, :, None]
+            & active[:, None, None]
+        )
+        t_ln = jnp.where(due_ln, msgs.deliver[:, None, :], INF_US)
+        slot = jnp.argmin(t_ln, axis=2)  # [L,N]
+        slot_oh = due_ln & (jnp.arange(S)[None, None, :] == slot[:, :, None])  # [L,N,S]
+        has_msg = slot_oh.any(-1)
+
+        slot_ohi = slot_oh.astype(jnp.int32)
+        m_src = (msgs.src[:, None, :] * slot_ohi).sum(-1)
+        m_kind = (msgs.kind[:, None, :] * slot_ohi).sum(-1)
+        m_pay = (msgs.payload[:, None, :, :] * slot_ohi[:, :, :, None]).sum(2)
+        node_ids = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (L, N))
+
+        ns_m, out_m, timer_m = self._v_on_message(
+            state.node, node_ids, m_src, m_kind, m_pay, clock, mkeys
+        )
+        node = _tree_where(has_msg, ns_m, state.node)
+        # handlers return a negative timer to mean "keep the current deadline"
+        timer = jnp.where(has_msg & (timer_m >= 0), timer_m, state.timer)
+        consumed = slot_oh.any(1)  # [L,S]
+        valid = msgs.valid & ~consumed
+
+        # -- 4. fire due timers (post-message timer values) ----------------
+        due_t = state.alive & active[:, None] & (timer <= clock[:, None])
+        ns_t, out_t, timer_t = self._v_on_timer(node, node_ids, clock, tkeys)
+        node = _tree_where(due_t, ns_t, node)
+        timer = jnp.where(due_t & (timer_t >= 0), timer_t, jnp.where(due_t, INF_US, timer))
+
+        # -- 5. crash/restart chaos (Handle::kill/restart analog) ----------
+        alive = state.alive
+        crashed, chaos_at = state.crashed, state.chaos_at
+        if cfg.chaos_enabled:
+            chaos_due = active & (state.chaos_at <= clock)
+            is_restart = state.crashed >= 0
+            do_crash = chaos_due & ~is_restart
+            do_restart = chaos_due & is_restart
+
+            victim = prng.randint(ckey, 1, 0, N)
+            crash_mask = do_crash[:, None] & (node_ids == victim[:, None])
+            restart_node = jnp.clip(state.crashed, 0, N - 1)
+            restart_mask = do_restart[:, None] & (node_ids == restart_node[:, None])
+
+            alive = (alive & ~crash_mask) | restart_mask
+            ns_r, timer_r = self._v_on_restart(node, node_ids, clock, rkeys)
+            node = _tree_where(restart_mask, ns_r, node)
+            timer = jnp.where(restart_mask, timer_r, timer)
+
+            restart_delay = prng.randint(
+                ckey, 2, cfg.restart_delay_lo_us, cfg.restart_delay_hi_us
+            )
+            next_crash = prng.randint(
+                ckey, 3, cfg.crash_interval_lo_us, cfg.crash_interval_hi_us
+            )
+            crashed = jnp.where(
+                do_crash, victim, jnp.where(do_restart, -1, state.crashed)
+            )
+            chaos_at = jnp.where(
+                do_crash,
+                clock + restart_delay,
+                jnp.where(do_restart, clock + next_crash, state.chaos_at),
+            )
+            # in-flight messages to a crashed node are lost (reset_node closes
+            # sockets, network.rs:142-147)
+            dst_alive_now = (dst_oh & alive[:, None, :]).any(-1)
+            valid = valid & dst_alive_now
+
+        # -- 6. collect outboxes, roll the network, pack into pool ---------
+        def flat(out: Outbox, emitting, e):  # [L,N,e,...] -> [L, N*e, ...]
+            v = (out.valid & emitting[:, :, None]).reshape(L, N * e)
+            return (
+                v,
+                out.dst.reshape(L, N * e),
+                out.kind.reshape(L, N * e),
+                out.payload.reshape(L, N * e, P),
+                jnp.broadcast_to(node_ids[:, :, None], (L, N, e)).reshape(L, N * e),
+            )
+
+        E_m = self.spec.max_out_msg
+        mv, md, mk, mp, ms_ = flat(out_m, has_msg, E_m)
+        tv, td, tk, tp, ts_ = flat(out_t, due_t, E)
+        C = N * E_m + N * E
+        cand_valid = jnp.concatenate([mv, tv], axis=1)  # [L,C]
+        cand_dst = jnp.clip(jnp.concatenate([md, td], axis=1), 0, N - 1)
+        cand_kind = jnp.concatenate([mk, tk], axis=1)
+        cand_pay = jnp.concatenate([mp, tp], axis=1)
+        cand_src = jnp.concatenate([ms_, ts_], axis=1)
+
+        # network rolls: loss + latency (test_link analog)
+        cidx = jnp.arange(C, dtype=jnp.uint32)[None, :]
+        net_key = prng.fold(key, 105)[:, None]
+        u = prng.uniform(net_key, 1, index=cidx)
+        lat = prng.randint(
+            net_key, 2, cfg.latency_lo_us,
+            max(cfg.latency_hi_us, cfg.latency_lo_us + 1), index=cidx,
+        )
+        cand_dst_oh = cand_dst[:, :, None] == jnp.arange(N)[None, None, :]  # [L,C,N]
+        keep = cand_valid & (u >= cfg.loss_rate)
+        # sends to currently-dead nodes are dropped (clogged-node semantics)
+        keep = keep & (cand_dst_oh & alive[:, None, :]).any(-1)
+        deliver_at = clock[:, None] + lat.astype(jnp.int32)
+
+        # pack survivors into free slots: rank each kept candidate, rank each
+        # free slot, and match rank r -> r-th free slot via one-hot products
+        free = ~valid
+        free_rank = jnp.cumsum(free, axis=1) - 1  # [L,S] rank of each free slot
+        n_free = free.sum(axis=1)
+        rank = jnp.cumsum(keep, axis=1) - 1  # [L,C]
+        placed = keep & (rank < n_free[:, None])
+        # write_oh[l,c,s] = candidate c goes into slot s
+        write_oh = (
+            placed[:, :, None]
+            & free[:, None, :]
+            & (rank[:, :, None] == free_rank[:, None, :])
+        )  # [L,C,S]
+        written = write_oh.any(1)  # [L,S]
+        w_ohi = write_oh.astype(jnp.int32)
+
+        def put(pool_vals, cand_vals):
+            if cand_vals.ndim == 2:  # [L,C] -> [L,S]
+                incoming = (cand_vals[:, :, None] * w_ohi).sum(1)
+            else:  # [L,C,P] -> [L,S,P]
+                incoming = (cand_vals[:, :, None, :] * w_ohi[:, :, :, None]).sum(1)
+            mask = written if pool_vals.ndim == 2 else written[:, :, None]
+            return jnp.where(mask, incoming, pool_vals)
+
+        new_valid = valid | written
+        new_deliver = put(jnp.where(valid, msgs.deliver, INF_US), deliver_at)
+        new_src = put(msgs.src, cand_src)
+        new_dst = put(msgs.dst, cand_dst)
+        new_kind = put(msgs.kind, cand_kind)
+        new_payload = put(msgs.payload, cand_pay)
+        overflow = state.overflow + (keep & ~placed).sum(axis=1)
+
+        # -- 7. invariants + lane lifecycle --------------------------------
+        ok = self._v_check(node, alive, clock)
+        new_violation = active & ~ok & ~state.violated
+        violated = state.violated | new_violation
+        violation_at = jnp.where(new_violation, clock, state.violation_at)
+        reached_horizon = clock >= cfg.horizon_us
+        done = state.done | deadlocked | reached_horizon | violated
+
+        return SimState(
+            clock=clock,
+            key=key,
+            done=done,
+            violated=violated,
+            violation_at=violation_at,
+            deadlocked=state.deadlocked | deadlocked,
+            steps=state.steps + active.astype(jnp.int32),
+            events=state.events
+            + has_msg.sum(axis=1, dtype=jnp.int32)
+            + due_t.sum(axis=1, dtype=jnp.int32),
+            overflow=overflow,
+            alive=alive,
+            crashed=crashed,
+            chaos_at=chaos_at,
+            timer=timer,
+            node=node,
+            msgs=MsgPool(
+                valid=new_valid,
+                deliver=new_deliver,
+                src=new_src,
+                dst=new_dst,
+                kind=new_kind,
+                payload=new_payload,
+            ),
+        )
+
+    # ------------------------------------------------------------------ run
+
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def _run(self, state: SimState, max_steps: int) -> SimState:
+        def cond(carry):
+            s, i = carry
+            return jnp.logical_and(i < max_steps, jnp.any(~s.done))
+
+        def body(carry):
+            s, i = carry
+            return self._step(s), i + 1
+
+        final, _ = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
+        return final
+
+    def run(self, seeds, max_steps: int = 100_000) -> SimState:
+        """Run lanes until every lane is done (or max_steps)."""
+        return self._run(self.init(seeds), max_steps)
+
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def run_steps(self, state: SimState, n_steps: int) -> SimState:
+        """Fixed-step scan (benchmark-friendly: no host syncs)."""
+
+        def body(s, _):
+            return self._step(s), None
+
+        final, _ = jax.lax.scan(body, state, None, length=n_steps)
+        return final
+
+    # ------------------------------------------------------------ sharding
+
+    def shard_state(
+        self, state: SimState, mesh: jax.sharding.Mesh, lane_axis: str = "seeds",
+        node_axis: Optional[str] = None,
+    ) -> SimState:
+        """Shard lane (and optionally node) axes over a device mesh.
+
+        Lanes are independent, so lane-sharding needs no collectives at all —
+        the scaling-book data-parallel recipe. Node-sharding additionally
+        splits per-node state; XLA inserts gathers for pool<->node routing.
+        """
+        P = jax.sharding.PartitionSpec
+
+        def shard(x):
+            if x.ndim == 0:
+                return x
+            axes: list = [lane_axis] + [None] * (x.ndim - 1)
+            if node_axis is not None and x.ndim >= 2:
+                axes[1] = node_axis
+            return jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, P(*axes))
+            )
+
+        return jax.tree_util.tree_map(shard, state)
+
+
+def summarize(state: SimState) -> dict:
+    """Host-side summary of a finished batch (bug reports with repro info)."""
+    import numpy as np
+
+    violated = np.asarray(state.violated)
+    return {
+        "lanes": int(violated.shape[0]),
+        "violations": int(violated.sum()),
+        "violation_lanes": np.nonzero(violated)[0].tolist()[:32],
+        "deadlocked": int(np.asarray(state.deadlocked).sum()),
+        "total_events": int(np.asarray(state.events).sum()),
+        "total_overflow": int(np.asarray(state.overflow).sum()),
+        "mean_steps": float(np.asarray(state.steps).mean()),
+        "mean_virtual_secs": float(np.asarray(state.clock).mean()) / 1e6,
+    }

@@ -1,0 +1,354 @@
+"""Raft as a JAX state machine — the flagship fuzz workload.
+
+The analog of MadRaft's 5-node election + log-replication fuzz
+(BASELINE.json config #3): leader election with randomized timeouts,
+single-entry AppendEntries replication, majority commit, and client writes
+injected at leaders — all as pure scalar-style JAX handlers batched by
+`BatchedSim` over thousands of seed lanes, under message loss, latency
+jitter, and crash/restart chaos.
+
+Checked invariants (per lane, per step):
+  * Election Safety: at most one leader per term.
+  * Log Matching on committed prefixes: any two nodes' committed entries
+    agree in (term, command) at every index.
+
+Durable vs volatile state mirrors Raft's persistence rules: term / voted_for
+/ log survive a crash (`on_restart`), role / votes / commit / leader state
+do not — the same split FsSim.power_fail models on the host runtime.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .spec import Outbox, ProtocolSpec
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+REQUEST_VOTE, VOTE_RESP, APPEND, APPEND_RESP = 0, 1, 2, 3
+PAYLOAD_WIDTH = 6
+
+
+class RaftState(NamedTuple):
+    term: jnp.ndarray  # i32
+    voted_for: jnp.ndarray  # i32, -1 = none       (durable)
+    role: jnp.ndarray  # i32                        (volatile)
+    votes: jnp.ndarray  # i32 bitmask               (volatile)
+    log_term: jnp.ndarray  # i32 [LOG]              (durable)
+    log_cmd: jnp.ndarray  # i32 [LOG]               (durable)
+    log_len: jnp.ndarray  # i32                     (durable)
+    commit: jnp.ndarray  # i32, index of last committed (volatile)
+    next_idx: jnp.ndarray  # i32 [N]                (leader volatile)
+    match_idx: jnp.ndarray  # i32 [N]               (leader volatile)
+    next_cmd: jnp.ndarray  # i32 client-write counter
+
+
+def make_raft_spec(
+    n_nodes: int = 5,
+    log_capacity: int = 24,
+    election_lo_us: int = 150_000,
+    election_hi_us: int = 300_000,
+    heartbeat_us: int = 50_000,
+    client_rate: float = 0.5,
+) -> ProtocolSpec:
+    N, LOG = n_nodes, log_capacity
+    idx = jnp.arange(LOG, dtype=jnp.int32)
+    peers = jnp.arange(N, dtype=jnp.int32)
+
+    def election_deadline(now, key, site):
+        return now + prng.randint(key, site, election_lo_us, election_hi_us)
+
+    def at(log_arr, i):
+        """log_arr[i] via one-hot reduce (TPU-friendly; i may be [k] or scalar),
+        0 when i out of range."""
+        i_arr = jnp.asarray(i)
+        oh = idx == i_arr[..., None]  # [..., LOG]
+        return (log_arr * oh.astype(jnp.int32)).sum(-1)
+
+    def term_at(log_term, i):
+        """log term at index i, 0 when i < 0 (empty-log sentinel)."""
+        return at(log_term, i)
+
+    def no_out():
+        # on_message side: single-slot outbox (max_out_msg = 1)
+        return Outbox(
+            valid=jnp.zeros((1,), jnp.bool_),
+            dst=jnp.zeros((1,), jnp.int32),
+            kind=jnp.zeros((1,), jnp.int32),
+            payload=jnp.zeros((1, PAYLOAD_WIDTH), jnp.int32),
+        )
+
+    def reply(dst, kind, payload):
+        return Outbox(
+            valid=jnp.ones((1,), jnp.bool_),
+            dst=jnp.reshape(dst, (1,)).astype(jnp.int32),
+            kind=jnp.full((1,), kind, jnp.int32),
+            payload=jnp.reshape(payload, (1, PAYLOAD_WIDTH)).astype(jnp.int32),
+        )
+
+    def broadcast(nid, kind, payload):  # payload [N,P]
+        return Outbox(
+            valid=(peers != nid),
+            dst=peers,
+            kind=jnp.full((N,), kind, jnp.int32),
+            payload=payload.astype(jnp.int32),
+        )
+
+    def pack(*fields):
+        return jnp.stack([jnp.asarray(f, jnp.int32) for f in fields])
+
+    # ------------------------------------------------------------------ init
+
+    def init(key, nid):
+        state = RaftState(
+            term=jnp.int32(0),
+            voted_for=jnp.int32(-1),
+            role=jnp.int32(FOLLOWER),
+            votes=jnp.int32(0),
+            log_term=jnp.zeros((LOG,), jnp.int32),
+            log_cmd=jnp.zeros((LOG,), jnp.int32),
+            log_len=jnp.int32(0),
+            commit=jnp.int32(-1),
+            next_idx=jnp.zeros((N,), jnp.int32),
+            match_idx=jnp.full((N,), -1, jnp.int32),
+            next_cmd=jnp.int32(1),
+        )
+        return state, election_deadline(jnp.int32(0), key, 20)
+
+    # ----------------------------------------------------------------- timer
+
+    def on_timer(s: RaftState, nid, now, key):
+        is_leader = s.role == LEADER
+
+        # -- leader: maybe append a client command, then heartbeat/replicate
+        do_append = is_leader & (s.log_len < LOG) & (prng.uniform(key, 26) < client_rate)
+        at_end = idx == s.log_len
+        log_cmd = jnp.where(do_append & at_end, nid * 100_000 + s.next_cmd, s.log_cmd)
+        log_term = jnp.where(do_append & at_end, s.term, s.log_term)
+        log_len = s.log_len + do_append.astype(jnp.int32)
+
+        prev_idx = s.next_idx - 1  # [N]
+        prev_term = at(log_term, prev_idx)
+        has_entry = s.next_idx < log_len
+        e_term = jnp.where(has_entry, at(log_term, s.next_idx), 0)
+        e_cmd = jnp.where(has_entry, at(log_cmd, s.next_idx), 0)
+        ae_payload = jnp.stack(
+            [
+                jnp.full((N,), s.term, jnp.int32),
+                prev_idx,
+                prev_term,
+                e_term,
+                e_cmd,
+                jnp.full((N,), s.commit, jnp.int32),
+            ],
+            axis=1,
+        )
+        leader_out = broadcast(nid, APPEND, ae_payload)
+        leader_state = s._replace(
+            log_term=log_term, log_cmd=log_cmd, log_len=log_len,
+            next_cmd=s.next_cmd + do_append.astype(jnp.int32),
+        )
+
+        # -- follower/candidate: election timeout => start election
+        new_term = s.term + 1
+        last_idx = s.log_len - 1
+        rv_payload = jnp.broadcast_to(
+            pack(new_term, last_idx, term_at(s.log_term, last_idx), 0, 0, 0),
+            (N, PAYLOAD_WIDTH),
+        )
+        cand_out = broadcast(nid, REQUEST_VOTE, rv_payload)
+        cand_state = s._replace(
+            term=new_term,
+            voted_for=nid,
+            role=jnp.int32(CANDIDATE),
+            votes=(jnp.int32(1) << nid),
+        )
+
+        state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(is_leader, a, b), leader_state, cand_state
+        )
+        out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(is_leader, a, b), leader_out, cand_out
+        )
+        timer = jnp.where(is_leader, now + heartbeat_us, election_deadline(now, key, 22))
+        return state, out, timer
+
+    # --------------------------------------------------------------- message
+
+    def h_request_vote(s: RaftState, nid, src, f, now, key):
+        c_term, c_last_idx, c_last_term = f[0], f[1], f[2]
+        # newer term: step down
+        newer = c_term > s.term
+        term = jnp.where(newer, c_term, s.term)
+        role = jnp.where(newer, FOLLOWER, s.role)
+        voted_for = jnp.where(newer, -1, s.voted_for)
+
+        my_last_idx = s.log_len - 1
+        my_last_term = term_at(s.log_term, my_last_idx)
+        log_ok = (c_last_term > my_last_term) | (
+            (c_last_term == my_last_term) & (c_last_idx >= my_last_idx)
+        )
+        grant = (c_term == term) & ((voted_for == -1) | (voted_for == src)) & log_ok
+        voted_for = jnp.where(grant, src, voted_for)
+        state = s._replace(term=term, role=role, voted_for=voted_for)
+        out = reply(src, VOTE_RESP, pack(term, grant, 0, 0, 0, 0))
+        # granting a vote resets the election timer (standard Raft)
+        timer = jnp.where(grant, election_deadline(now, key, 23), jnp.int32(-1))
+        return state, out, timer  # timer -1 = keep current (resolved below)
+
+    def h_vote_resp(s: RaftState, nid, src, f, now, key):
+        r_term, granted = f[0], f[1]
+        newer = r_term > s.term
+        term = jnp.where(newer, r_term, s.term)
+        role = jnp.where(newer, FOLLOWER, s.role)
+        voted_for = jnp.where(newer, -1, s.voted_for)
+
+        votes = jnp.where(
+            (role == CANDIDATE) & (r_term == term) & (granted > 0),
+            s.votes | (jnp.int32(1) << src),
+            s.votes,
+        )
+        won = (role == CANDIDATE) & (
+            jax.lax.population_count(votes.astype(jnp.uint32)).astype(jnp.int32)
+            > N // 2
+        )
+        role = jnp.where(won, LEADER, role)
+        next_idx = jnp.where(won, jnp.full((N,), 1, jnp.int32) * s.log_len, s.next_idx)
+        match_idx = jnp.where(won, jnp.full((N,), -1, jnp.int32), s.match_idx)
+        match_idx = jnp.where(won & (peers == nid), s.log_len - 1, match_idx)
+        state = s._replace(
+            term=term, role=role, voted_for=voted_for, votes=votes,
+            next_idx=next_idx, match_idx=match_idx,
+        )
+        # on win, fire the heartbeat timer immediately
+        timer = jnp.where(won, now, jnp.int32(-1))
+        return state, no_out(), timer
+
+    def h_append(s: RaftState, nid, src, f, now, key):
+        l_term, prev_idx, prev_term, e_term, e_cmd, l_commit = (
+            f[0], f[1], f[2], f[3], f[4], f[5],
+        )
+        stale = l_term < s.term
+        # valid leader contact: adopt term, become follower
+        term = jnp.where(stale, s.term, l_term)
+        role = jnp.where(stale, s.role, FOLLOWER)
+        voted_for = jnp.where(l_term > s.term, -1, s.voted_for)
+
+        prev_ok = (prev_idx < 0) | (
+            (prev_idx < s.log_len) & (term_at(s.log_term, prev_idx) == prev_term)
+        )
+        ok = (~stale) & prev_ok
+        has_entry = e_term > 0
+        write_at = prev_idx + 1
+        do_write = ok & has_entry & (write_at < LOG)
+        at_w = idx == write_at
+        # conflict: entry at write_at with different term => truncate + replace
+        existing_term = term_at(s.log_term, write_at)
+        same = (write_at < s.log_len) & (existing_term == e_term)
+        log_term_new = jnp.where(do_write & at_w, e_term, s.log_term)
+        log_cmd_new = jnp.where(do_write & at_w, e_cmd, s.log_cmd)
+        log_len_new = jnp.where(
+            do_write, jnp.where(same, s.log_len, write_at + 1), s.log_len
+        )
+        match = jnp.where(ok, jnp.where(has_entry & (write_at < LOG), write_at, prev_idx), -1)
+        commit = jnp.where(
+            ok, jnp.maximum(s.commit, jnp.minimum(l_commit, match)), s.commit
+        )
+        state = s._replace(
+            term=term, role=role, voted_for=voted_for,
+            log_term=log_term_new, log_cmd=log_cmd_new, log_len=log_len_new,
+            commit=commit,
+        )
+        out = reply(src, APPEND_RESP, pack(term, ok, match, 0, 0, 0))
+        # any valid AppendEntries resets the election timer
+        timer = jnp.where(~stale, election_deadline(now, key, 24), jnp.int32(-1))
+        return state, out, timer
+
+    def h_append_resp(s: RaftState, nid, src, f, now, key):
+        r_term, success, match = f[0], f[1], f[2]
+        newer = r_term > s.term
+        term = jnp.where(newer, r_term, s.term)
+        role = jnp.where(newer, FOLLOWER, s.role)
+        voted_for = jnp.where(newer, -1, s.voted_for)
+
+        is_leader = (role == LEADER) & (r_term == term)
+        upd = is_leader & (success > 0)
+        match_idx = jnp.where(
+            upd & (peers == src), jnp.maximum(s.match_idx, match), s.match_idx
+        )
+        next_idx = jnp.where(
+            upd & (peers == src), jnp.maximum(s.next_idx, match + 1), s.next_idx
+        )
+        # backoff on rejection
+        back = is_leader & (success == 0)
+        next_idx = jnp.where(
+            back & (peers == src), jnp.maximum(s.next_idx - 1, 0), next_idx
+        )
+        # advance commit: highest index replicated on a majority, current term
+        my_match = jnp.where(peers == nid, s.log_len - 1, match_idx)
+        sorted_match = jnp.sort(my_match)
+        majority_idx = sorted_match[N - (N // 2 + 1)]
+        can_commit = (majority_idx > s.commit) & (
+            term_at(s.log_term, majority_idx) == term
+        )
+        commit = jnp.where(is_leader & can_commit, majority_idx, s.commit)
+        state = s._replace(
+            term=term, role=role, voted_for=voted_for,
+            next_idx=next_idx, match_idx=match_idx, commit=commit,
+        )
+        return state, no_out(), jnp.int32(-1)
+
+    def on_message(s: RaftState, nid, src, kind, payload, now, key):
+        state, out, timer = jax.lax.switch(
+            jnp.clip(kind, 0, 3),
+            [h_request_vote, h_vote_resp, h_append, h_append_resp],
+            s, nid, src, payload, now, key,
+        )
+        return state, out, timer
+
+    # --------------------------------------------------------------- restart
+
+    def on_restart(s: RaftState, nid, now, key):
+        state = s._replace(
+            role=jnp.int32(FOLLOWER),
+            votes=jnp.int32(0),
+            commit=jnp.int32(-1),
+            next_idx=jnp.zeros((N,), jnp.int32),
+            match_idx=jnp.full((N,), -1, jnp.int32),
+        )
+        return state, election_deadline(now, key, 25)
+
+    # ------------------------------------------------------------ invariants
+
+    def check_invariants(ns: RaftState, alive, now):
+        # ns leaves are [N,...] for one lane
+        is_leader = ns.role == LEADER  # [N]
+        same_term = ns.term[:, None] == ns.term[None, :]  # [N,N]
+        both_lead = is_leader[:, None] & is_leader[None, :]
+        off_diag = ~jnp.eye(N, dtype=jnp.bool_)
+        election_safety = ~(same_term & both_lead & off_diag).any()
+
+        # committed-prefix agreement
+        committed = idx[None, :] <= ns.commit[:, None]  # [N,LOG]
+        both = committed[:, None, :] & committed[None, :, :]  # [N,N,LOG]
+        term_eq = ns.log_term[:, None, :] == ns.log_term[None, :, :]
+        cmd_eq = ns.log_cmd[:, None, :] == ns.log_cmd[None, :, :]
+        log_matching = ~(both & ~(term_eq & cmd_eq)).any()
+
+        return election_safety & log_matching
+
+    return ProtocolSpec(
+        name=f"raft{N}",
+        n_nodes=N,
+        payload_width=PAYLOAD_WIDTH,
+        max_out=N,
+        max_out_msg=1,
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+    )

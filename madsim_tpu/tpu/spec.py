@@ -1,0 +1,107 @@
+"""ProtocolSpec: how a distributed protocol plugs into the batched TPU engine.
+
+The host runtime (madsim_tpu.core) runs arbitrary Python coroutines, one seed
+per executor — the analog of the reference's thread-per-seed sweep
+(runtime/builder.rs:118-136). The TPU engine instead runs protocols expressed
+as *functional state machines*: pure JAX handlers over fixed-shape state. That
+trade is what unlocks thousands of concurrent seeds per chip: the entire
+discrete-event loop (timers, network rolls, delivery, chaos) becomes one
+jitted step function vmapped over a [seed] lane axis and vectorized over the
+[node] axis (BASELINE.json north star; SURVEY.md §7 step 2-3).
+
+A protocol author writes handlers in *scalar style* — state fields are scalars
+or small per-node arrays, messages are (kind, payload-vector) — and the engine
+vmaps them over lanes x nodes. No Python control flow on traced values:
+`jnp.where` / `lax.cond` only.
+
+Handler contract (all pure, all JAX-traceable):
+
+    init(key, node_id) -> (node_state, first_timer_us)
+        Per-node initial state. node_id is a traced int32 scalar.
+
+    on_message(node_state, node_id, src, kind, payload, now_us, key)
+        -> (node_state', outbox, next_timer_us)
+        Deliver one message. `outbox` is an Outbox of up to `max_out` sends.
+        Return next_timer_us for the node's timer; return any negative value
+        to keep the current deadline unchanged.
+
+    on_timer(node_state, node_id, now_us, key)
+        -> (node_state', outbox, next_timer_us)
+        The node's timer fired. Returning a negative value disables the timer.
+
+    on_restart(node_state, node_id, now_us, key) -> (node_state, first_timer_us)
+        Crash recovery: reset volatile state, keep durable state (the FsSim
+        analog: what survives `power_fail`).
+
+    check_invariants(all_node_states, alive, now_us) -> ok: bool scalar
+        Safety predicate over one lane's full [node] state (engine vmaps over
+        lanes). False => the lane records a violation (bug found) and freezes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax.numpy as jnp
+
+# sentinel for "no timer" / "no event" (int32 microseconds)
+INF_US = jnp.int32(2**31 - 1)
+
+
+class Outbox(NamedTuple):
+    """Fixed-width send buffer returned by handlers: up to E messages."""
+
+    valid: Any  # bool [E]
+    dst: Any  # int32 [E]
+    kind: Any  # int32 [E]
+    payload: Any  # int32 [E, P]
+
+
+def empty_outbox(max_out: int, payload_width: int) -> Outbox:
+    return Outbox(
+        valid=jnp.zeros((max_out,), jnp.bool_),
+        dst=jnp.zeros((max_out,), jnp.int32),
+        kind=jnp.zeros((max_out,), jnp.int32),
+        payload=jnp.zeros((max_out, payload_width), jnp.int32),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    name: str
+    n_nodes: int
+    payload_width: int
+    max_out: int  # max messages one on_timer invocation can emit (broadcast width)
+    init: Callable
+    on_message: Callable
+    on_timer: Callable
+    on_restart: Callable
+    check_invariants: Callable
+    max_out_msg: int = 1  # max messages one on_message invocation can emit
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Engine knobs, mirroring the host NetSim/chaos defaults.
+
+    Latency defaults mirror reference net/network.rs:78-89 (1-10 ms, 0 loss);
+    crash/restart chaos mirrors the kill + randomized-restart pattern
+    (task/mod.rs:282-298 uses 1-10 s restart delays).
+    """
+
+    msg_capacity: int = 64  # message-pool slots per lane
+    latency_lo_us: int = 1_000
+    latency_hi_us: int = 10_000
+    loss_rate: float = 0.0
+    # crash/restart chaos (0 disables): a random node crashes every
+    # crash_interval, restarts after restart_delay
+    crash_interval_lo_us: int = 0
+    crash_interval_hi_us: int = 0
+    restart_delay_lo_us: int = 1_000_000
+    restart_delay_hi_us: int = 10_000_000
+    horizon_us: int = 30_000_000  # virtual-time budget per lane
+
+    @property
+    def chaos_enabled(self) -> bool:
+        return self.crash_interval_hi_us > 0

@@ -1,0 +1,356 @@
+"""Raft on the host runtime: the single-seed CPU baseline + flagship example.
+
+This is the same protocol as `madsim_tpu.tpu.raft` written the way a *user* of
+the host runtime writes distributed code: async tasks, typed RPC over
+`Endpoint`, virtual-time timers, chaos via `Handle.kill/restart` — the MadRaft
+analog running on this framework's tokio-analog core. `bench.py` measures it
+one-seed-per-run (the reference's thread-per-seed model,
+runtime/builder.rs:118-136) against the TPU batched engine fuzzing thousands
+of lanes per step.
+
+Run one seed: `fuzz_one_seed(seed)` -> dict of stats; raises
+InvariantViolation on a safety bug. `buggy=True` injects the classic
+unsafe-commit mistake (commit on a single ack, no current-term check — what
+Raft §5.4.2 forbids) to validate that the invariant monitors catch real
+protocol bugs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import madsim_tpu as ms
+from madsim_tpu.net import Endpoint, rpc
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+ELECTION_LO, ELECTION_HI = 0.150, 0.300
+HEARTBEAT = 0.050
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+@rpc.rpc_request
+class RequestVote:
+    def __init__(self, term, cand, last_idx, last_term):
+        self.term, self.cand = term, cand
+        self.last_idx, self.last_term = last_idx, last_term
+
+
+@rpc.rpc_request
+class AppendEntries:
+    def __init__(self, term, leader, prev_idx, prev_term, entry, commit):
+        self.term, self.leader = term, leader
+        self.prev_idx, self.prev_term = prev_idx, prev_term
+        self.entry = entry  # None (heartbeat) or (term, cmd)
+        self.commit = commit
+
+
+@dataclass
+class RaftNode:
+    node_id: int
+    n: int
+    addrs: List[str]
+    client_rate: float = 0.5
+    log_capacity: int = 24
+    buggy: bool = False
+
+    term: int = 0
+    voted_for: Optional[int] = None
+    role: int = FOLLOWER
+    votes: int = 0
+    log: List[tuple] = field(default_factory=list)  # (term, cmd)
+    commit: int = -1
+    next_idx: Dict[int, int] = field(default_factory=dict)
+    match_idx: Dict[int, int] = field(default_factory=dict)
+    next_cmd: int = 1
+    last_contact: float = 0.0
+    timeout: float = 0.0
+
+    async def run(self) -> None:
+        self.ep = await Endpoint.bind(self.addrs[self.node_id])
+        rpc.add_rpc_handler(self.ep, RequestVote, self.on_request_vote)
+        rpc.add_rpc_handler(self.ep, AppendEntries, self.on_append)
+        self.reset_election_timer()
+        while True:
+            if self.role == LEADER:
+                await ms.time.sleep(HEARTBEAT)
+                self.maybe_client_write()
+                ms.spawn(self.broadcast_append())
+            else:
+                now = ms.time.current().elapsed()
+                wait = self.timeout - now
+                if wait > 0:
+                    # short ticks: a mid-sleep promotion to leader must start
+                    # heartbeating promptly, not after the residual wait
+                    await ms.time.sleep(min(wait, HEARTBEAT / 2))
+                    continue
+                ms.spawn(self.start_election())
+                self.reset_election_timer()
+
+    # -- timers --
+
+    def reset_election_timer(self) -> None:
+        self.timeout = ms.time.current().elapsed() + ELECTION_LO + ms.rand() * (
+            ELECTION_HI - ELECTION_LO
+        )
+
+    # -- election --
+
+    async def start_election(self) -> None:
+        self.term += 1
+        self.role = CANDIDATE
+        self.voted_for = self.node_id
+        self.votes = 1 << self.node_id
+        term = self.term
+        last_idx = len(self.log) - 1
+        last_term = self.log[last_idx][0] if last_idx >= 0 else 0
+        for peer in range(self.n):
+            if peer != self.node_id:
+                ms.spawn(self.request_vote_from(peer, term, last_idx, last_term))
+
+    async def request_vote_from(self, peer, term, last_idx, last_term) -> None:
+        try:
+            rterm, granted = await rpc.call_timeout(
+                self.ep,
+                self.addrs[peer],
+                RequestVote(term, self.node_id, last_idx, last_term),
+                0.1,
+            )
+        except (TimeoutError, OSError):
+            return
+        if rterm > self.term:
+            self.step_down(rterm)
+            return
+        if self.role != CANDIDATE or self.term != term or not granted:
+            return
+        self.votes |= 1 << peer
+        majority = self.n // 2 + 1
+        if bin(self.votes).count("1") >= majority and self.role == CANDIDATE:
+            self.role = LEADER
+            self.next_idx = {p: len(self.log) for p in range(self.n)}
+            self.match_idx = {p: -1 for p in range(self.n)}
+            self.match_idx[self.node_id] = len(self.log) - 1
+            # assert leadership NOW — waiting for the next run-loop tick can
+            # exceed followers' election timeouts and livelock elections
+            ms.spawn(self.broadcast_append())
+
+    async def on_request_vote(self, req: RequestVote):
+        if req.term > self.term:
+            self.step_down(req.term)
+        my_last_idx = len(self.log) - 1
+        my_last_term = self.log[my_last_idx][0] if my_last_idx >= 0 else 0
+        log_ok = (req.last_term, req.last_idx) >= (my_last_term, my_last_idx)
+        grant = (
+            req.term == self.term
+            and self.voted_for in (None, req.cand)
+            and log_ok
+        )
+        if grant:
+            self.voted_for = req.cand
+            self.reset_election_timer()
+        return (self.term, grant)
+
+    def step_down(self, term: int) -> None:
+        self.term = term
+        self.role = FOLLOWER
+        self.voted_for = None
+        self.votes = 0
+
+    # -- replication --
+
+    def maybe_client_write(self) -> None:
+        if (
+            self.role == LEADER
+            and len(self.log) < self.log_capacity
+            and ms.rand() < self.client_rate
+        ):
+            self.log.append((self.term, self.node_id * 100_000 + self.next_cmd))
+            self.next_cmd += 1
+            self.match_idx[self.node_id] = len(self.log) - 1
+
+    async def broadcast_append(self) -> None:
+        for peer in range(self.n):
+            if peer != self.node_id:
+                ms.spawn(self.append_to(peer))
+
+    async def append_to(self, peer: int) -> None:
+        term = self.term
+        ni = self.next_idx.get(peer, 0)
+        prev_idx = ni - 1
+        prev_term = self.log[prev_idx][0] if prev_idx >= 0 else 0
+        entry = self.log[ni] if ni < len(self.log) else None
+        try:
+            rterm, ok, match = await rpc.call_timeout(
+                self.ep,
+                self.addrs[peer],
+                AppendEntries(term, self.node_id, prev_idx, prev_term, entry, self.commit),
+                0.1,
+            )
+        except (TimeoutError, OSError):
+            return
+        if rterm > self.term:
+            self.step_down(rterm)
+            return
+        if self.role != LEADER or self.term != term:
+            return
+        if ok:
+            self.match_idx[peer] = max(self.match_idx.get(peer, -1), match)
+            self.next_idx[peer] = max(self.next_idx.get(peer, 0), match + 1)
+            self.advance_commit()
+        else:
+            self.next_idx[peer] = max(0, self.next_idx.get(peer, 1) - 1)
+
+    def advance_commit(self) -> None:
+        matches = sorted(self.match_idx.get(p, -1) for p in range(self.n))
+        if self.buggy:
+            # injected bug (for detector validation): commit as soon as ANY
+            # single replica acks, and skip the current-term check — the
+            # classic unsafe-commit mistake Raft §5.4.2 exists to prevent
+            majority_idx = matches[-1]
+            if majority_idx > self.commit and majority_idx < len(self.log):
+                self.commit = majority_idx
+            return
+        majority_idx = matches[self.n - (self.n // 2 + 1)]
+        if majority_idx > self.commit and (
+            majority_idx < len(self.log) and self.log[majority_idx][0] == self.term
+        ):
+            self.commit = majority_idx
+
+    async def on_append(self, req: AppendEntries):
+        if req.term < self.term:
+            return (self.term, False, -1)
+        if req.term > self.term:
+            self.step_down(req.term)
+        self.role = FOLLOWER
+        self.reset_election_timer()
+        prev_ok = req.prev_idx < 0 or (
+            req.prev_idx < len(self.log)
+            and self.log[req.prev_idx][0] == req.prev_term
+        )
+        if not prev_ok:
+            return (self.term, False, -1)
+        match = req.prev_idx
+        if req.entry is not None:
+            w = req.prev_idx + 1
+            if w < len(self.log):
+                if self.log[w][0] != req.entry[0]:
+                    del self.log[w:]
+                    self.log.append(req.entry)
+            elif w == len(self.log):
+                self.log.append(req.entry)
+            match = w if w < self.log_capacity else req.prev_idx
+        self.commit = max(self.commit, min(req.commit, match))
+        return (self.term, True, match)
+
+
+async def _fuzz_body(
+    n_nodes: int,
+    virtual_secs: float,
+    chaos: bool,
+    buggy: bool,
+    client_rate: float,
+) -> dict:
+    handle = ms.Handle.current()
+    from madsim_tpu.net import NetSim
+
+    addrs = [f"10.0.1.{i + 1}:6000" for i in range(n_nodes)]
+    rafts = [
+        RaftNode(i, n_nodes, addrs, buggy=buggy, client_rate=client_rate)
+        for i in range(n_nodes)
+    ]
+    nodes = []
+    for i in range(n_nodes):
+        node = handle.create_node().name(f"raft-{i}").ip(f"10.0.1.{i + 1}").build()
+        node.spawn(rafts[i].run())
+        nodes.append(node)
+
+    first_committed: dict = {}  # index -> (term, cmd) first observed committed
+
+    def check_invariants() -> None:
+        # election safety (a killed node's state is frozen; still applies)
+        leaders = [(r.term, r.node_id) for r in rafts if r.role == LEADER]
+        terms = [t for t, _ in leaders]
+        if len(terms) != len(set(terms)):
+            raise InvariantViolation(f"two leaders in one term: {leaders}")
+        # a committed entry must exist: commit index beyond the log means a
+        # committed entry was truncated away
+        for r in rafts:
+            if r.commit >= len(r.log):
+                raise InvariantViolation(
+                    f"node {r.node_id} committed up to {r.commit} but log has "
+                    f"only {len(r.log)} entries (committed entry truncated)"
+                )
+        # committed-prefix agreement
+        for a in rafts:
+            for b in rafts:
+                for i in range(min(a.commit, b.commit) + 1):
+                    if a.log[i] != b.log[i]:
+                        raise InvariantViolation(
+                            f"log mismatch at {i}: {a.log[i]} vs {b.log[i]}"
+                        )
+        # committed entries are immutable (catches unsafe early commits even
+        # when no two nodes disagree at the same instant)
+        for r in rafts:
+            for i in range(r.commit + 1):
+                seen = first_committed.get(i)
+                if seen is None:
+                    first_committed[i] = r.log[i]
+                elif r.log[i] != seen:
+                    raise InvariantViolation(
+                        f"committed entry rewritten at {i}: {seen} -> {r.log[i]} "
+                        f"(node {r.node_id})"
+                    )
+
+    async def chaos_task() -> None:
+        while True:
+            await ms.time.sleep(0.5 + ms.rand() * 2.5)
+            victim = ms.randrange(n_nodes)
+            handle.kill(nodes[victim].id)
+            await ms.time.sleep(0.3 + ms.rand() * 1.7)
+            # fresh RaftNode object: volatile state lost, durable state kept
+            old = rafts[victim]
+            fresh = RaftNode(
+                victim, n_nodes, addrs, buggy=buggy, client_rate=client_rate
+            )
+            fresh.term, fresh.voted_for = old.term, old.voted_for
+            fresh.log = list(old.log)
+            fresh.next_cmd = old.next_cmd
+            rafts[victim] = fresh
+            handle.restart(nodes[victim].id)
+            nodes[victim].spawn(fresh.run())
+
+    if chaos:
+        ms.spawn(chaos_task())
+
+    t = ms.time.current()
+    end = t.elapsed() + virtual_secs
+    while t.elapsed() < end:
+        await ms.time.sleep(0.01)
+        check_invariants()
+    return {
+        "events": ms.plugin.simulator(NetSim).stat().msg_count,
+        "commits": [r.commit for r in rafts],
+        "max_term": max(r.term for r in rafts),
+    }
+
+
+def fuzz_one_seed(
+    seed: int,
+    n_nodes: int = 5,
+    virtual_secs: float = 10.0,
+    loss_rate: float = 0.1,
+    chaos: bool = True,
+    buggy: bool = False,
+    client_rate: float = 0.5,
+) -> dict:
+    """One complete fuzzed execution (the unit the reference runs per thread)."""
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = loss_rate
+    rt = ms.Runtime(seed=seed, config=cfg)
+    return rt.block_on(
+        _fuzz_body(n_nodes, virtual_secs, chaos, buggy, client_rate)
+    )

@@ -1,9 +1,18 @@
 import os
 
-# TPU tests run on a virtual 8-device CPU mesh; must be set before jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# TPU/sharding tests run on a virtual 8-device CPU mesh. Must be configured
+# before any jax import; the environment may pre-set JAX_PLATFORMS to a real
+# accelerator (e.g. "axon"), so override rather than setdefault.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass

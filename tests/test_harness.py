@@ -1,0 +1,106 @@
+"""Test-harness tests: seed sweep, env config, determinism check, buggify."""
+
+import os
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.testing import Builder, TestFailure, madsim_test
+
+
+def test_builder_sweeps_seeds():
+    seen = []
+
+    async def body():
+        seen.append(ms.Handle.current().seed)
+
+    Builder(seed=100, count=5).run(lambda: body())
+    assert seen == [100, 101, 102, 103, 104]
+
+
+def test_builder_jobs_threads():
+    seen = []
+
+    async def body():
+        seen.append(ms.Handle.current().seed)
+
+    Builder(seed=10, count=8, jobs=4).run(lambda: body())
+    assert sorted(seen) == list(range(10, 18))
+
+
+def test_failure_reports_repro_seed():
+    async def body():
+        if ms.Handle.current().seed == 7:
+            raise RuntimeError("found a bug")
+
+    with pytest.raises(TestFailure, match="MADSIM_TEST_SEED=7"):
+        Builder(seed=5, count=5).run(lambda: body())
+
+
+def test_env_config(monkeypatch, tmp_path):
+    cfg = tmp_path / "cfg.toml"
+    cfg.write_text('[net]\npacket_loss_rate = 0.5\nsend_latency = "2ms..4ms"\n')
+    monkeypatch.setenv("MADSIM_TEST_SEED", "33")
+    monkeypatch.setenv("MADSIM_TEST_NUM", "2")
+    monkeypatch.setenv("MADSIM_TEST_CONFIG", str(cfg))
+    monkeypatch.setenv("MADSIM_TEST_TIME_LIMIT", "60")
+
+    b = Builder.from_env()
+    assert (b.seed, b.count, b.time_limit) == (33, 2, 60.0)
+    assert b.config.net.packet_loss_rate == 0.5
+    assert b.config.net.send_latency_min == 0.002
+
+    seeds = []
+
+    async def body():
+        h = ms.Handle.current()
+        assert h.config.net.packet_loss_rate == 0.5
+        seeds.append(h.seed)
+
+    b.run(lambda: body())
+    assert seeds == [33, 34]
+
+
+def test_check_determinism_mode():
+    async def body():
+        for _ in range(5):
+            await ms.time.sleep(ms.rand())
+
+    Builder(seed=3, count=2, check=True).run(lambda: body())
+
+
+def test_madsim_test_decorator(monkeypatch):
+    monkeypatch.setenv("MADSIM_TEST_SEED", "42")
+    calls = []
+
+    @madsim_test
+    async def my_test():
+        calls.append(ms.Handle.current().seed)
+
+    my_test()
+    assert calls == [42]
+
+
+def test_time_limit_from_builder():
+    async def body():
+        await ms.time.sleep(1e6)
+
+    with pytest.raises(TestFailure):
+        Builder(seed=1, time_limit=10.0).run(lambda: body())
+
+
+def test_buggify_fires_when_enabled():
+    rt = ms.Runtime(seed=9)
+
+    async def main():
+        assert not ms.buggify.is_enabled()
+        assert not ms.buggify.buggify()  # disabled => never fires
+        ms.buggify.enable()
+        fired = sum(1 for _ in range(1000) if ms.buggify.buggify())
+        always = sum(1 for _ in range(100) if ms.buggify.buggify_with_prob(1.0))
+        ms.buggify.disable()
+        return fired, always
+
+    fired, always = rt.block_on(main())
+    assert 150 < fired < 350  # ~25%
+    assert always == 100

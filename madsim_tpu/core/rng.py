@@ -86,7 +86,15 @@ class GlobalRng:
 
     def __init__(self, seed: int) -> None:
         self.seed = seed & _MASK64
-        self._rng = Xoshiro256PP(self.seed)
+        # the C++ core is a bit-exact drop-in for the Python generator
+        from ..native import AVAILABLE as _native_ok, Rng as _NativeRng
+
+        if _native_ok:
+            self._rng = _NativeRng(seed=self.seed)
+            self._native_randrange = self._rng.randrange
+        else:
+            self._rng = Xoshiro256PP(self.seed)
+            self._native_randrange = None
         # determinism-check log: None = off, else list of (value, time_hash)
         self._log: Optional[List[tuple[int, int]]] = None
         self._check: Optional[List[tuple[int, int]]] = None
@@ -114,6 +122,11 @@ class GlobalRng:
     def _time_hash(self) -> int:
         return self.time_hash_fn() if self.time_hash_fn is not None else 0
 
+    @property
+    def plain(self) -> bool:
+        """True when no record/replay log is active (fast paths allowed)."""
+        return self._log is None and self._check is None
+
     # ---- draws ----
 
     def next_u64(self) -> int:
@@ -138,6 +151,8 @@ class GlobalRng:
 
     def random(self) -> float:
         """Uniform float in [0, 1) with 53 bits of precision."""
+        if self.plain:
+            return (self._rng.next_u64() >> 11) * (1.0 / (1 << 53))
         return (self.next_u64() >> 11) * (1.0 / (1 << 53))
 
     def randrange(self, start: int, stop: Optional[int] = None) -> int:
@@ -147,6 +162,14 @@ class GlobalRng:
         n = stop - start
         if n <= 0:
             raise ValueError(f"empty range for randrange({start}, {stop})")
+        if (
+            self._native_randrange is not None
+            and self.plain
+            and 0 <= start
+            and stop < (1 << 63)  # native path parses signed 64-bit
+        ):
+            # native fast path: identical rejection algorithm, no logging
+            return self._native_randrange(start, stop)
         # Lemire-style unbiased bounded draw via rejection sampling.
         threshold = (_MASK64 + 1) - ((_MASK64 + 1) % n)
         while True:

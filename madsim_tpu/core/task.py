@@ -305,7 +305,10 @@ class Executor:
     def __init__(self, rng: GlobalRng, time: TimeHandle) -> None:
         self.rng = rng
         self.time = time
-        self.ready: List[Task] = []
+        from ..native import AVAILABLE as _native_ok, Queue as _CQueue, Rng as _CRng
+
+        self._native = bool(_native_ok) and isinstance(rng._rng, _CRng)
+        self.ready = _CQueue() if self._native else []
         self.nodes: Dict[NodeId, _Node] = {}
         self.next_node_id = 1
         self.next_task_id = 1
@@ -333,10 +336,18 @@ class Executor:
     def schedule(self, task: Task) -> None:
         if not task._in_queue and not task._parked and not task.finished:
             task._in_queue = True
-            self.ready.append(task)
+            if self._native:
+                self.ready.push(task)
+            else:
+                self.ready.append(task)
 
     def _pop_random(self) -> Task:
         """Uniform random pop (reference utils/mpsc.rs:71-84)."""
+        if self._native:
+            if self.rng.plain:
+                # bit-identical draw performed natively
+                return self.ready.pop_random(self.rng._rng)
+            return self.ready.pop_at(self.rng.randrange(len(self.ready)))
         i = self.rng.randrange(len(self.ready))
         last = len(self.ready) - 1
         if i != last:

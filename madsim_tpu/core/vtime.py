@@ -82,6 +82,43 @@ class Timer:
         return self._live
 
 
+class _NativeEntry:
+    __slots__ = ("id",)
+
+    def __init__(self, id: int) -> None:
+        self.id = id
+
+
+class NativeTimer:
+    """Adapter over the C++ timer heap (same interface as `Timer`)."""
+
+    def __init__(self) -> None:
+        from ..native import Timer as _CTimer
+
+        self._t = _CTimer()
+
+    def add(self, deadline_ns: int, callback: Callable[[], None]) -> _NativeEntry:
+        return _NativeEntry(self._t.add(deadline_ns, callback))
+
+    def cancel(self, entry) -> None:
+        self._t.cancel(entry.id)
+
+    def next_deadline(self) -> Optional[int]:
+        return self._t.next_deadline()
+
+    def expire(self, now_ns: int) -> None:
+        # one at a time: callbacks may add/cancel timers and must observe the
+        # same heap state as the pure-Python loop
+        while True:
+            cb = self._t.expire_next(now_ns)
+            if cb is None:
+                return
+            cb()
+
+    def __len__(self) -> int:
+        return len(self._t)
+
+
 class Clock:
     """Virtual clock: elapsed ns since start + randomized wall-clock base."""
 
@@ -104,7 +141,9 @@ class TimeHandle:
         # base wall-clock date around 2022, mirroring time/mod.rs:26-36
         base_secs = 60 * 60 * 24 * 365 * (2022 - 1970) + rng.randrange(60 * 60 * 24 * 365)
         self.clock = Clock(base_secs * NANOS_PER_SEC)
-        self.timer = Timer()
+        from ..native import AVAILABLE as _native_ok
+
+        self.timer = NativeTimer() if _native_ok else Timer()
 
     # ---- reads ----
 

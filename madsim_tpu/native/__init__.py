@@ -1,0 +1,20 @@
+"""Optional C++ fast path for the host executor core.
+
+Build with `python setup_native.py build_ext --inplace`. The pure-Python
+implementations in core/ are the semantics reference; the native Rng, Timer
+and Queue are bit-compatible drop-ins (same xoshiro256++ stream, same
+Lemire bounded draw, same timer ordering) — verified by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+try:
+    from . import _core  # type: ignore[attr-defined]
+
+    Rng = _core.Rng
+    Timer = _core.Timer
+    Queue = _core.Queue
+    AVAILABLE = True
+except ImportError:  # extension not built: pure-Python fallback is used
+    Rng = Timer = Queue = None  # type: ignore[assignment]
+    AVAILABLE = False

@@ -1,0 +1,404 @@
+/* Native fast path for the host executor core.
+ *
+ * The reference's single-seed hot loop (executor block_on / run_all_ready,
+ * madsim task/mod.rs:220-307) is bookkeeping: RNG draws, timer-heap pushes
+ * and pops, and uniformly-random ready-queue pops. This module implements
+ * those three in C++ as CPython objects, bit-compatible with the pure-Python
+ * implementations in core/rng.py and core/vtime.py — the same seed produces
+ * the same execution whether or not the extension is built (verified by
+ * tests/test_native.py parity tests).
+ *
+ * Built via setup_native.py (setuptools); import is optional — the Python
+ * fallback is always available.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+/* ------------------------------- xoshiro256++ --------------------------- */
+
+static inline uint64_t rotl64(uint64_t x, int k) {
+  return (x << k) | (x >> (64 - k));
+}
+
+struct XoshiroState {
+  uint64_t s[4];
+
+  void seed(uint64_t seed_val) {
+    // splitmix64 init, mirroring rng.py splitmix64_next
+    uint64_t state = seed_val;
+    for (int i = 0; i < 4; i++) {
+      state += 0x9E3779B97F4A7C15ULL;
+      uint64_t z = state;
+      z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+      z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+      s[i] = z ^ (z >> 31);
+    }
+  }
+
+  uint64_t next() {
+    uint64_t result = rotl64(s[0] + s[3], 23) + s[0];
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl64(s[3], 45);
+    return result;
+  }
+
+  // Lemire-style rejection bounded draw, mirroring rng.py randrange:
+  // threshold = 2^64 - (2^64 % n); accept v < threshold.
+  uint64_t bounded(uint64_t n) {
+    uint64_t r = ((~0ULL) % n + 1) % n;  // 2^64 mod n
+    if (r == 0) return next() % n;       // n divides 2^64: every draw accepted
+    uint64_t threshold = 0 - r;          // wraps to 2^64 - r
+    for (;;) {
+      uint64_t v = next();
+      if (v < threshold) return v % n;
+    }
+  }
+};
+
+typedef struct {
+  PyObject_HEAD XoshiroState rng;
+} RngObject;
+
+static int Rng_init(RngObject* self, PyObject* args, PyObject* kwds) {
+  unsigned long long seed = 0;
+  static const char* kwlist[] = {"seed", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "K", (char**)kwlist, &seed))
+    return -1;
+  self->rng.seed((uint64_t)seed);
+  return 0;
+}
+
+static PyObject* Rng_next_u64(RngObject* self, PyObject*) {
+  return PyLong_FromUnsignedLongLong(self->rng.next());
+}
+
+static PyObject* Rng_randrange(RngObject* self, PyObject* args) {
+  long long start, stop = LLONG_MIN;
+  if (!PyArg_ParseTuple(args, "L|L", &start, &stop)) return nullptr;
+  if (stop == LLONG_MIN) {
+    stop = start;
+    start = 0;
+  }
+  long long n = stop - start;
+  if (n <= 0) {
+    PyErr_Format(PyExc_ValueError, "empty range for randrange(%lld, %lld)",
+                 start, stop);
+    return nullptr;
+  }
+  return PyLong_FromLongLong(start + (long long)self->rng.bounded((uint64_t)n));
+}
+
+static PyObject* Rng_random(RngObject* self, PyObject*) {
+  return PyFloat_FromDouble((self->rng.next() >> 11) * (1.0 / 9007199254740992.0));
+}
+
+static PyObject* Rng_getstate(RngObject* self, PyObject*) {
+  return Py_BuildValue("(KKKK)", self->rng.s[0], self->rng.s[1], self->rng.s[2],
+                       self->rng.s[3]);
+}
+
+static PyObject* Rng_setstate(RngObject* self, PyObject* args) {
+  unsigned long long a, b, c, d;
+  if (!PyArg_ParseTuple(args, "(KKKK)", &a, &b, &c, &d)) return nullptr;
+  self->rng.s[0] = a;
+  self->rng.s[1] = b;
+  self->rng.s[2] = c;
+  self->rng.s[3] = d;
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef Rng_methods[] = {
+    {"next_u64", (PyCFunction)Rng_next_u64, METH_NOARGS, "next u64"},
+    {"randrange", (PyCFunction)Rng_randrange, METH_VARARGS, "bounded draw"},
+    {"random", (PyCFunction)Rng_random, METH_NOARGS, "uniform [0,1)"},
+    {"getstate", (PyCFunction)Rng_getstate, METH_NOARGS, "state tuple"},
+    {"setstate", (PyCFunction)Rng_setstate, METH_VARARGS, "restore state"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject RngType = {
+    PyVarObject_HEAD_INIT(nullptr, 0) "madsim_tpu.native._core.Rng",
+    sizeof(RngObject),
+};
+
+/* ------------------------------- timer heap ----------------------------- */
+
+struct TimerEntry {
+  int64_t deadline_ns;
+  uint64_t seq;
+  PyObject* callback;  // owned
+  bool cancelled;
+};
+
+struct HeapItem {
+  int64_t deadline_ns;
+  uint64_t seq;
+  size_t slot;  // index into entries vector
+  bool operator>(const HeapItem& o) const {
+    return deadline_ns != o.deadline_ns ? deadline_ns > o.deadline_ns
+                                        : seq > o.seq;
+  }
+};
+
+typedef struct {
+  PyObject_HEAD std::vector<HeapItem>* heap;  // min-heap via std::*_heap
+  std::vector<TimerEntry>* entries;
+  std::vector<size_t>* free_slots;
+  uint64_t next_seq;
+  Py_ssize_t live;
+} TimerObject;
+
+static int Timer_init(TimerObject* self, PyObject*, PyObject*) {
+  self->heap = new std::vector<HeapItem>();
+  self->entries = new std::vector<TimerEntry>();
+  self->free_slots = new std::vector<size_t>();
+  self->next_seq = 0;
+  self->live = 0;
+  return 0;
+}
+
+static void Timer_dealloc(TimerObject* self) {
+  if (self->entries) {
+    for (auto& e : *self->entries) Py_XDECREF(e.callback);
+  }
+  delete self->heap;
+  delete self->entries;
+  delete self->free_slots;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static const auto heap_cmp = [](const HeapItem& a, const HeapItem& b) {
+  return a > b;  // min-heap
+};
+
+static PyObject* Timer_add(TimerObject* self, PyObject* args) {
+  long long deadline;
+  PyObject* callback;
+  if (!PyArg_ParseTuple(args, "LO", &deadline, &callback)) return nullptr;
+  size_t slot;
+  if (!self->free_slots->empty()) {
+    slot = self->free_slots->back();
+    self->free_slots->pop_back();
+  } else {
+    slot = self->entries->size();
+    self->entries->push_back(TimerEntry{});
+  }
+  Py_INCREF(callback);
+  (*self->entries)[slot] =
+      TimerEntry{deadline, self->next_seq, callback, false};
+  self->heap->push_back(HeapItem{deadline, self->next_seq, slot});
+  std::push_heap(self->heap->begin(), self->heap->end(), heap_cmp);
+  uint64_t seq = self->next_seq;
+  self->next_seq++;
+  self->live++;
+  // (slot, seq): seq guards against cancelling a recycled slot
+  return Py_BuildValue("(nK)", (Py_ssize_t)slot, (unsigned long long)seq);
+}
+
+static PyObject* Timer_cancel(TimerObject* self, PyObject* args) {
+  Py_ssize_t slot;
+  unsigned long long seq;
+  if (!PyArg_ParseTuple(args, "(nK)", &slot, &seq)) return nullptr;
+  if (slot >= 0 && (size_t)slot < self->entries->size()) {
+    TimerEntry& e = (*self->entries)[slot];
+    if (e.seq == seq && !e.cancelled && e.callback) {
+      e.cancelled = true;
+      self->live--;
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+static void timer_pop_top(TimerObject* self) {
+  std::pop_heap(self->heap->begin(), self->heap->end(), heap_cmp);
+  self->heap->pop_back();
+}
+
+static PyObject* Timer_next_deadline(TimerObject* self, PyObject*) {
+  while (!self->heap->empty()) {
+    const HeapItem& top = self->heap->front();
+    TimerEntry& e = (*self->entries)[top.slot];
+    if (e.cancelled || e.seq != top.seq) {
+      if (e.seq == top.seq && e.callback) {
+        Py_CLEAR(e.callback);
+        self->free_slots->push_back(top.slot);
+      }
+      timer_pop_top(self);
+      continue;
+    }
+    return PyLong_FromLongLong(top.deadline_ns);
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Timer_expire_next(TimerObject* self, PyObject* args) {
+  /* Pop and return the next due callback, or None. The caller invokes it
+     before asking for the next one, so callbacks that cancel or add timers
+     observe the same heap state as in the pure-Python Timer.expire loop. */
+  long long now;
+  if (!PyArg_ParseTuple(args, "L", &now)) return nullptr;
+  while (!self->heap->empty()) {
+    const HeapItem top = self->heap->front();
+    TimerEntry& e = (*self->entries)[top.slot];
+    bool stale = e.cancelled || e.seq != top.seq;
+    if (!stale && top.deadline_ns > now) break;
+    timer_pop_top(self);
+    PyObject* cb = nullptr;
+    if (!stale) {
+      self->live--;
+      cb = e.callback;
+      Py_INCREF(cb);
+    }
+    if (e.seq == top.seq) {
+      Py_CLEAR(e.callback);
+      self->free_slots->push_back(top.slot);
+    }
+    if (cb) return cb;
+  }
+  Py_RETURN_NONE;
+}
+
+static Py_ssize_t Timer_len(PyObject* self) {
+  return ((TimerObject*)self)->live;
+}
+
+static PyMethodDef Timer_methods[] = {
+    {"add", (PyCFunction)Timer_add, METH_VARARGS, "add(deadline_ns, cb) -> id"},
+    {"cancel", (PyCFunction)Timer_cancel, METH_VARARGS, "cancel(id)"},
+    {"next_deadline", (PyCFunction)Timer_next_deadline, METH_NOARGS,
+     "earliest live deadline or None"},
+    {"expire_next", (PyCFunction)Timer_expire_next, METH_VARARGS,
+     "expire_next(now_ns) -> next due callback or None"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods Timer_as_sequence = {Timer_len};
+
+static PyTypeObject TimerType = {
+    PyVarObject_HEAD_INIT(nullptr, 0) "madsim_tpu.native._core.Timer",
+    sizeof(TimerObject),
+};
+
+/* ---------------------------- ready queue ------------------------------- */
+
+typedef struct {
+  PyObject_HEAD std::vector<PyObject*>* items;  // owned refs
+} QueueObject;
+
+static int Queue_init(QueueObject* self, PyObject*, PyObject*) {
+  self->items = new std::vector<PyObject*>();
+  return 0;
+}
+
+static void Queue_dealloc(QueueObject* self) {
+  if (self->items) {
+    for (PyObject* o : *self->items) Py_XDECREF(o);
+    delete self->items;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* Queue_push(QueueObject* self, PyObject* obj) {
+  Py_INCREF(obj);
+  self->items->push_back(obj);
+  Py_RETURN_NONE;
+}
+
+static PyObject* Queue_pop_random(QueueObject* self, PyObject* args) {
+  /* pop_random(rng: Rng) — uniformly random element via the SAME bounded
+     draw as Python's _pop_random (swap-with-last then pop). */
+  PyObject* rng_obj;
+  if (!PyArg_ParseTuple(args, "O!", &RngType, &rng_obj)) return nullptr;
+  size_t n = self->items->size();
+  if (n == 0) {
+    PyErr_SetString(PyExc_IndexError, "pop from empty queue");
+    return nullptr;
+  }
+  XoshiroState& rng = ((RngObject*)rng_obj)->rng;
+  size_t i = (size_t)rng.bounded((uint64_t)n);
+  std::swap((*self->items)[i], (*self->items)[n - 1]);
+  PyObject* out = self->items->back();
+  self->items->pop_back();
+  return out;  // transfer ownership
+}
+
+static PyObject* Queue_pop_at(QueueObject* self, PyObject* args) {
+  /* pop_at(i): swap-remove — used by the determinism-check path where the
+     index draw must go through the logged Python RNG. */
+  Py_ssize_t i;
+  if (!PyArg_ParseTuple(args, "n", &i)) return nullptr;
+  size_t n = self->items->size();
+  if (i < 0 || (size_t)i >= n) {
+    PyErr_SetString(PyExc_IndexError, "pop_at out of range");
+    return nullptr;
+  }
+  std::swap((*self->items)[i], (*self->items)[n - 1]);
+  PyObject* out = self->items->back();
+  self->items->pop_back();
+  return out;
+}
+
+static Py_ssize_t Queue_len(PyObject* self) {
+  return (Py_ssize_t)((QueueObject*)self)->items->size();
+}
+
+static PyMethodDef Queue_methods[] = {
+    {"push", (PyCFunction)Queue_push, METH_O, "push(obj)"},
+    {"pop_random", (PyCFunction)Queue_pop_random, METH_VARARGS,
+     "pop_random(rng) -> obj"},
+    {"pop_at", (PyCFunction)Queue_pop_at, METH_VARARGS, "pop_at(i) -> obj"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods Queue_as_sequence = {Queue_len};
+
+static PyTypeObject QueueType = {
+    PyVarObject_HEAD_INIT(nullptr, 0) "madsim_tpu.native._core.Queue",
+    sizeof(QueueObject),
+};
+
+/* ------------------------------- module --------------------------------- */
+
+static PyModuleDef core_module = {PyModuleDef_HEAD_INIT, "_core",
+                                  "native executor core", -1, nullptr};
+
+PyMODINIT_FUNC PyInit__core(void) {
+  RngType.tp_new = PyType_GenericNew;
+  RngType.tp_init = (initproc)Rng_init;
+  RngType.tp_methods = Rng_methods;
+  RngType.tp_flags = Py_TPFLAGS_DEFAULT;
+
+  TimerType.tp_new = PyType_GenericNew;
+  TimerType.tp_init = (initproc)Timer_init;
+  TimerType.tp_dealloc = (destructor)Timer_dealloc;
+  TimerType.tp_methods = Timer_methods;
+  TimerType.tp_as_sequence = &Timer_as_sequence;
+  TimerType.tp_flags = Py_TPFLAGS_DEFAULT;
+
+  QueueType.tp_new = PyType_GenericNew;
+  QueueType.tp_init = (initproc)Queue_init;
+  QueueType.tp_dealloc = (destructor)Queue_dealloc;
+  QueueType.tp_methods = Queue_methods;
+  QueueType.tp_as_sequence = &Queue_as_sequence;
+  QueueType.tp_flags = Py_TPFLAGS_DEFAULT;
+
+  if (PyType_Ready(&RngType) < 0 || PyType_Ready(&TimerType) < 0 ||
+      PyType_Ready(&QueueType) < 0)
+    return nullptr;
+
+  PyObject* m = PyModule_Create(&core_module);
+  if (!m) return nullptr;
+  Py_INCREF(&RngType);
+  PyModule_AddObject(m, "Rng", (PyObject*)&RngType);
+  Py_INCREF(&TimerType);
+  PyModule_AddObject(m, "Timer", (PyObject*)&TimerType);
+  Py_INCREF(&QueueType);
+  PyModule_AddObject(m, "Queue", (PyObject*)&QueueType);
+  return m;
+}

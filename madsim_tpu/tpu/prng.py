@@ -64,7 +64,10 @@ def randint(key: jnp.ndarray, site: int, lo, hi, index=0) -> jnp.ndarray:
     (lo == hi) and must never hit mod-by-zero, whose result XLA leaves
     implementation-defined per backend.
     """
-    span = jnp.maximum(jnp.asarray(hi, jnp.int64) - jnp.asarray(lo, jnp.int64), 1).astype(_U32)
+    # int32 span is safe: all simulation quantities are < 2^31
+    span = jnp.maximum(
+        jnp.asarray(hi, jnp.int32) - jnp.asarray(lo, jnp.int32), 1
+    ).astype(_U32)
     return jnp.asarray(lo, jnp.int32) + (bits(key, site, index) % span).astype(
         jnp.int32
     )

@@ -1,0 +1,21 @@
+"""Build the optional native executor core:
+
+    python setup_native.py build_ext --inplace
+
+Produces madsim_tpu/native/_core.*.so; madsim_tpu falls back to the pure
+Python implementations when absent.
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    name="madsim-tpu-native",
+    ext_modules=[
+        Extension(
+            "madsim_tpu.native._core",
+            sources=["madsim_tpu/native/_core.cpp"],
+            extra_compile_args=["-O2", "-std=c++17"],
+            language="c++",
+        )
+    ],
+)

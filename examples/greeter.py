@@ -1,0 +1,74 @@
+"""Greeter: the tonic-example analog — all four RPC shapes.
+
+Reference: tonic-example/src/lib.rs (greeter server with unary,
+server-streaming, client-streaming and bidi RPCs) exercised under chaos in
+tonic-example/tests/test.rs.
+
+Run a simulated cluster:  python examples/greeter.py [seed]
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+import madsim_tpu as ms
+from madsim_tpu.sims import grpc
+
+
+class Greeter(grpc.Service):
+    SERVICE_NAME = "helloworld.Greeter"
+
+    @grpc.unary
+    async def say_hello(self, request):
+        return {"message": f"Hello {request['name']}!"}
+
+    @grpc.server_streaming
+    async def lots_of_replies(self, request):
+        for i in range(5):
+            await ms.time.sleep(0.1)
+            yield {"message": f"{i}: Hello {request['name']}!"}
+
+    @grpc.client_streaming
+    async def lots_of_greetings(self, requests):
+        names = [r["name"] async for r in requests]
+        return {"message": f"Hello {', '.join(names)}!"}
+
+    @grpc.bidi_streaming
+    async def bidi_hello(self, requests):
+        async for r in requests:
+            yield {"message": f"Hello {r['name']}!"}
+
+
+async def serve(addr: str) -> None:
+    await grpc.Server().add_service(Greeter()).serve(addr)
+
+
+def main(seed: int = 1) -> None:
+    rt = ms.Runtime(seed=seed)
+
+    async def root():
+        h = rt.handle
+        server = h.create_node().name("server").ip("10.0.0.1").build()
+        client = h.create_node().name("client").ip("10.0.0.2").build()
+        server.spawn(serve("10.0.0.1:50051"))
+        await ms.time.sleep(0.1)
+
+        async def run_client():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Greeter, channel)
+            print(await stub.say_hello({"name": "madsim"}))
+            async for m in await stub.lots_of_replies({"name": "stream"}):
+                print(m)
+            print(await stub.lots_of_greetings([{"name": n} for n in "abc"]))
+            replies = await stub.bidi_hello([{"name": n} for n in ("x", "y")])
+            print(await replies.collect())
+
+        await client.spawn(run_client())
+
+    rt.block_on(root())
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1)

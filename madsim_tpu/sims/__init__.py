@@ -1,0 +1,6 @@
+"""Ecosystem facades: in-sim servers speaking familiar APIs.
+
+Analogs of the reference's `#[cfg(madsim)]`-switched crates (SURVEY.md §2.2):
+grpc (madsim-tonic), etcd (madsim-etcd-client), kafka (madsim-rdkafka),
+s3 (madsim-aws-sdk-s3). All ride on `madsim_tpu.net.Endpoint`.
+"""

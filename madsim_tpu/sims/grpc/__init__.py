@@ -1,0 +1,24 @@
+"""gRPC facade over the simulated network (madsim-tonic analog, 1053 LoC ref).
+
+All four RPC shapes (unary, server-streaming, client-streaming, bidi),
+status codes, metadata, interceptors, virtual-time deadlines, and full chaos
+integration: killing the server node surfaces UNAVAILABLE at clients,
+mid-stream kills reset streams, restarts re-bind.
+"""
+
+from .client import (  # noqa: F401
+    Channel,
+    Streaming,
+    client_for,
+    connect,
+    connect_lazy,
+)
+from .server import Server, current_metadata  # noqa: F401
+from .service import (  # noqa: F401
+    Service,
+    bidi_streaming,
+    client_streaming,
+    server_streaming,
+    unary,
+)
+from .status import Code, Status  # noqa: F401

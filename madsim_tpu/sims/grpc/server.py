@@ -1,0 +1,153 @@
+"""gRPC server over simulated connections.
+
+Analog of reference madsim-tonic/src/transport/server.rs:196-318: the server
+accepts `connect1` streams, routes the first message by "/Service/method"
+path, spawns one task per request, and speaks the four streaming shapes with
+typed frames (the BoxMessage protocol analog — message matrix documented in
+madsim-tonic/src/client.rs:33-37):
+
+    request:  (path, client_streaming?, payload, metadata)
+    frames:   ("frame", msg) ... ("end", None)          client->server stream
+    response: ("ok", msg) | ("err", Status)             unary response
+              ("frame", msg) ... ("trailer", None)      server->client stream
+
+Unknown service/method responds Status UNIMPLEMENTED (server.rs:246-256).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from ...core import context, task as task_mod
+from ...core.sync import ChannelClosed, Event
+from ...net import Endpoint
+from ...net.netsim import PayloadReceiver, PayloadSender
+from . import service as svc_mod
+from .status import Status
+
+# server-side view of the current request's metadata (single-threaded sim:
+# set around each handler invocation)
+_current_metadata: Dict[str, str] = {}
+
+
+def current_metadata() -> Dict[str, str]:
+    """Metadata of the request currently being handled."""
+    return _current_metadata
+
+
+class _RequestStream:
+    """Async iterator over incoming client-stream frames."""
+
+    def __init__(self, rx: PayloadReceiver) -> None:
+        self._rx = rx
+        self._done = False
+
+    def __aiter__(self) -> "AsyncIterator[Any]":
+        return self
+
+    async def __anext__(self) -> Any:
+        if self._done:
+            raise StopAsyncIteration
+        try:
+            tag, payload = await self._rx.recv()
+        except ChannelClosed:
+            self._done = True
+            raise StopAsyncIteration from None
+        if tag == "end":
+            self._done = True
+            raise StopAsyncIteration
+        return payload
+
+
+class Server:
+    """Builder + router (tonic `Server::builder()` analog)."""
+
+    def __init__(self) -> None:
+        self._services: Dict[str, svc_mod.Service] = {}
+        self._shutdown = Event()
+
+    def add_service(self, service: svc_mod.Service) -> "Server":
+        self._services[service.service_name()] = service
+        return self
+
+    async def serve(self, addr) -> None:
+        """Bind and accept until the node dies or `shutdown()` is called."""
+        ep = await Endpoint.bind(addr)
+        await self._accept_loop(ep)
+
+    def spawn_serve(self, addr) -> "task_mod.JoinHandle":
+        """Convenience: run `serve` as a task on the current node."""
+        return task_mod.spawn(self.serve(addr), name="grpc-server")
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    async def serve_with_shutdown(self, addr, signal) -> None:
+        """Serve until `signal` (an awaitable) completes (tonic analog)."""
+
+        async def waiter() -> None:
+            await signal
+            self._shutdown.set()
+
+        task_mod.spawn(waiter(), name="grpc-shutdown")
+        await self.serve(addr)
+
+    # -- internals --
+
+    async def _accept_loop(self, ep: Endpoint) -> None:
+        while not self._shutdown.is_set():
+            try:
+                tx, rx, peer = await ep.accept1()
+            except ChannelClosed:
+                return
+            task_mod.spawn(self._handle_conn(tx, rx), name="grpc-conn")
+
+    async def _handle_conn(self, tx: PayloadSender, rx: PayloadReceiver) -> None:
+        try:
+            path, client_streaming, payload, metadata = await rx.recv()
+        except ChannelClosed:
+            return
+        try:
+            service_name, method_name = path.strip("/").split("/", 1)
+        except ValueError:
+            self._send_err(tx, Status.unimplemented(f"bad path: {path}"))
+            return
+        service = self._services.get(service_name)
+        handler = getattr(service, method_name, None) if service else None
+        mode = getattr(handler, "_grpc_mode", None)
+        if handler is None or mode is None:
+            self._send_err(
+                tx, Status.unimplemented(f"unknown rpc: {service_name}/{method_name}")
+            )
+            return
+
+        global _current_metadata
+        _current_metadata = metadata or {}
+        try:
+            if mode == svc_mod.UNARY:
+                rsp = await handler(payload)
+                tx.send(("ok", rsp))
+            elif mode == svc_mod.SERVER_STREAMING:
+                async for frame in handler(payload):
+                    tx.send(("frame", frame))
+                tx.send(("trailer", None))
+            elif mode == svc_mod.CLIENT_STREAMING:
+                rsp = await handler(_RequestStream(rx))
+                tx.send(("ok", rsp))
+            elif mode == svc_mod.BIDI_STREAMING:
+                async for frame in handler(_RequestStream(rx)):
+                    tx.send(("frame", frame))
+                tx.send(("trailer", None))
+        except Status as status:
+            self._send_err(tx, status)
+        except ChannelClosed:
+            pass  # client went away mid-stream
+        except Exception as exc:  # noqa: BLE001 - handler bug => INTERNAL status
+            self._send_err(tx, Status.internal(f"{type(exc).__name__}: {exc}"))
+
+    @staticmethod
+    def _send_err(tx: PayloadSender, status: Status) -> None:
+        try:
+            tx.send(("err", status))
+        except ChannelClosed:
+            pass

@@ -1,0 +1,67 @@
+"""Service definition: decorators in place of protoc codegen.
+
+The reference generates sim clients/servers from .proto files
+(madsim-tonic-build dual codegen, src/prost.rs:313-364). Python needs no
+codegen: a `Service` subclass declares its RPC methods with mode decorators,
+and both the server router and the typed client are derived from it by
+reflection. Messages are arbitrary Python objects.
+
+    class Greeter(grpc.Service):
+        SERVICE_NAME = "helloworld.Greeter"
+
+        @grpc.unary
+        async def say_hello(self, request): ...
+
+        @grpc.server_streaming
+        async def lots_of_replies(self, request): yield ...
+
+        @grpc.client_streaming
+        async def lots_of_greetings(self, requests): ...
+
+        @grpc.bidi_streaming
+        async def bidi_hello(self, requests): yield ...
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+UNARY = "unary"
+SERVER_STREAMING = "server_streaming"
+CLIENT_STREAMING = "client_streaming"
+BIDI_STREAMING = "bidi_streaming"
+
+
+def _mark(mode: str) -> Callable:
+    def deco(fn: Callable) -> Callable:
+        fn._grpc_mode = mode
+        return fn
+
+    return deco
+
+
+unary = _mark(UNARY)
+server_streaming = _mark(SERVER_STREAMING)
+client_streaming = _mark(CLIENT_STREAMING)
+bidi_streaming = _mark(BIDI_STREAMING)
+
+
+class Service:
+    """Base class for RPC services; SERVICE_NAME routes requests."""
+
+    SERVICE_NAME: str = ""
+
+    @classmethod
+    def rpc_methods(cls) -> Dict[str, str]:
+        """{method_name: mode} for all decorated methods."""
+        out = {}
+        for name in dir(cls):
+            fn = getattr(cls, name, None)
+            mode = getattr(fn, "_grpc_mode", None)
+            if mode is not None:
+                out[name] = mode
+        return out
+
+    @classmethod
+    def service_name(cls) -> str:
+        return cls.SERVICE_NAME or cls.__name__

@@ -1,0 +1,92 @@
+"""gRPC status codes + Status exception (tonic `Status`/`Code` analog).
+
+The reference reuses real tonic's Status/Code types in simulation
+(madsim-tonic/src/sim.rs:1-5); here Status is a plain exception carrying a
+code, message, and metadata.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Code:
+    OK = 0
+    CANCELLED = 1
+    UNKNOWN = 2
+    INVALID_ARGUMENT = 3
+    DEADLINE_EXCEEDED = 4
+    NOT_FOUND = 5
+    ALREADY_EXISTS = 6
+    PERMISSION_DENIED = 7
+    RESOURCE_EXHAUSTED = 8
+    FAILED_PRECONDITION = 9
+    ABORTED = 10
+    OUT_OF_RANGE = 11
+    UNIMPLEMENTED = 12
+    INTERNAL = 13
+    UNAVAILABLE = 14
+    DATA_LOSS = 15
+    UNAUTHENTICATED = 16
+
+    _NAMES = {}
+
+
+Code._NAMES = {
+    v: k for k, v in vars(Code).items() if isinstance(v, int) and not k.startswith("_")
+}
+
+
+class Status(Exception):
+    """RPC error status; raise from handlers, caught by clients."""
+
+    def __init__(
+        self, code: int, message: str = "", metadata: Optional[Dict[str, str]] = None
+    ) -> None:
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.metadata = metadata or {}
+
+    def code_name(self) -> str:
+        return Code._NAMES.get(self.code, str(self.code))
+
+    def __repr__(self) -> str:
+        return f"Status(code={self.code_name()}, message={self.message!r})"
+
+    # convenience constructors, mirroring tonic's Status::not_found etc.
+    @staticmethod
+    def cancelled(msg: str = "") -> "Status":
+        return Status(Code.CANCELLED, msg)
+
+    @staticmethod
+    def unknown(msg: str = "") -> "Status":
+        return Status(Code.UNKNOWN, msg)
+
+    @staticmethod
+    def invalid_argument(msg: str = "") -> "Status":
+        return Status(Code.INVALID_ARGUMENT, msg)
+
+    @staticmethod
+    def deadline_exceeded(msg: str = "") -> "Status":
+        return Status(Code.DEADLINE_EXCEEDED, msg)
+
+    @staticmethod
+    def not_found(msg: str = "") -> "Status":
+        return Status(Code.NOT_FOUND, msg)
+
+    @staticmethod
+    def permission_denied(msg: str = "") -> "Status":
+        return Status(Code.PERMISSION_DENIED, msg)
+
+    @staticmethod
+    def unimplemented(msg: str = "") -> "Status":
+        return Status(Code.UNIMPLEMENTED, msg)
+
+    @staticmethod
+    def internal(msg: str = "") -> "Status":
+        return Status(Code.INTERNAL, msg)
+
+    @staticmethod
+    def unavailable(msg: str = "") -> "Status":
+        return Status(Code.UNAVAILABLE, msg)

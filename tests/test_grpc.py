@@ -1,0 +1,308 @@
+"""gRPC facade tests — mirrors tonic-example/tests/test.rs:
+all 4 RPC shapes (:22-119), server_crash (:234-278), client_crash (:155-202),
+interceptors + timeouts (:316-400)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.sims import grpc
+
+
+class Greeter(grpc.Service):
+    SERVICE_NAME = "helloworld.Greeter"
+
+    @grpc.unary
+    async def say_hello(self, request):
+        return {"message": f"Hello {request['name']}!"}
+
+    @grpc.unary
+    async def whoami(self, request):
+        md = grpc.current_metadata()
+        return {"user": md.get("user", "<anon>")}
+
+    @grpc.unary
+    async def slow(self, request):
+        await ms.time.sleep(10.0)
+        return {"message": "finally"}
+
+    @grpc.unary
+    async def fail_not_found(self, request):
+        raise grpc.Status.not_found("no such thing")
+
+    @grpc.unary
+    async def crash_handler(self, request):
+        raise RuntimeError("handler bug")
+
+    @grpc.server_streaming
+    async def count(self, request):
+        for i in range(request["n"]):
+            await ms.time.sleep(0.05)
+            yield {"i": i}
+
+    @grpc.client_streaming
+    async def sum_all(self, requests):
+        total = 0
+        async for r in requests:
+            total += r["x"]
+        return {"sum": total}
+
+    @grpc.bidi_streaming
+    async def echo(self, requests):
+        async for r in requests:
+            yield {"echo": r["x"]}
+
+
+def make_cluster(seed=1):
+    rt = ms.Runtime(seed=seed)
+    state = {}
+
+    async def setup():
+        h = rt.handle
+        state["server"] = h.create_node().name("server").ip("10.0.0.1").init(
+            lambda: grpc.Server().add_service(Greeter()).serve("10.0.0.1:50051")
+        ).build()
+        state["client"] = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.time.sleep(0.1)
+
+    return rt, state, setup
+
+
+def test_all_four_rpc_shapes():
+    rt, state, setup = make_cluster()
+
+    async def main():
+        await setup()
+
+        async def run():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Greeter, channel)
+            r1 = await stub.say_hello({"name": "world"})
+            assert r1 == {"message": "Hello world!"}
+            frames = await (await stub.count({"n": 4})).collect()
+            assert frames == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+            r3 = await stub.sum_all([{"x": i} for i in range(5)])
+            assert r3 == {"sum": 10}
+            out = await (await stub.echo([{"x": "a"}, {"x": "b"}])).collect()
+            assert out == [{"echo": "a"}, {"echo": "b"}]
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_unknown_rpc_unimplemented():
+    rt, state, setup = make_cluster()
+
+    class Unknown(grpc.Service):
+        SERVICE_NAME = "nope.Nope"
+
+        @grpc.unary
+        async def nothing(self, request):
+            return None
+
+    async def main():
+        await setup()
+
+        async def run():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Unknown, channel)
+            with pytest.raises(grpc.Status) as e:
+                await stub.nothing({})
+            assert e.value.code == grpc.Code.UNIMPLEMENTED
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_status_propagation_and_internal():
+    rt, state, setup = make_cluster()
+
+    async def main():
+        await setup()
+
+        async def run():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Greeter, channel)
+            with pytest.raises(grpc.Status) as e:
+                await stub.fail_not_found({})
+            assert e.value.code == grpc.Code.NOT_FOUND
+            with pytest.raises(grpc.Status) as e:
+                await stub.crash_handler({})
+            assert e.value.code == grpc.Code.INTERNAL
+            assert "handler bug" in e.value.message
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_timeout_deadline_exceeded():
+    rt, state, setup = make_cluster()
+
+    async def main():
+        await setup()
+
+        async def run():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Greeter, channel)
+            with pytest.raises(grpc.Status) as e:
+                await stub.slow({}, timeout=1.0)
+            assert e.value.code == grpc.Code.DEADLINE_EXCEEDED
+            # channel-level default timeout
+            channel.default_timeout = 0.5
+            with pytest.raises(grpc.Status) as e:
+                await stub.slow({})
+            assert e.value.code == grpc.Code.DEADLINE_EXCEEDED
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_interceptor_metadata():
+    rt, state, setup = make_cluster()
+
+    async def main():
+        await setup()
+
+        async def run():
+            def auth(msg, metadata):
+                metadata["user"] = "alice"
+
+            channel = await grpc.connect("http://10.0.0.1:50051", interceptor=auth)
+            stub = grpc.client_for(Greeter, channel)
+            assert await stub.whoami({}) == {"user": "alice"}
+
+            def reject(msg, metadata):
+                raise grpc.Status.permission_denied("nope")
+
+            channel2 = await grpc.connect("http://10.0.0.1:50051", interceptor=reject)
+            stub2 = grpc.client_for(Greeter, channel2)
+            with pytest.raises(grpc.Status) as e:
+                await stub2.whoami({})
+            assert e.value.code == grpc.Code.PERMISSION_DENIED
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_connect_refused_when_no_server():
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        h = rt.handle
+        h.create_node().name("server").ip("10.0.0.1").build()  # nothing bound
+        client = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def run():
+            with pytest.raises(grpc.Status) as e:
+                await grpc.connect("http://10.0.0.1:50051")
+            assert e.value.code == grpc.Code.UNAVAILABLE
+            return True
+
+        return await client.spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_server_crash_mid_stream_then_restart():
+    # reference tonic-example/tests/test.rs:234-278 (server_crash)
+    rt, state, setup = make_cluster(seed=3)
+
+    async def main():
+        await setup()
+        h = rt.handle
+
+        async def run():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Greeter, channel)
+            stream = await stub.count({"n": 100})
+            got = [await stream.__anext__()]
+            h.kill(state["server"].id)
+            with pytest.raises(grpc.Status) as e:
+                while True:
+                    got.append(await stream.__anext__())
+            assert e.value.code == grpc.Code.UNAVAILABLE
+            assert len(got) >= 1
+
+            # calls while down: unavailable
+            with pytest.raises(grpc.Status) as e2:
+                await stub.say_hello({"name": "x"})
+            assert e2.value.code == grpc.Code.UNAVAILABLE
+
+            # restart re-runs init => server comes back
+            h.restart(state["server"].id)
+            await ms.time.sleep(0.2)
+            r = await stub.say_hello({"name": "back"})
+            assert r == {"message": "Hello back!"}
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_client_crash_mid_stream_server_survives():
+    # reference tonic-example/tests/test.rs:155-202 (client_crash)
+    rt, state, setup = make_cluster(seed=5)
+
+    async def main():
+        await setup()
+        h = rt.handle
+
+        async def doomed_client():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Greeter, channel)
+            stream = await stub.count({"n": 1000})
+            async for _ in stream:
+                pass
+
+        state["client"].spawn(doomed_client())
+        await ms.time.sleep(0.3)
+        h.kill(state["client"].id)
+        await ms.time.sleep(0.5)
+
+        # server is still healthy: a fresh client works
+        probe = h.create_node().name("probe").ip("10.0.0.9").build()
+
+        async def check():
+            channel = await grpc.connect("http://10.0.0.1:50051")
+            stub = grpc.client_for(Greeter, channel)
+            return await stub.say_hello({"name": "probe"})
+
+        assert (await probe.spawn(check())) == {"message": "Hello probe!"}
+        return True
+
+    assert rt.block_on(main())
+
+
+def test_grpc_deterministic():
+    def run(seed):
+        import examples.greeter  # noqa: F401  (import works)
+        rt, state, setup = make_cluster(seed=seed)
+        trace = []
+
+        async def main():
+            await setup()
+
+            async def run_c():
+                channel = await grpc.connect("http://10.0.0.1:50051")
+                stub = grpc.client_for(Greeter, channel)
+                for i in range(5):
+                    await stub.say_hello({"name": str(i)})
+                    trace.append(ms.time.current().now_ns())
+
+            await state["client"].spawn(run_c())
+
+        rt.block_on(main())
+        return trace
+
+    assert run(11) == run(11)
+    assert run(11) != run(12)

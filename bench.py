@@ -16,13 +16,13 @@ import json
 import time
 
 
-def bench_tpu(lanes: int, virtual_secs: float) -> dict:
+def bench_tpu(lanes: int, virtual_secs: float, client_rate: float) -> dict:
     import jax
     import jax.numpy as jnp
 
     from madsim_tpu.tpu import BatchedSim, SimConfig, make_raft_spec, summarize
 
-    spec = make_raft_spec(n_nodes=5)
+    spec = make_raft_spec(n_nodes=5, client_rate=client_rate)
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
         loss_rate=0.10,
@@ -43,7 +43,7 @@ def bench_tpu(lanes: int, virtual_secs: float) -> dict:
     state.clock.block_until_ready()
     wall = time.perf_counter() - t0
 
-    s = summarize(state)
+    s = summarize(state, spec)
     return {
         "wall_s": wall,
         "seeds_per_sec": lanes / wall,
@@ -54,15 +54,15 @@ def bench_tpu(lanes: int, virtual_secs: float) -> dict:
     }
 
 
-def bench_cpu_baseline(n_seeds: int, virtual_secs: float) -> dict:
+def bench_cpu_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
     from madsim_tpu.workloads.raft_host import fuzz_one_seed
 
     # warm one seed (imports, code paths)
-    fuzz_one_seed(999_983, virtual_secs=virtual_secs)
+    fuzz_one_seed(999_983, virtual_secs=virtual_secs, client_rate=client_rate)
     t0 = time.perf_counter()
     events = 0
     for seed in range(n_seeds):
-        r = fuzz_one_seed(seed, virtual_secs=virtual_secs)
+        r = fuzz_one_seed(seed, virtual_secs=virtual_secs, client_rate=client_rate)
         events += r["events"]
     wall = time.perf_counter() - t0
     return {
@@ -74,13 +74,17 @@ def bench_cpu_baseline(n_seeds: int, virtual_secs: float) -> dict:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--lanes", type=int, default=16384)
+    parser.add_argument("--lanes", type=int, default=32768)
     parser.add_argument("--virtual-secs", type=float, default=10.0)
     parser.add_argument("--cpu-seeds", type=int, default=16)
+    # client_rate sized so the TPU spec's fixed-capacity log does NOT
+    # saturate within the horizon (10s x 0.1/heartbeat ~ 20 appends < 24
+    # capacity) — both backends then run the same protocol work end to end
+    parser.add_argument("--client-rate", type=float, default=0.1)
     args = parser.parse_args()
 
-    cpu = bench_cpu_baseline(args.cpu_seeds, args.virtual_secs)
-    tpu = bench_tpu(args.lanes, args.virtual_secs)
+    cpu = bench_cpu_baseline(args.cpu_seeds, args.virtual_secs, args.client_rate)
+    tpu = bench_tpu(args.lanes, args.virtual_secs, args.client_rate)
 
     result = {
         "metric": "raft5_fuzz_seeds_per_sec",
@@ -92,7 +96,10 @@ def main() -> None:
         "tpu_wall_s": round(tpu["wall_s"], 3),
         "tpu_events_per_sec": round(tpu["events_per_sec"], 1),
         "cpu_baseline_seeds_per_sec": round(cpu["seeds_per_sec"], 3),
+        "cpu_baseline_events_per_sec": round(cpu["events_per_sec"], 1),
         "violations": tpu["summary"]["violations"],
+        "overflow": tpu["summary"]["total_overflow"],
+        "log_saturated_lanes": tpu["summary"].get("log_saturated_lanes", 0),
         "backend": tpu["backend"],
     }
     print(json.dumps(result))

@@ -9,7 +9,7 @@ concurrently on TPU via JAX (vmap/pjit over a [seed, node] state tensor).
 Layout:
     core/     deterministic runtime: RNG, virtual time, executor, nodes
     net/      network simulation: chaos, endpoints, RPC, TCP/UDP, DNS, IPVS
-    sims/     ecosystem facades: grpc, etcd, kafka, s3 (in-sim servers)
+    sims/     ecosystem facades with in-sim servers (see sims/__init__.py)
     tpu/      the batched TPU engine: lane states, vmapped step, sharding
     native/   C++ fast path for the host executor core
     fs/signal/testing: filesystem sim, signals, the test harness

@@ -224,6 +224,16 @@ class Runtime:
         finally:
             guard.exit()
 
+    @staticmethod
+    def run_batch(seeds, workload, **kwargs):
+        """Fuzz a whole seed range as one TPU batch (the builder.rs:118-136
+        thread-per-seed fan-out replaced by device lanes); violating seeds
+        re-run on this host runtime. See `madsim_tpu.tpu.batch.run_batch`.
+        """
+        from ..tpu.batch import run_batch as _run_batch
+
+        return _run_batch(seeds, workload, **kwargs)
+
 
 def check_determinism(
     seed: int,

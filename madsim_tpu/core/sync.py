@@ -162,7 +162,9 @@ class Notify:
         while self._waiters:
             if self._waiters.popleft().try_set_result(None):
                 return
-        self._pending += 1
+        # tokio's Notify stores at most ONE permit: repeated notify_one with
+        # no waiters must not grant multiple stored wakeups
+        self._pending = 1
 
     def notify_waiters(self) -> None:
         waiters, self._waiters = self._waiters, deque()

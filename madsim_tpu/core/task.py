@@ -114,6 +114,7 @@ class Task:
         "_in_queue",
         "_parked",
         "_awaiting",
+        "task_locals",
     )
 
     def __init__(
@@ -137,6 +138,8 @@ class Task:
         self._in_queue = False
         self._parked = False
         self._awaiting: Optional[Future] = None
+        # request/task-scoped data (tokio task_local! analog); lazily created
+        self.task_locals: Optional[dict] = None
         node.tasks.append(self)
         node.spawn_counts[location] = node.spawn_counts.get(location, 0) + 1
 

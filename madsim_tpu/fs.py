@@ -3,11 +3,12 @@
 Each node owns an in-memory map of path -> inode. Files support positional
 reads/writes (`read_at` / `write_all_at`), truncation, metadata, and fsync.
 State survives node restarts (it models a disk, not memory); `power_fail`
-models crash-induced loss of unsynced data by truncating every file back to
-its last synced length.
+models crash-induced loss of unsynced data by restoring every file to its
+content as of the last `sync_all` (a snapshot, so unsynced in-place
+overwrites of synced ranges are lost too, not just appended bytes).
 
 The reference leaves `power_fail` as a TODO stub (fs.rs:51-53); here it is
-implemented, tracking the synced length per inode.
+implemented, snapshotting synced content per inode.
 """
 
 from __future__ import annotations
@@ -20,11 +21,11 @@ from .core.task import NodeId
 
 
 class _INode:
-    __slots__ = ("data", "synced_len")
+    __slots__ = ("data", "synced")
 
     def __init__(self) -> None:
         self.data = bytearray()
-        self.synced_len = 0
+        self.synced = b""  # snapshot of content as of the last sync_all
 
 
 class FsSim(Simulator):
@@ -44,9 +45,14 @@ class FsSim(Simulator):
     # -- chaos / inspection API --
 
     def power_fail(self, node_id: NodeId) -> None:
-        """Lose all unsynced data on the node's disk."""
+        """Lose ALL unsynced data on the node's disk.
+
+        Restores each file to its exact content at the last `sync_all` —
+        unsynced in-place overwrites of previously-synced byte ranges are
+        rolled back too, not just appended length.
+        """
         for inode in self._fs.get(node_id, {}).values():
-            del inode.data[inode.synced_len:]
+            inode.data[:] = inode.synced
 
     def get_file_size(self, node_id: NodeId, path: str) -> Optional[int]:
         inode = self._fs.get(node_id, {}).get(str(path))
@@ -134,7 +140,7 @@ class File:
             data.extend(b"\x00" * (size - len(data)))
 
     async def sync_all(self) -> None:
-        self._inode.synced_len = len(self._inode.data)
+        self._inode.synced = bytes(self._inode.data)
 
     async def metadata(self) -> Metadata:
         return Metadata(len(self._inode.data))

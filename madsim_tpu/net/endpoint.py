@@ -12,7 +12,7 @@ from node reset); endpoints are context managers.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import context
 from ..core.futures import Future
@@ -33,12 +33,24 @@ class _Message:
         self.from_addr = from_addr
 
 
+# Max dead one-shot tags remembered per mailbox; beyond this the oldest are
+# evicted (their late responses, if any, park as ordinary messages).
+_DEAD_TAG_CAP = 4096
+
+
 class Mailbox:
-    """Tag-matching mailbox (reference endpoint.rs:329-361)."""
+    """Tag-matching mailbox (reference endpoint.rs:329-361).
+
+    `forget(tag)` prunes state for one-shot tags nobody will ever read again
+    (e.g. the unique response tag of a timed-out rpc call): parked messages
+    and registrations are dropped, and a late-arriving message for the tag is
+    discarded on delivery instead of parking forever.
+    """
 
     def __init__(self) -> None:
         self.registered: List[Tuple[int, Future[_Message]]] = []
         self.msgs: List[_Message] = []
+        self.dead_tags: Dict[int, None] = {}  # insertion-ordered set
 
     def deliver(self, msg: _Message) -> None:
         for i, (tag, fut) in enumerate(self.registered):
@@ -48,6 +60,10 @@ class Mailbox:
         self.registered = [
             (t, f) for t, f in self.registered if not (f.done() or f.abandoned())
         ]
+        if msg.tag in self.dead_tags:
+            # a one-shot tag is sent to at most once: drop and forget
+            del self.dead_tags[msg.tag]
+            return
         self.msgs.append(msg)
 
     def recv(self, tag: int) -> Future[_Message]:
@@ -59,6 +75,13 @@ class Mailbox:
                 return fut
         self.registered.append((tag, fut))
         return fut
+
+    def forget(self, tag: int) -> None:
+        self.msgs = [m for m in self.msgs if m.tag != tag]
+        self.registered = [(t, f) for t, f in self.registered if t != tag]
+        self.dead_tags[tag] = None
+        while len(self.dead_tags) > _DEAD_TAG_CAP:
+            del self.dead_tags[next(iter(self.dead_tags))]
 
 
 class EndpointSocket:
@@ -177,7 +200,11 @@ class Endpoint:
     async def recv(self, tag: int) -> bytes:
         peer = self.peer_addr()
         data, from_addr = await self.recv_from(tag)
-        assert from_addr == peer, "receive a message but not from the connected address"
+        if from_addr != peer:
+            raise OSError(
+                f"received a message from {from_addr}, not from the connected "
+                f"address {peer}"
+            )
         return data
 
     # -- raw payloads (used by ecosystem sims) --
@@ -191,6 +218,10 @@ class Endpoint:
         msg = await self._socket.mailbox.recv(tag)
         await self.net.rand_delay()
         return msg.data, msg.from_addr
+
+    def forget_tag(self, tag: int) -> None:
+        """Drop all mailbox state for a one-shot tag nobody will read again."""
+        self._socket.mailbox.forget(tag)
 
     # -- reliable connections --
 

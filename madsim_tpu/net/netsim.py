@@ -113,6 +113,13 @@ class NetSim(Simulator):
         # drop closing connection halves on kill)
         self._node_channels: Dict[NodeId, List[Channel]] = {}
 
+    @staticmethod
+    def current() -> "NetSim":
+        """The current simulation's NetSim (reference `NetSim::current()`)."""
+        from ..core.plugin import simulator
+
+        return simulator(NetSim)
+
     # -- plugin lifecycle --
 
     def create_node(self, node_id: NodeId) -> None:

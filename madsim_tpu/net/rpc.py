@@ -58,7 +58,12 @@ async def call_with_data(
     rsp_tag = handle.rng.next_u64()
     resolved = await lookup_host(dst)
     await ep.send_to_raw(resolved, _rpc_id(type(req)), (rsp_tag, req, bytes(data)))
-    payload, _from = await ep.recv_from_raw(rsp_tag)
+    try:
+        payload, _from = await ep.recv_from_raw(rsp_tag)
+    finally:
+        # the response tag is single-use: prune mailbox state so a timed-out
+        # or cancelled call doesn't park its late response forever
+        ep.forget_tag(rsp_tag)
     rsp, rsp_data = payload
     return rsp, rsp_data
 

@@ -1,0 +1,34 @@
+"""etcd v3 simulation: in-sim server + client over the simulated network.
+
+Analog of reference madsim-etcd-client (2790 LoC): a revisioned KV store with
+leases, transactions, elections, prefix watches, and TOML dump/load snapshots,
+served over the Endpoint connection API (`connect1`/`accept1`) exactly like
+the reference's SimServer (server.rs:34-103). The client exposes pythonic
+sub-clients (kv/lease/election/watch/maintenance) mirroring
+etcd-client's fluent API (sim.rs:27-77).
+
+    server.spawn(SimServer().serve("10.0.0.1:2379"))
+    client = await Client.connect("10.0.0.1:2379")
+    await client.kv.put("foo", "bar")
+    resp = await client.kv.get("foo")
+"""
+
+from .client import (  # noqa: F401
+    Client,
+    DeleteOptions,
+    GetOptions,
+    PutOptions,
+)
+from .server import SimServer  # noqa: F401
+from .service import (  # noqa: F401
+    Compare,
+    CompareOp,
+    Event,
+    EventType,
+    KeyValue,
+    LeaderKey,
+    ResponseHeader,
+    Txn,
+    TxnOp,
+)
+from .errors import EtcdError  # noqa: F401

@@ -1,0 +1,42 @@
+"""etcd error type (reference madsim-etcd-client/src/error.rs:1-106).
+
+The reference wraps tonic::Status for server-side errors and a string for
+election errors; here one exception type carries a grpc-style code + message
+(we reuse the sims.grpc Code space) so user code can match on either.
+"""
+
+from __future__ import annotations
+
+from ..grpc.status import Code
+
+
+class EtcdError(Exception):
+    """An etcd operation failed."""
+
+    def __init__(self, message: str, code: Code = Code.UNKNOWN) -> None:
+        super().__init__(message)
+        self.message = message
+        self.code = code
+
+    def __reduce__(self):
+        return (type(self), (self.message, self.code))
+
+
+def lease_not_found() -> EtcdError:
+    # reference service.rs:594-599
+    return EtcdError("etcdserver: requested lease not found", Code.NOT_FOUND)
+
+
+def request_too_large() -> EtcdError:
+    # reference service.rs:179-187
+    return EtcdError("etcdserver: request is too large", Code.INVALID_ARGUMENT)
+
+
+def request_timed_out() -> EtcdError:
+    # reference service.rs:166-177
+    return EtcdError("etcdserver: request timed out", Code.UNAVAILABLE)
+
+
+def session_expired() -> EtcdError:
+    # reference service.rs:601-603
+    return EtcdError("session expired", Code.FAILED_PRECONDITION)

@@ -1,0 +1,147 @@
+"""SimServer: the in-sim etcd server loop (reference server.rs:14-101).
+
+Accepts `connect1` streams on the simulated network; each connection carries
+one request (or one long-lived KeepAlive/Observe stream). Requests are plain
+tuples ("op", args...) — the wire enum of server.rs:105-167 — answered with
+either ("ok", response) or ("err", EtcdError).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core import task as task_mod
+from ...core.sync import ChannelClosed
+from ...net import Endpoint
+from .errors import EtcdError
+from .service import EtcdService, Txn
+
+
+class SimServer:
+    """Builder + server (reference server.rs:14-32)."""
+
+    def __init__(self) -> None:
+        self._timeout_rate = 0.0
+        self._load: Optional[str] = None
+
+    @staticmethod
+    def builder() -> "SimServer":
+        return SimServer()
+
+    def timeout_rate(self, rate: float) -> "SimServer":
+        """Rate of injected 'etcdserver: request timed out' errors."""
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError("rate must be within [0, 1]")
+        self._timeout_rate = rate
+        return self
+
+    def load(self, data: str) -> "SimServer":
+        """Start from a TOML dump (restart-with-snapshot, server.rs:27-31)."""
+        self._load = data
+        return self
+
+    async def serve(self, addr) -> None:
+        ep = await Endpoint.bind(addr)
+        service = EtcdService(self._timeout_rate, self._load)
+        task_mod.spawn(service.start_ticker(), name="etcd-ticker")
+        while True:
+            try:
+                tx, rx, _peer = await ep.accept1()
+            except ChannelClosed:
+                return
+            task_mod.spawn(self._serve_conn(service, tx, rx), name="etcd-conn")
+
+    async def _serve_conn(self, service: EtcdService, tx, rx) -> None:
+        try:
+            request = await rx.recv()
+        except ChannelClosed:
+            return
+        op, *args = request
+        try:
+            if op == "put":
+                key, value, lease, prev_kv = args
+                rsp = await service.put(key, value, lease=lease, prev_kv=prev_kv)
+            elif op == "get":
+                key, prefix, revision = args
+                rsp = await service.get(key, prefix=prefix, revision=revision)
+            elif op == "delete":
+                key, prefix = args
+                rsp = await service.delete(key, prefix=prefix)
+            elif op == "txn":
+                (txn,) = args
+                assert isinstance(txn, Txn)
+                rsp = await service.txn(txn)
+            elif op == "lease_grant":
+                ttl, id = args
+                rsp = await service.lease_grant(ttl, id)
+            elif op == "lease_revoke":
+                (id,) = args
+                rsp = await service.lease_revoke(id)
+            elif op == "lease_keep_alive":
+                # long-lived stream: respond to each ping (server.rs:55-59)
+                (id,) = args
+                while True:
+                    rsp = await service.lease_keep_alive(id)
+                    tx.send(("ok", rsp))
+                    await rx.recv()
+            elif op == "lease_time_to_live":
+                id, keys = args
+                rsp = await service.lease_time_to_live(id, keys)
+            elif op == "lease_leases":
+                rsp = await service.lease_leases()
+            elif op == "campaign":
+                name, value, lease = args
+                rsp = await service.campaign(name, value, lease)
+            elif op == "proclaim":
+                leader, value = args
+                rsp = await service.proclaim(leader, value)
+            elif op == "leader":
+                (name,) = args
+                rsp = await service.leader(name)
+            elif op == "observe":
+                # long-lived stream: push leader changes (server.rs:74-91)
+                (name,) = args
+                name = name.encode() if isinstance(name, str) else bytes(name)
+                leader, events = await service.observe(name)
+                try:
+                    while True:
+                        await events.recv()
+                        new_leader = service.inner.leader(name)
+                        if new_leader.kv == leader.kv:
+                            continue
+                        leader = new_leader
+                        tx.send(("ok", new_leader))
+                finally:
+                    events.close()
+            elif op == "watch":
+                # long-lived stream: raw PUT/DELETE events under a prefix
+                # (the EventBus surfaced directly; service.rs:226-244)
+                (prefix, capacity) = args
+                prefix = prefix.encode() if isinstance(prefix, str) else bytes(prefix)
+                events = service.inner.watcher.subscribe(prefix, capacity)
+                try:
+                    while True:
+                        tx.send(("ok", await events.recv()))
+                finally:
+                    events.close()
+            elif op == "resign":
+                (leader,) = args
+                rsp = await service.resign(leader)
+            elif op == "status":
+                rsp = await service.status()
+            elif op == "dump":
+                rsp = await service.dump()
+            else:
+                raise EtcdError(f"unknown request: {op}")
+        except EtcdError as e:
+            try:
+                tx.send(("err", e))
+            except ChannelClosed:
+                pass
+            return
+        except ChannelClosed:
+            return  # client went away mid-stream
+        try:
+            tx.send(("ok", rsp))
+        except ChannelClosed:
+            pass

@@ -1,0 +1,731 @@
+"""The etcd state machine: revisioned KV + leases + txn + election + watch.
+
+Analog of reference madsim-etcd-client/src/service.rs:190-592 (ServiceInner)
+and :12-188 (EtcdService). Differences from the reference are idiomatic, not
+semantic: the KV store is a dict iterated in sorted order (Python has no
+BTreeMap), watches are an EventBus of bounded channels exactly like the
+reference's mpsc fan-out, and the lease clock ticks once per virtual second
+from a background task spawned by the server.
+
+Snapshot format: TOML, like the reference (service.rs:161-164). Keys/values
+are binary-safe via base64. Parsing uses stdlib tomllib; emission uses the
+small writer in this module (stdlib has no TOML writer).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import enum
+import tomllib
+from typing import Dict, List, Optional, Tuple, Union
+
+from ...core import context
+from ...core.sync import Channel, ChannelClosed
+from .errors import (
+    EtcdError,
+    lease_not_found,
+    request_timed_out,
+    request_too_large,
+    session_expired,
+)
+
+Key = bytes
+Value = bytes
+
+
+def _b(x: Union[str, bytes, bytearray]) -> bytes:
+    return x.encode() if isinstance(x, str) else bytes(x)
+
+
+# --------------------------------------------------------------------- types
+
+
+@dataclasses.dataclass
+class ResponseHeader:
+    """reference sim.rs:112-125."""
+
+    revision: int
+
+
+@dataclasses.dataclass
+class KeyValue:
+    """reference kv.rs KeyValue."""
+
+    key: bytes
+    value: bytes
+    lease: int = 0
+    create_revision: int = 0
+    mod_revision: int = 0
+
+
+class EventType(enum.Enum):
+    PUT = 0
+    DELETE = 1
+
+
+@dataclasses.dataclass
+class Event:
+    """reference service.rs:221-225."""
+
+    type: EventType
+    kv: KeyValue
+
+
+@dataclasses.dataclass
+class LeaderKey:
+    """reference election.rs LeaderKey."""
+
+    name: bytes
+    key: bytes
+    rev: int
+    lease: int
+
+
+class CompareOp(enum.Enum):
+    EQUAL = 0
+    GREATER = 1
+    LESS = 2
+    NOT_EQUAL = 3
+
+
+@dataclasses.dataclass
+class Compare:
+    """One txn guard on a key's value (reference service.rs:365-373)."""
+
+    key: bytes
+    op: CompareOp
+    value: bytes
+
+    @staticmethod
+    def value_eq(key, value) -> "Compare":
+        return Compare(_b(key), CompareOp.EQUAL, _b(value))
+
+
+@dataclasses.dataclass
+class TxnOp:
+    """get/put/delete/nested-txn op (reference server.rs TxnOp)."""
+
+    kind: str  # "get" | "put" | "delete" | "txn"
+    key: bytes = b""
+    value: bytes = b""
+    options: Optional[dict] = None
+    txn: Optional["Txn"] = None
+
+    @staticmethod
+    def get(key, **options) -> "TxnOp":
+        return TxnOp("get", key=_b(key), options=options)
+
+    @staticmethod
+    def put(key, value, **options) -> "TxnOp":
+        return TxnOp("put", key=_b(key), value=_b(value), options=options)
+
+    @staticmethod
+    def delete(key, **options) -> "TxnOp":
+        return TxnOp("delete", key=_b(key), options=options)
+
+    @staticmethod
+    def nested(txn: "Txn") -> "TxnOp":
+        return TxnOp("txn", txn=txn)
+
+
+@dataclasses.dataclass
+class Txn:
+    """compare / then / else transaction (reference kv.rs Txn)."""
+
+    compare: List[Compare] = dataclasses.field(default_factory=list)
+    success: List[TxnOp] = dataclasses.field(default_factory=list)
+    failure: List[TxnOp] = dataclasses.field(default_factory=list)
+
+    def when(self, *compares: Compare) -> "Txn":
+        self.compare.extend(compares)
+        return self
+
+    def and_then(self, *ops: TxnOp) -> "Txn":
+        self.success.extend(ops)
+        return self
+
+    def or_else(self, *ops: TxnOp) -> "Txn":
+        self.failure.extend(ops)
+        return self
+
+    def size(self) -> int:
+        return sum(len(c.key) + len(c.value) for c in self.compare) + sum(
+            len(op.key) + len(op.value) + (op.txn.size() if op.txn else 0)
+            for op in self.success + self.failure
+        )
+
+
+# response envelopes (reference kv.rs / lease.rs / election.rs response types)
+
+
+@dataclasses.dataclass
+class PutResponse:
+    header: ResponseHeader
+    prev_kv: Optional[KeyValue] = None
+
+
+@dataclasses.dataclass
+class GetResponse:
+    header: ResponseHeader
+    kvs: List[KeyValue] = dataclasses.field(default_factory=list)
+
+    def count(self) -> int:
+        return len(self.kvs)
+
+
+@dataclasses.dataclass
+class DeleteResponse:
+    header: ResponseHeader
+    deleted: int = 0
+
+
+@dataclasses.dataclass
+class TxnResponse:
+    header: ResponseHeader
+    succeeded: bool = False
+    op_responses: List[object] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class LeaseGrantResponse:
+    header: ResponseHeader
+    id: int = 0
+    ttl: int = 0
+
+
+@dataclasses.dataclass
+class LeaseRevokeResponse:
+    header: ResponseHeader
+
+
+@dataclasses.dataclass
+class LeaseKeepAliveResponse:
+    header: ResponseHeader
+    id: int = 0
+    ttl: int = 0
+
+
+@dataclasses.dataclass
+class LeaseTimeToLiveResponse:
+    header: ResponseHeader
+    id: int = 0
+    ttl: int = 0
+    granted_ttl: int = 0
+    keys: List[bytes] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class LeaseStatus:
+    id: int
+
+
+@dataclasses.dataclass
+class LeaseLeasesResponse:
+    header: ResponseHeader
+    leases: List[LeaseStatus] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class CampaignResponse:
+    header: ResponseHeader
+    leader: Optional[LeaderKey] = None
+
+
+@dataclasses.dataclass
+class ProclaimResponse:
+    header: ResponseHeader
+
+
+@dataclasses.dataclass
+class LeaderResponse:
+    header: ResponseHeader
+    kv: Optional[KeyValue] = None
+
+
+@dataclasses.dataclass
+class ResignResponse:
+    header: ResponseHeader
+
+
+@dataclasses.dataclass
+class StatusResponse:
+    header: ResponseHeader
+
+
+@dataclasses.dataclass
+class _Lease:
+    """reference service.rs:251-266."""
+
+    ttl: int
+    granted_ttl: int
+    keys: List[bytes] = dataclasses.field(default_factory=list)
+
+
+# ------------------------------------------------------------------ EventBus
+
+
+class EventBus:
+    """Prefix-matched watch fan-out (reference service.rs:201-245)."""
+
+    def __init__(self) -> None:
+        self._subs: List[Tuple[bytes, Channel]] = []
+
+    def subscribe(self, prefix: bytes, capacity: int = 4) -> Channel:
+        ch = Channel(capacity=capacity)
+        self._subs.append((prefix, ch))
+        return ch
+
+    def publish(self, event: Event) -> None:
+        live: List[Tuple[bytes, Channel]] = []
+        for prefix, ch in self._subs:
+            if not event.kv.key.startswith(prefix):
+                live.append((prefix, ch))
+                continue
+            try:
+                ok = ch.try_send(event)
+            except ChannelClosed:
+                ok = False
+            if ok:
+                live.append((prefix, ch))
+            else:
+                # receiver gone or full: drop the subscription (ref :237-243)
+                # AND close the channel so a parked receiver errors out
+                # instead of waiting forever (the mpsc-sender-drop analog)
+                ch.close()
+        self._subs = live
+
+
+# -------------------------------------------------------------- ServiceInner
+
+
+class ServiceInner:
+    """The synchronous state machine (reference service.rs:268-592)."""
+
+    def __init__(self) -> None:
+        self.revision: int = 0
+        self.kv: Dict[bytes, KeyValue] = {}
+        self.lease: Dict[int, _Lease] = {}
+        self.watcher = EventBus()
+        self._txn_depth = 0  # >0: inside a txn; ops share ONE revision
+
+    # -- header
+
+    def header(self) -> ResponseHeader:
+        return ResponseHeader(revision=self.revision)
+
+    # -- kv (service.rs:275-361)
+
+    def put(self, key: Key, value: Value, lease: int = 0, prev_kv: bool = False) -> PutResponse:
+        prev = self.kv.get(key)
+        if lease != 0:
+            lease_obj = self.lease.get(lease)
+            if lease_obj is None:
+                raise lease_not_found()
+            if key not in lease_obj.keys:
+                lease_obj.keys.append(key)
+        if prev is not None and prev.lease != 0 and prev.lease != lease:
+            old = self.lease.get(prev.lease)
+            if old is not None and key in old.keys:
+                old.keys.remove(key)
+        if self._txn_depth == 0:
+            self.revision += 1
+        kv = KeyValue(
+            key=key,
+            value=value,
+            lease=lease,
+            create_revision=prev.create_revision if prev else self.revision,
+            mod_revision=self.revision,
+        )
+        self.kv[key] = kv
+        self.watcher.publish(Event(EventType.PUT, kv))
+        return PutResponse(header=self.header(), prev_kv=prev if prev_kv else None)
+
+    def get(self, key: Key, prefix: bool = False, revision: int = 0) -> GetResponse:
+        if revision > 0:
+            raise EtcdError("get with revision is not supported")  # ref todo!() :325
+        if prefix:
+            kvs = [self.kv[k] for k in sorted(self.kv) if k.startswith(key)]
+        else:
+            kvs = [self.kv[key]] if key in self.kv else []
+        return GetResponse(header=self.header(), kvs=list(kvs))
+
+    def delete(self, key: Key, prefix: bool = False) -> DeleteResponse:
+        keys = (
+            [k for k in self.kv if k.startswith(key)] if prefix
+            else ([key] if key in self.kv else [])
+        )
+        deleted = 0
+        for k in keys:
+            kv = self.kv.pop(k)
+            deleted += 1
+            if self._txn_depth == 0:
+                self.revision += 1
+            if kv.lease != 0:
+                lease_obj = self.lease.get(kv.lease)
+                if lease_obj is not None and k in lease_obj.keys:
+                    lease_obj.keys.remove(k)
+            self.watcher.publish(Event(EventType.DELETE, kv))
+        return DeleteResponse(header=self.header(), deleted=deleted)
+
+    def txn(self, txn: Txn) -> TxnResponse:
+        def check(cmp: Compare) -> bool:
+            value = self.kv[cmp.key].value if cmp.key in self.kv else None
+            if cmp.op is CompareOp.EQUAL:
+                return value == cmp.value
+            if cmp.op is CompareOp.GREATER:
+                return value is not None and value > cmp.value
+            if cmp.op is CompareOp.LESS:
+                return value is not None and value < cmp.value
+            return value != cmp.value  # NOT_EQUAL
+
+        succeeded = all(check(c) for c in txn.compare)
+        # The whole txn is atomic: ONE revision bump, every inner write
+        # stamped with it (real etcd semantics). The reference instead
+        # rewinds self.revision after inner ops bumped it
+        # (service.rs:375-390), which leaves duplicate mod_revisions behind
+        # — a reference bug not worth reproducing.
+        self._txn_depth += 1
+        if self._txn_depth == 1:
+            self.revision += 1
+        try:
+            op_responses: List[object] = []
+            for op in txn.success if succeeded else txn.failure:
+                opts = op.options or {}
+                if op.kind == "get":
+                    op_responses.append(self.get(op.key, **opts))
+                elif op.kind == "put":
+                    op_responses.append(self.put(op.key, op.value, **opts))
+                elif op.kind == "delete":
+                    op_responses.append(self.delete(op.key, **opts))
+                elif op.kind == "txn":
+                    op_responses.append(self.txn(op.txn))
+        finally:
+            self._txn_depth -= 1
+        return TxnResponse(
+            header=self.header(), succeeded=succeeded, op_responses=op_responses
+        )
+
+    # -- lease (service.rs:399-486)
+
+    def lease_grant(self, ttl: int, id: int = 0) -> LeaseGrantResponse:
+        if id == 0:
+            rng = context.current_handle().rng
+            while id == 0 or id in self.lease:
+                id = rng.next_u64() >> 1  # non-negative i64
+        if id in self.lease:
+            raise EtcdError("lease ID already exists")
+        self.lease[id] = _Lease(ttl=ttl, granted_ttl=ttl)
+        self.revision += 1
+        return LeaseGrantResponse(header=self.header(), id=id, ttl=ttl)
+
+    def lease_revoke(self, id: int) -> LeaseRevokeResponse:
+        lease_obj = self.lease.pop(id, None)
+        if lease_obj is None:
+            raise lease_not_found()
+        for key in lease_obj.keys:
+            kv = self.kv.pop(key)
+            self.watcher.publish(Event(EventType.DELETE, kv))
+        self.revision += 1
+        return LeaseRevokeResponse(header=self.header())
+
+    def lease_keep_alive(self, id: int) -> LeaseKeepAliveResponse:
+        lease_obj = self.lease.get(id)
+        if lease_obj is None:
+            raise lease_not_found()
+        lease_obj.ttl = lease_obj.granted_ttl
+        self.revision += 1
+        return LeaseKeepAliveResponse(
+            header=self.header(), id=id, ttl=lease_obj.ttl
+        )
+
+    def lease_time_to_live(self, id: int, keys: bool = False) -> LeaseTimeToLiveResponse:
+        lease_obj = self.lease.get(id)
+        if lease_obj is None:
+            raise lease_not_found()
+        return LeaseTimeToLiveResponse(
+            header=self.header(),
+            id=id,
+            ttl=lease_obj.ttl,
+            granted_ttl=lease_obj.granted_ttl,
+            keys=list(lease_obj.keys) if keys else [],
+        )
+
+    def lease_leases(self) -> LeaseLeasesResponse:
+        return LeaseLeasesResponse(
+            header=self.header(),
+            leases=[LeaseStatus(id=i) for i in self.lease],
+        )
+
+    def tick(self) -> None:
+        """Expire leases; called once per virtual second (service.rs:467-486)."""
+        expired = []
+        for id, lease_obj in self.lease.items():
+            lease_obj.ttl -= 1
+            if lease_obj.ttl <= 0:
+                expired.append(id)
+        for id in expired:
+            lease_obj = self.lease.pop(id)
+            for key in lease_obj.keys:
+                kv = self.kv.pop(key)
+                self.watcher.publish(Event(EventType.DELETE, kv))
+        if expired:
+            self.revision += 1
+
+    # -- election (service.rs:488-592)
+
+    def campaign_once(
+        self, name: Key, value: Value, lease: int
+    ) -> Union[CampaignResponse, Tuple[bytes, Channel]]:
+        """One campaign attempt: win, or (my key, event stream to wait on)."""
+        key = name + b"/" + format(lease, "016x").encode()
+        existing = self.kv.get(key)
+        if existing is None or existing.value != value:
+            self.revision += 1
+            kv = KeyValue(
+                key=key,
+                value=value,
+                lease=lease,
+                create_revision=self.revision,
+                mod_revision=self.revision,
+            )
+            lease_obj = self.lease.get(lease)
+            if lease_obj is None:
+                raise lease_not_found()
+            if key not in lease_obj.keys:
+                lease_obj.keys.append(key)
+            self.kv[key] = kv
+            self.watcher.publish(Event(EventType.PUT, kv))
+
+        leader = self.leader(name)
+        if leader.kv is not None and leader.kv.key == key:
+            return CampaignResponse(
+                header=self.header(),
+                leader=LeaderKey(name=name, key=key, rev=self.revision, lease=lease),
+            )
+        return key, self.watcher.subscribe(name)
+
+    def proclaim(self, leader: LeaderKey, value: Value) -> ProclaimResponse:
+        kv = self.kv.get(leader.key)
+        if kv is None:
+            raise session_expired()
+        self.revision += 1
+        # replace, don't mutate: observers hold references to the old object
+        # and detect changes by comparison (server.rs observe loop)
+        kv = dataclasses.replace(kv, value=value, mod_revision=self.revision)
+        self.kv[leader.key] = kv
+        self.watcher.publish(Event(EventType.PUT, kv))
+        return ProclaimResponse(header=self.header())
+
+    def leader(self, name: Key) -> LeaderResponse:
+        # lowest create_revision among keys with prefix name (service.rs:554-562)
+        candidates = [v for k, v in self.kv.items() if k.startswith(name)]
+        kv = min(candidates, key=lambda v: v.create_revision, default=None)
+        return LeaderResponse(header=self.header(), kv=kv)
+
+    def observe(self, name: Key) -> Tuple[LeaderResponse, Channel]:
+        ch = self.watcher.subscribe(name)
+        return self.leader(name), ch
+
+    def resign(self, leader: LeaderKey) -> ResignResponse:
+        kv = self.kv.pop(leader.key, None)
+        if kv is None:
+            raise session_expired()
+        lease_obj = self.lease.get(kv.lease)
+        if lease_obj is not None and leader.key in lease_obj.keys:
+            lease_obj.keys.remove(leader.key)
+        self.watcher.publish(Event(EventType.DELETE, kv))
+        self.revision += 1
+        return ResignResponse(header=self.header())
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(header=self.header())
+
+    # -- snapshot (service.rs:161-164; TOML like the reference)
+
+    def dump(self) -> str:
+        lines = [f"revision = {self.revision}", ""]
+        for k in sorted(self.kv):
+            v = self.kv[k]
+            lines += [
+                "[[kv]]",
+                f'key = "{base64.b64encode(v.key).decode()}"',
+                f'value = "{base64.b64encode(v.value).decode()}"',
+                f"lease = {v.lease}",
+                f"create_revision = {v.create_revision}",
+                f"modify_revision = {v.mod_revision}",
+                "",
+            ]
+        for id in sorted(self.lease):
+            l = self.lease[id]
+            keys = ", ".join(f'"{base64.b64encode(k).decode()}"' for k in l.keys)
+            lines += [
+                "[[lease]]",
+                f"id = {id}",
+                f"ttl = {l.ttl}",
+                f"granted_ttl = {l.granted_ttl}",
+                f"keys = [{keys}]",
+                "",
+            ]
+        return "\n".join(lines)
+
+    @staticmethod
+    def load(data: str) -> "ServiceInner":
+        doc = tomllib.loads(data)
+        inner = ServiceInner()
+        inner.revision = int(doc.get("revision", 0))
+        for e in doc.get("kv", []):
+            key = base64.b64decode(e["key"])
+            inner.kv[key] = KeyValue(
+                key=key,
+                value=base64.b64decode(e["value"]),
+                lease=int(e.get("lease", 0)),
+                create_revision=int(e.get("create_revision", 0)),
+                mod_revision=int(e.get("modify_revision", 0)),
+            )
+        for e in doc.get("lease", []):
+            inner.lease[int(e["id"])] = _Lease(
+                ttl=int(e["ttl"]),
+                granted_ttl=int(e["granted_ttl"]),
+                keys=[base64.b64decode(k) for k in e.get("keys", [])],
+            )
+        return inner
+
+
+# --------------------------------------------------------------- EtcdService
+
+
+class EtcdService:
+    """Async wrapper: injected timeouts + request-size cap + lease ticking.
+
+    Reference service.rs:12-188. `timeout_rate` injects random
+    'etcdserver: request timed out' failures (5-15 s stalls) before the
+    state-machine op — the etcd-level fault injection used by chaos tests.
+    """
+
+    MAX_REQUEST_BYTES = 0x18_0000  # 1.5 MiB (service.rs:37)
+
+    def __init__(self, timeout_rate: float = 0.0, data: Optional[str] = None) -> None:
+        self.timeout_rate = timeout_rate
+        self.inner = ServiceInner.load(data) if data else ServiceInner()
+
+    async def start_ticker(self) -> None:
+        """Lease-expiry clock; run as a task on the server node (service.rs:28-34)."""
+        from ...core.vtime import sleep
+
+        while True:
+            await sleep(1.0)
+            self.inner.tick()
+
+    async def _timeout(self) -> None:
+        handle = context.current_handle()
+        if self.timeout_rate > 0 and handle.rng.random() < self.timeout_rate:
+            from ...core.vtime import sleep
+
+            await sleep(5.0 + handle.rng.random() * 10.0)
+            raise request_timed_out()
+
+    def _assert_size(self, size: int) -> None:
+        if size > self.MAX_REQUEST_BYTES:
+            raise request_too_large()
+
+    # every op: size check -> injected timeout -> synchronous state machine
+
+    async def put(self, key, value, lease: int = 0, prev_kv: bool = False) -> PutResponse:
+        key, value = _b(key), _b(value)
+        self._assert_size(len(key) + len(value))
+        await self._timeout()
+        return self.inner.put(key, value, lease=lease, prev_kv=prev_kv)
+
+    async def get(self, key, prefix: bool = False, revision: int = 0) -> GetResponse:
+        key = _b(key)
+        self._assert_size(len(key))
+        await self._timeout()
+        return self.inner.get(key, prefix=prefix, revision=revision)
+
+    async def delete(self, key, prefix: bool = False) -> DeleteResponse:
+        key = _b(key)
+        self._assert_size(len(key))
+        await self._timeout()
+        return self.inner.delete(key, prefix=prefix)
+
+    async def txn(self, txn: Txn) -> TxnResponse:
+        self._assert_size(txn.size())
+        await self._timeout()
+        return self.inner.txn(txn)
+
+    async def lease_grant(self, ttl: int, id: int = 0) -> LeaseGrantResponse:
+        await self._timeout()
+        return self.inner.lease_grant(ttl, id)
+
+    async def lease_revoke(self, id: int) -> LeaseRevokeResponse:
+        await self._timeout()
+        return self.inner.lease_revoke(id)
+
+    async def lease_keep_alive(self, id: int) -> LeaseKeepAliveResponse:
+        await self._timeout()
+        return self.inner.lease_keep_alive(id)
+
+    async def lease_time_to_live(self, id: int, keys: bool = False) -> LeaseTimeToLiveResponse:
+        await self._timeout()
+        return self.inner.lease_time_to_live(id, keys)
+
+    async def lease_leases(self) -> LeaseLeasesResponse:
+        await self._timeout()
+        return self.inner.lease_leases()
+
+    async def campaign(self, name, value, lease: int) -> CampaignResponse:
+        """Block until leadership is acquired (reference service.rs:100-125)."""
+        name, value = _b(name), _b(value)
+        self._assert_size(len(name) + len(value))
+        await self._timeout()
+        result = self.inner.campaign_once(name, value, lease)
+        if isinstance(result, CampaignResponse):
+            return result
+        key, events = result
+        try:
+            while True:
+                await events.recv()
+                leader = self.inner.leader(name)
+                if leader.kv is None:
+                    raise session_expired()
+                if leader.kv.key == key:
+                    return CampaignResponse(
+                        header=leader.header,
+                        leader=LeaderKey(
+                            name=name, key=key,
+                            rev=leader.kv.mod_revision, lease=leader.kv.lease,
+                        ),
+                    )
+        finally:
+            events.close()
+
+    async def proclaim(self, leader: LeaderKey, value) -> ProclaimResponse:
+        value = _b(value)
+        self._assert_size(len(leader.key) + len(value))
+        await self._timeout()
+        return self.inner.proclaim(leader, value)
+
+    async def leader(self, name) -> LeaderResponse:
+        name = _b(name)
+        self._assert_size(len(name))
+        await self._timeout()
+        return self.inner.leader(name)
+
+    async def observe(self, name) -> Tuple[LeaderResponse, Channel]:
+        name = _b(name)
+        self._assert_size(len(name))
+        await self._timeout()
+        return self.inner.observe(name)
+
+    async def resign(self, leader: LeaderKey) -> ResignResponse:
+        self._assert_size(len(leader.key))
+        await self._timeout()
+        return self.inner.resign(leader)
+
+    async def status(self) -> StatusResponse:
+        await self._timeout()
+        return self.inner.status()
+
+    async def dump(self) -> str:
+        return self.inner.dump()

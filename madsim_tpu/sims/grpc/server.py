@@ -25,14 +25,19 @@ from ...net.netsim import PayloadReceiver, PayloadSender
 from . import service as svc_mod
 from .status import Status
 
-# server-side view of the current request's metadata (single-threaded sim:
-# set around each handler invocation)
-_current_metadata: Dict[str, str] = {}
+# Request metadata is carried on the task handling the request (one task per
+# connection), never in a global: concurrent handlers interleave at every
+# await, so a global would leak one request's metadata into another. The
+# reference carries it on the request itself (madsim-tonic/src/sim.rs:20-42).
+_METADATA_KEY = "grpc_request_metadata"
 
 
 def current_metadata() -> Dict[str, str]:
-    """Metadata of the request currently being handled."""
-    return _current_metadata
+    """Metadata of the request the current task is handling."""
+    task = context.try_current_task()
+    if task is None or task.task_locals is None:
+        return {}
+    return task.task_locals.get(_METADATA_KEY) or {}
 
 
 class _RequestStream:
@@ -121,8 +126,10 @@ class Server:
             )
             return
 
-        global _current_metadata
-        _current_metadata = metadata or {}
+        task = context.current_task()
+        if task.task_locals is None:
+            task.task_locals = {}
+        task.task_locals[_METADATA_KEY] = metadata or {}
         try:
             if mode == svc_mod.UNARY:
                 rsp = await handler(payload)

@@ -1,5 +1,12 @@
 """The TPU batched simulation backend (SURVEY.md §7, BASELINE.json north star)."""
 
+from .batch import (  # noqa: F401
+    BatchResult,
+    BatchViolation,
+    BatchWorkload,
+    batch_test,
+    run_batch,
+)
 from .engine import BatchedSim, MsgPool, SimState, summarize  # noqa: F401
-from .raft import RaftState, make_raft_spec  # noqa: F401
+from .raft import RaftState, make_raft_spec, raft_workload  # noqa: F401
 from .spec import INF_US, Outbox, ProtocolSpec, SimConfig, empty_outbox  # noqa: F401

@@ -1,0 +1,193 @@
+"""run_batch: the host↔TPU bridge — whole seed sweeps as one device batch.
+
+This replaces the reference's thread-per-seed fan-out
+(madsim/src/sim/runtime/builder.rs:118-136) for device-expressible workloads:
+instead of `MADSIM_TEST_NUM` OS threads each running a full host simulation,
+the entire seed range becomes lanes of one `BatchedSim` batch, fuzzed in a
+handful of jitted steps on TPU. Violating lanes come back as *seeds*, and each
+violating seed is re-run on the single-lane host runtime (`host_repro`) for
+full-fidelity debugging — print statements, Python breakpoints, per-node logs.
+
+The determinism contract is per-backend (SURVEY.md §7 step 1): a seed is
+bit-reproducible *within* a backend. The TPU engine is the wide net; the host
+runtime is the microscope. A workload provides both faces:
+
+    workload = BatchWorkload(
+        spec=make_raft_spec(n_nodes=5),
+        config=SimConfig(loss_rate=0.1, ...),
+        host_repro=lambda seed: fuzz_one_seed(seed, ...),  # optional
+    )
+    result = run_batch(range(10_000), workload)
+    result.raise_on_violation()    # TestFailure with repro seeds
+
+or, as a test (the `#[madsim::test]` analog for batched workloads):
+
+    @batch_test(workload)
+    def test_raft_fuzz(result):
+        assert result.violations == 0
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import BatchedSim, SimState, summarize
+from .spec import ProtocolSpec, SimConfig
+
+# lanes per device dispatch: bounds peak memory for huge sweeps
+DEFAULT_CHUNK = 65_536
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchWorkload:
+    """A protocol's two faces: the TPU spec + the host-runtime reproducer.
+
+    `host_repro(seed)` runs ONE seed on the host runtime (madsim_tpu.core),
+    raising or returning a dict with a truthy "violations"/"violation" entry
+    when the bug reproduces. It does not need to match the TPU trajectory
+    bit-for-bit — it is the debugging microscope, not a replay.
+    """
+
+    spec: ProtocolSpec
+    config: Optional[SimConfig] = None
+    host_repro: Optional[Callable[[int], Any]] = None
+    max_steps: int = 100_000
+
+
+class BatchViolation(AssertionError):
+    """Violations found in a batch; carries repro seeds (builder.rs DX analog)."""
+
+    def __init__(self, seeds: List[int], detail: str) -> None:
+        shown = ", ".join(str(s) for s in seeds[:16])
+        more = "" if len(seeds) <= 16 else f" (+{len(seeds) - 16} more)"
+        super().__init__(
+            f"{len(seeds)} violating seed(s): {shown}{more}\n    {detail}\n"
+            f"    reproduce one with: MADSIM_TEST_SEED={seeds[0]}"
+        )
+        self.seeds = seeds
+
+
+@dataclasses.dataclass
+class BatchResult:
+    """Outcome of one batched sweep."""
+
+    seeds: np.ndarray  # [L] the seeds that ran
+    violated: np.ndarray  # [L] bool
+    deadlocked: np.ndarray  # [L] bool
+    summary: Dict[str, Any]
+    state: SimState  # final engine state (chunked runs: last chunk only)
+    host_repros: Dict[int, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def violations(self) -> int:
+        return int(self.violated.sum())
+
+    @property
+    def violating_seeds(self) -> List[int]:
+        return [int(s) for s in self.seeds[self.violated]]
+
+    def raise_on_violation(self) -> None:
+        if self.violations:
+            raise BatchViolation(
+                self.violating_seeds,
+                f"summary: {self.summary}",
+            )
+
+
+def run_batch(
+    seeds: Sequence[int],
+    workload: BatchWorkload,
+    repro_on_host: bool = True,
+    max_host_repros: int = 4,
+    chunk: int = DEFAULT_CHUNK,
+) -> BatchResult:
+    """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
+
+    The TPU pass is the seed sweep (runtime/builder.rs:110-148 made wide);
+    the host pass is the repro DX (builder.rs prints the failing seed — here
+    the failing seed is actually *re-executed* on the debuggable runtime).
+    """
+    seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
+    if seeds_arr.ndim != 1 or seeds_arr.size == 0:
+        raise ValueError("seeds must be a non-empty 1-D sequence")
+    sim = BatchedSim(workload.spec, workload.config)
+
+    violated_parts: List[np.ndarray] = []
+    deadlocked_parts: List[np.ndarray] = []
+    state: Optional[SimState] = None
+    totals: Dict[str, float] = {}
+    weights: Dict[str, int] = {}
+    for off in range(0, seeds_arr.size, chunk):
+        part = seeds_arr[off : off + chunk]
+        state = sim.run(part, max_steps=workload.max_steps)
+        violated_parts.append(np.asarray(state.violated))
+        deadlocked_parts.append(np.asarray(state.deadlocked))
+        s = summarize(state, workload.spec)
+        for k, v in s.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if k.startswith("mean_"):
+                # lane-weighted average across chunks, not a sum of means
+                totals[k] = totals.get(k, 0) + v * part.size
+                weights[k] = weights.get(k, 0) + part.size
+            else:
+                totals[k] = totals.get(k, 0) + v
+    for k, w in weights.items():
+        totals[k] = totals[k] / w
+
+    violated = np.concatenate(violated_parts)
+    deadlocked = np.concatenate(deadlocked_parts)
+    result = BatchResult(
+        seeds=seeds_arr,
+        violated=violated,
+        deadlocked=deadlocked,
+        summary=totals,
+        state=state,
+    )
+
+    if repro_on_host and workload.host_repro is not None and result.violations:
+        for seed in result.violating_seeds[:max_host_repros]:
+            try:
+                result.host_repros[seed] = workload.host_repro(seed)
+            except BaseException as e:  # noqa: BLE001 - a raising repro IS a repro
+                result.host_repros[seed] = e
+    return result
+
+
+def batch_test(
+    workload: BatchWorkload,
+    default_num: int = 1024,
+    expect_violations: bool = False,
+):
+    """Decorator: run the env-configured seed range as ONE device batch.
+
+    Reads the same env vars as `@madsim_test` (MADSIM_TEST_SEED,
+    MADSIM_TEST_NUM); the decorated function receives the BatchResult. When
+    `expect_violations` is False, any violation raises BatchViolation with
+    repro seeds (and host repro results attached, if the workload has a
+    host face).
+
+        @batch_test(raft_workload())
+        def test_fuzz(result): ...             # 1024 seeds, one batch
+        MADSIM_TEST_NUM=10000 pytest ...       # 10k seeds, one batch
+    """
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            env = os.environ
+            first = int(env.get("MADSIM_TEST_SEED", "0"))
+            num = int(env.get("MADSIM_TEST_NUM", str(default_num)))
+            result = run_batch(range(first, first + num), workload)
+            if not expect_violations:
+                result.raise_on_violation()
+            return fn(result, *args, **kwargs)
+
+        return wrapper
+
+    return deco

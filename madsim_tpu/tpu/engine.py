@@ -87,6 +87,16 @@ class BatchedSim:
         self.spec = spec
         self.config = config or SimConfig()
         N = spec.n_nodes
+        # Message-pool layout: per-origin ring regions. Each of the
+        # C = N*max_out_msg + N*max_out candidate positions owns K consecutive
+        # slots, so packing a new message is a pure elementwise write into the
+        # first free slot of its region — no rank-matching one-hot products
+        # (the old pack built a [L,C,S] one-hot and a [L,C,S,P] contraction;
+        # at L=16k that was ~220M MACs/step and dominated the step cost).
+        # K is derived from msg_capacity: the budget is spread over regions.
+        self._C = N * spec.max_out_msg + N * spec.max_out
+        self._K = max(1, self.config.msg_capacity // self._C)
+        self._S = self._C * self._K
         # scalar-style handlers -> [L,N] batched
         self._v_init = jax.vmap(jax.vmap(spec.init, in_axes=(0, 0)), in_axes=(0, None))
         self._v_on_message = jax.vmap(
@@ -109,7 +119,7 @@ class BatchedSim:
         """Build lane state for a batch of seeds (int array [L])."""
         spec, cfg = self.spec, self.config
         seeds = jnp.asarray(seeds, jnp.uint32)
-        L, N, S = seeds.shape[0], spec.n_nodes, cfg.msg_capacity
+        L, N, S = seeds.shape[0], spec.n_nodes, self._S
 
         key = prng.key_from(seeds)  # u32 [L]
         node_keys = prng.fold(key[:, None], jnp.arange(N, dtype=jnp.uint32))
@@ -151,7 +161,7 @@ class BatchedSim:
 
     def _step(self, state: SimState) -> SimState:
         spec, cfg = self.spec, self.config
-        N, S, E, P = spec.n_nodes, cfg.msg_capacity, spec.max_out, spec.payload_width
+        N, S, E, P = spec.n_nodes, self._S, spec.max_out, spec.payload_width
         L = state.clock.shape[0]
         msgs = state.msgs
 
@@ -266,7 +276,7 @@ class BatchedSim:
         E_m = self.spec.max_out_msg
         mv, md, mk, mp, ms_ = flat(out_m, has_msg, E_m)
         tv, td, tk, tp, ts_ = flat(out_t, due_t, E)
-        C = N * E_m + N * E
+        C, K = self._C, self._K
         cand_valid = jnp.concatenate([mv, tv], axis=1)  # [L,C]
         cand_dst = jnp.clip(jnp.concatenate([md, td], axis=1), 0, N - 1)
         cand_kind = jnp.concatenate([mk, tk], axis=1)
@@ -287,29 +297,28 @@ class BatchedSim:
         keep = keep & (cand_dst_oh & alive[:, None, :]).any(-1)
         deliver_at = clock[:, None] + lat.astype(jnp.int32)
 
-        # pack survivors into free slots: rank each kept candidate, rank each
-        # free slot, and match rank r -> r-th free slot via one-hot products
-        free = ~valid
-        free_rank = jnp.cumsum(free, axis=1) - 1  # [L,S] rank of each free slot
-        n_free = free.sum(axis=1)
-        rank = jnp.cumsum(keep, axis=1) - 1  # [L,C]
-        placed = keep & (rank < n_free[:, None])
-        # write_oh[l,c,s] = candidate c goes into slot s
-        write_oh = (
-            placed[:, :, None]
-            & free[:, None, :]
-            & (rank[:, :, None] == free_rank[:, None, :])
-        )  # [L,C,S]
-        written = write_oh.any(1)  # [L,S]
-        w_ohi = write_oh.astype(jnp.int32)
+        # pack survivors into their origin's ring region: candidate c owns
+        # slots [c*K, (c+1)*K); the message lands in the first free slot of
+        # the region, else it overflows (counted). Pure elementwise writes —
+        # no [L,C,S] one-hot products.
+        region_free = ~valid.reshape(L, C, K)  # [L,C,K]
+        first_free = region_free & (
+            jnp.cumsum(region_free.astype(jnp.int8), axis=2) == 1
+        )
+        place = keep[:, :, None] & first_free  # [L,C,K]
+        placed = place.any(2)  # [L,C]
+        written = place.reshape(L, S)
 
         def put(pool_vals, cand_vals):
             if cand_vals.ndim == 2:  # [L,C] -> [L,S]
-                incoming = (cand_vals[:, :, None] * w_ohi).sum(1)
-            else:  # [L,C,P] -> [L,S,P]
-                incoming = (cand_vals[:, :, None, :] * w_ohi[:, :, :, None]).sum(1)
-            mask = written if pool_vals.ndim == 2 else written[:, :, None]
-            return jnp.where(mask, incoming, pool_vals)
+                incoming = jnp.broadcast_to(
+                    cand_vals[:, :, None], (L, C, K)
+                ).reshape(L, S)
+                return jnp.where(written, incoming, pool_vals)
+            incoming = jnp.broadcast_to(  # [L,C,P] -> [L,S,P]
+                cand_vals[:, :, None, :], (L, C, K, P)
+            ).reshape(L, S, P)
+            return jnp.where(written[:, :, None], incoming, pool_vals)
 
         new_valid = valid | written
         new_deliver = put(jnp.where(valid, msgs.deliver, INF_US), deliver_at)
@@ -410,12 +419,18 @@ class BatchedSim:
         return jax.tree_util.tree_map(shard, state)
 
 
-def summarize(state: SimState) -> dict:
-    """Host-side summary of a finished batch (bug reports with repro info)."""
+def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
+    """Host-side summary of a finished batch (bug reports with repro info).
+
+    Pass the spec to include its `lane_metrics` diagnostics — e.g. the Raft
+    spec reports how many lanes saturated their fixed-capacity log (a lane
+    whose log stopped appending is a lane that stopped finding bugs; that
+    must be visible, not silent).
+    """
     import numpy as np
 
     violated = np.asarray(state.violated)
-    return {
+    out = {
         "lanes": int(violated.shape[0]),
         "violations": int(violated.sum()),
         "violation_lanes": np.nonzero(violated)[0].tolist()[:32],
@@ -425,3 +440,11 @@ def summarize(state: SimState) -> dict:
         "mean_steps": float(np.asarray(state.steps).mean()),
         "mean_virtual_secs": float(np.asarray(state.clock).mean()) / 1e6,
     }
+    if spec is not None and spec.lane_metrics is not None:
+        for name, arr in spec.lane_metrics(state.node).items():
+            a = np.asarray(arr)
+            if a.dtype == np.bool_:
+                out[name] = int(a.sum())
+            else:
+                out[name] = float(a.mean())
+    return out

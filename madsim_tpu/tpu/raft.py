@@ -340,6 +340,16 @@ def make_raft_spec(
 
         return election_safety & log_matching
 
+    # ------------------------------------------------------------ diagnostics
+
+    def lane_metrics(node):
+        # node leaves are [L,N,...]; a lane whose any node hit log capacity
+        # has a frozen fuzz — surface it (engine.summarize)
+        return {
+            "log_saturated_lanes": (node.log_len >= LOG).any(axis=-1),
+            "mean_log_len": node.log_len.astype(jnp.float32).mean(axis=-1),
+        }
+
     return ProtocolSpec(
         name=f"raft{N}",
         n_nodes=N,
@@ -351,4 +361,46 @@ def make_raft_spec(
         on_timer=on_timer,
         on_restart=on_restart,
         check_invariants=check_invariants,
+        lane_metrics=lane_metrics,
+    )
+
+
+def raft_workload(
+    n_nodes: int = 5,
+    virtual_secs: float = 10.0,
+    loss_rate: float = 0.1,
+    chaos: bool = True,
+    spec: "ProtocolSpec | None" = None,
+):
+    """The Raft fuzz as a BatchWorkload: TPU spec + host-runtime reproducer.
+
+    This is the two-faced bridge run_batch needs (SURVEY.md §7 step 2): the
+    same protocol exists as a JAX state machine (this module) and as host
+    coroutines (workloads/raft_host.py); violating TPU lanes hand their seed
+    to the host face for debuggable re-execution. Pass `spec` to fuzz a
+    modified (e.g. deliberately buggy) spec under the same chaos config.
+    """
+    from .batch import BatchWorkload
+    from .spec import SimConfig
+
+    def host_repro(seed: int):
+        from ..workloads.raft_host import fuzz_one_seed
+
+        return fuzz_one_seed(
+            seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+            loss_rate=loss_rate, chaos=chaos,
+        )
+
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        loss_rate=loss_rate,
+        crash_interval_lo_us=500_000 if chaos else 0,
+        crash_interval_hi_us=3_000_000 if chaos else 0,
+        restart_delay_lo_us=300_000,
+        restart_delay_hi_us=2_000_000,
+    )
+    return BatchWorkload(
+        spec=spec if spec is not None else make_raft_spec(n_nodes=n_nodes),
+        config=cfg,
+        host_repro=host_repro,
     )

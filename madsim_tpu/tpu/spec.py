@@ -79,6 +79,10 @@ class ProtocolSpec:
     on_restart: Callable
     check_invariants: Callable
     max_out_msg: int = 1  # max messages one on_message invocation can emit
+    # optional diagnostics: lane_metrics(node_pytree with [L,N,...] leaves)
+    # -> dict of [L] arrays, surfaced by engine.summarize (e.g. a fuzz that
+    # silently saturates a fixed-capacity log must report it, not hide it)
+    lane_metrics: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

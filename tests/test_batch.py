@@ -1,0 +1,113 @@
+"""run_batch bridge tests: a whole MADSIM_TEST_NUM sweep as ONE device batch,
+with violating seeds reproduced on the single-lane host runtime.
+
+This is the promised host<->TPU bridge (SURVEY.md §7 step 2; replaces the
+reference's thread-per-seed fan-out, runtime/builder.rs:118-136)."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.tpu import (
+    BatchViolation,
+    BatchWorkload,
+    SimConfig,
+    batch_test,
+    make_raft_spec,
+    raft_workload,
+    run_batch,
+)
+from madsim_tpu.tpu import raft as raft_mod
+
+
+def buggy_raft_spec(n_nodes=5):
+    """Raft with an injected split-brain bug: 2 of 5 votes win an election."""
+    spec = make_raft_spec(n_nodes)
+
+    def buggy_on_message(s, nid, src, kind, payload, now, key):
+        state, out, timer = spec.on_message(s, nid, src, kind, payload, now, key)
+        votes = jax.lax.population_count(state.votes.astype(jnp.uint32)).astype(
+            jnp.int32
+        )
+        win = (state.role == raft_mod.CANDIDATE) & (votes >= 2) & (
+            kind == raft_mod.VOTE_RESP
+        )
+        role = jnp.where(win, raft_mod.LEADER, state.role)
+        return state._replace(role=role), out, jnp.where(win, now, timer)
+
+    return dataclasses.replace(spec, on_message=buggy_on_message)
+
+
+def test_clean_raft_sweep_no_violations():
+    wl = raft_workload(virtual_secs=2.0)
+    result = run_batch(range(64), wl)
+    assert result.violations == 0
+    result.raise_on_violation()  # no-op
+    assert result.summary["total_events"] > 0
+
+
+def test_violating_seeds_reported_with_repro_seed():
+    wl = raft_workload(virtual_secs=5.0, spec=buggy_raft_spec())
+    result = run_batch(range(128), wl, repro_on_host=False)
+    assert result.violations > 0
+    seeds = result.violating_seeds
+    assert all(0 <= s < 128 for s in seeds)
+    with pytest.raises(BatchViolation) as e:
+        result.raise_on_violation()
+    assert e.value.seeds == seeds
+    assert f"MADSIM_TEST_SEED={seeds[0]}" in str(e.value)
+
+
+def test_chunked_sweep_matches_single_batch():
+    wl = raft_workload(virtual_secs=1.0, spec=buggy_raft_spec())
+    a = run_batch(range(64), wl, repro_on_host=False)
+    b = run_batch(range(64), wl, repro_on_host=False, chunk=16)
+    assert a.violating_seeds == b.violating_seeds
+
+
+def test_violating_lane_reproduces_on_host_runtime():
+    # TPU face finds the seed; host face re-runs it with full debugging.
+    # The injected bug lives in the TPU spec only, so use the host face as a
+    # sanity companion (it runs the REAL protocol: returns its own report).
+    wl = raft_workload(virtual_secs=2.0, spec=buggy_raft_spec())
+    result = run_batch(range(64), wl, max_host_repros=1)
+    assert result.violations > 0
+    assert len(result.host_repros) == 1
+    (seed, repro), = result.host_repros.items()
+    assert seed == result.violating_seeds[0]
+    # the host reproducer ran a full simulation of that seed
+    assert isinstance(repro, dict) and repro["events"] > 0
+
+
+def test_batch_test_decorator_reads_env(monkeypatch):
+    monkeypatch.setenv("MADSIM_TEST_SEED", "100")
+    monkeypatch.setenv("MADSIM_TEST_NUM", "32")
+    seen = {}
+
+    @batch_test(raft_workload(virtual_secs=1.0))
+    def my_test(result):
+        seen["seeds"] = result.seeds
+
+    my_test()
+    assert seen["seeds"].tolist() == list(range(100, 132))
+
+
+def test_batch_test_decorator_raises_on_violation(monkeypatch):
+    monkeypatch.setenv("MADSIM_TEST_NUM", "64")
+
+    @batch_test(raft_workload(virtual_secs=5.0, spec=buggy_raft_spec()))
+    def my_test(result):
+        raise AssertionError("should not reach the body")
+
+    with pytest.raises(BatchViolation):
+        my_test()
+
+
+def test_runtime_run_batch_entry_point():
+    result = ms.Runtime.run_batch(range(16), raft_workload(virtual_secs=1.0))
+    assert result.violations == 0

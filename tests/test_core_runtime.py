@@ -404,6 +404,54 @@ def test_fs_read_write_and_power_fail():
     rt.block_on(main())
 
 
+def test_fs_power_fail_rolls_back_inplace_overwrites():
+    # an unsynced overwrite of an already-synced byte range must NOT survive
+    # a power failure (content snapshot, not just length truncation)
+    rt = ms.Runtime(seed=1)
+    from madsim_tpu import fs
+
+    async def main():
+        f = await fs.File.create("/data/log")
+        await f.write_all_at(b"aaaaa", 0)
+        await f.sync_all()
+        await f.write_all_at(b"XX", 1)  # unsynced in-place overwrite
+        assert await f.read_at(32, 0) == b"aXXaa"
+
+        sim = ms.plugin.simulator(fs.FsSim)
+        sim.power_fail(ms.plugin.node())
+        assert await fs.read("/data/log") == b"aaaaa"
+
+    rt.block_on(main())
+
+
+def test_notify_stores_at_most_one_permit():
+    # tokio Notify semantics: N notify_one calls with no waiters grant ONE
+    # stored wakeup, not N
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        n = ms.sync.Notify()
+        n.notify_one()
+        n.notify_one()
+        n.notify_one()
+        await n.notified()  # consumes the single stored permit
+
+        woke = []
+
+        async def waiter():
+            await n.notified()
+            woke.append(True)
+
+        ms.spawn(waiter())
+        await ms.time.sleep(0.1)
+        assert woke == []  # no second stored permit
+        n.notify_one()
+        await ms.time.sleep(0.1)
+        assert woke == [True]
+
+    rt.block_on(main())
+
+
 def test_nested_runtime_forbidden():
     rt = ms.Runtime(seed=1)
 

@@ -1,0 +1,385 @@
+"""etcd sim tests — mirrors reference madsim-etcd-client/tests/test.rs:
+kv (:9-61), lease (:63-127), election (:129-241), maintenance (:243-263),
+load_dump (:265-314), plus kill/restart-with-snapshot chaos and prefix watch.
+"""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.sims import etcd
+from madsim_tpu.sims.etcd import Client, SimServer
+from madsim_tpu.sims.etcd.service import Compare, Txn, TxnOp
+
+
+def make_rt(seed=1):
+    rt = ms.Runtime(seed=seed)
+    state = {}
+
+    async def setup():
+        h = rt.handle
+        state["server"] = (
+            h.create_node().name("server").ip("10.0.0.1")
+            .init(lambda: SimServer.builder().serve("10.0.0.1:2379"))
+            .build()
+        )
+        state["client"] = h.create_node().name("client").ip("10.0.0.2").build()
+        ms.net.NetSim.current().add_dns_record("etcd", "10.0.0.1")
+        await ms.time.sleep(1.0)
+
+    return rt, state, setup
+
+
+def test_kv():
+    rt, state, setup = make_rt()
+
+    async def main():
+        await setup()
+
+        async def run():
+            client = await Client.connect(["etcd:2379"])
+            kv = client.kv_client()
+            await kv.put("foo", "bar")
+            resp = await kv.get("foo")
+            k = resp.kvs[0]
+            revision = resp.header.revision
+            assert k.key == b"foo"
+            assert k.value == b"bar"
+            assert k.lease == 0
+            assert k.create_revision == revision
+            assert k.mod_revision == revision
+            # put again: create_revision sticks, mod_revision advances
+            await kv.put("foo", "gg")
+            resp = await kv.get("foo")
+            k = resp.kvs[0]
+            assert k.value == b"gg"
+            assert k.create_revision == revision
+            assert k.mod_revision == resp.header.revision
+            # delete
+            await kv.delete("foo")
+            assert (await kv.get("foo")).kvs == []
+            # error: request too large (2 MiB > 1.5 MiB cap)
+            with pytest.raises(etcd.EtcdError, match="request is too large"):
+                await kv.put("large", b"\x01" * 0x20_0000)
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_txn():
+    rt, state, setup = make_rt()
+
+    async def main():
+        await setup()
+
+        async def run():
+            client = await Client.connect("10.0.0.1:2379")
+            kv = client.kv
+            await kv.put("k", "1")
+            # success branch
+            resp = await kv.txn(
+                Txn()
+                .when(Compare.value_eq("k", "1"))
+                .and_then(TxnOp.put("k", "2"), TxnOp.get("k"))
+                .or_else(TxnOp.put("k", "fail"))
+            )
+            assert resp.succeeded
+            assert resp.op_responses[1].kvs[0].value == b"2"
+            # failure branch
+            resp = await kv.txn(
+                Txn()
+                .when(Compare.value_eq("k", "1"))
+                .and_then(TxnOp.put("k", "nope"))
+                .or_else(TxnOp.delete("k"))
+            )
+            assert not resp.succeeded
+            assert (await kv.get("k")).kvs == []
+
+            # the whole txn is ONE revision: inner writes share it, and the
+            # next plain write gets a strictly higher one (no duplicate
+            # mod_revisions — diverges from the reference's rewind bug)
+            resp = await kv.txn(
+                Txn().and_then(TxnOp.put("t1", "a"), TxnOp.put("t2", "b"))
+            )
+            r1 = (await kv.get("t1")).kvs[0].mod_revision
+            r2 = (await kv.get("t2")).kvs[0].mod_revision
+            assert r1 == r2 == resp.header.revision
+            after = await kv.put("t3", "c")
+            assert after.header.revision > resp.header.revision
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_lease():
+    rt, state, setup = make_rt()
+
+    async def main():
+        await setup()
+
+        async def run():
+            client = await Client.connect("10.0.0.1:2379")
+            kv, lease = client.kv, client.lease
+            granted = await lease.grant(60)
+            await kv.put("foo", "bar", etcd.PutOptions().with_lease(granted.id))
+            resp = await kv.get("foo")
+            assert resp.kvs[0].lease == granted.id
+            # list leases
+            resp = await lease.leases()
+            assert [s.id for s in resp.leases] == [granted.id]
+
+            # keep alive for 90s total
+            await ms.time.sleep(45.0)
+            keeper, responses = await lease.keep_alive(granted.id)
+            await ms.time.sleep(45.0)
+            await keeper.keep_alive()
+            resp = await responses.message()
+            assert resp.id == granted.id
+            assert 50 < resp.ttl <= 60
+            assert (await kv.get("foo")).kvs  # still alive
+
+            # wait for expiry: key deleted
+            await ms.time.sleep(61.0)
+            assert (await kv.get("foo")).kvs == []
+
+            # errors on unknown lease
+            with pytest.raises(etcd.EtcdError, match="lease not found"):
+                await kv.put("foo", "bar", etcd.PutOptions().with_lease(1))
+            with pytest.raises(etcd.EtcdError, match="lease not found"):
+                await lease.revoke(1)
+            with pytest.raises(etcd.EtcdError, match="lease not found"):
+                await lease.time_to_live(1)
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_election():
+    rt, state, setup = make_rt()
+
+    async def main():
+        await setup()
+        h = rt.handle
+        c2 = h.create_node().name("client2").ip("10.0.0.3").build()
+        c3 = h.create_node().name("client3").ip("10.0.0.4").build()
+
+        async def first_leader():
+            client = await Client.connect("10.0.0.1:2379")
+            await ms.time.sleep(5.0)  # let the observer subscribe
+            lease = await client.lease.grant(60)
+            resp = await client.election.campaign("leader", "1", lease.id)
+            leader_key = resp.leader
+            assert leader_key.name == b"leader"
+            assert leader_key.lease == lease.id
+            resp = await client.election.leader("leader")
+            assert resp.kv.value == b"1"
+            # campaign again completes immediately
+            await client.election.campaign("leader", "1", lease.id)
+            # campaign with a new value
+            await client.election.campaign("leader", "1.1", lease.id)
+            # proclaim
+            await client.election.proclaim("1.2", leader_key)
+            resp = await client.election.leader("leader")
+            assert resp.kv.value == b"1.2"
+            await ms.time.sleep(30.0)
+            # revoking the lease releases leadership
+            await client.lease.revoke(lease.id)
+            with pytest.raises(etcd.EtcdError, match="session expired"):
+                await client.election.proclaim("1.3", leader_key)
+            # campaign with an invalid lease
+            with pytest.raises(etcd.EtcdError, match="lease not found"):
+                await client.election.campaign("invalid_lease", "1", 1)
+            return True
+
+        async def second_leader():
+            client = await Client.connect("10.0.0.1:2379")
+            await ms.time.sleep(10.0)  # after client1 is leader
+            lease = await client.lease.grant(60)
+            # blocks until client1's lease is revoked
+            resp = await client.election.campaign("leader", "2", lease.id)
+            assert resp.leader.name == b"leader"
+            assert resp.leader.lease == lease.id
+            await client.election.resign(resp.leader)
+            return True
+
+        async def observer():
+            client = await Client.connect("10.0.0.1:2379")
+            stream = await client.election.observe("leader")
+            values = []
+            for _ in range(3):
+                resp = await stream.message()
+                values.append(resp.kv.value)
+            assert values == [b"1", b"1.1", b"1.2"]
+            await ms.time.sleep(15.0)
+            # two election keys live under the prefix now
+            resp = await client.kv.get("leader", prefix=True)
+            assert len(resp.kvs) == 2
+            resp = await stream.message()
+            assert resp.kv.value == b"2"
+            return True
+
+        t1 = state["client"].spawn(first_leader())
+        t2 = c2.spawn(second_leader())
+        t3 = c3.spawn(observer())
+        return await t1 and await t2 and await t3
+
+    assert rt.block_on(main())
+
+
+def test_watch_prefix_events():
+    rt, state, setup = make_rt()
+
+    async def main():
+        await setup()
+
+        async def run():
+            client = await Client.connect("10.0.0.1:2379")
+            stream = await client.watch.watch_prefix("app/")
+            await client.kv.put("app/a", "1")
+            await client.kv.put("other", "x")  # not under the prefix
+            await client.kv.put("app/b", "2")
+            await client.kv.delete("app/a")
+            e1 = await stream.message()
+            assert (e1.type, e1.kv.key, e1.kv.value) == (
+                etcd.EventType.PUT, b"app/a", b"1",
+            )
+            e2 = await stream.message()
+            assert (e2.type, e2.kv.key) == (etcd.EventType.PUT, b"app/b")
+            e3 = await stream.message()
+            assert (e3.type, e3.kv.key) == (etcd.EventType.DELETE, b"app/a")
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_maintenance_status():
+    rt, state, setup = make_rt()
+
+    async def main():
+        await setup()
+
+        async def run():
+            client = await Client.connect("10.0.0.1:2379")
+            await client.maintenance.status()
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_load_dump():
+    # mirror test.rs:265-314: dump with binary values, re-serve, read back
+    rt, state, setup = make_rt()
+
+    async def main():
+        await setup()
+        h = rt.handle
+
+        async def phase1():
+            client = await Client.connect("10.0.0.1:2379")
+            lease = await client.lease.grant(60)
+            await client.kv.put(
+                "foo", b"bar\xff\x01\x02", etcd.PutOptions().with_lease(lease.id)
+            )
+            return await client.dump()
+
+        dump = await state["client"].spawn(phase1())
+
+        async def serve2():
+            await SimServer.builder().load(dump).serve("10.0.0.1:2380")
+
+        state["server"].spawn(serve2())
+        await ms.time.sleep(1.0)
+
+        async def phase2():
+            client = await Client.connect("10.0.0.1:2380")
+            resp = await client.kv.get("foo")
+            assert resp.kvs[0].value == b"bar\xff\x01\x02"
+            assert resp.kvs[0].lease != 0
+            return True
+
+        return await state["client"].spawn(phase2())
+
+    assert rt.block_on(main())
+
+
+def test_server_kill_restart_with_snapshot():
+    """The chaos pattern the reference uses at test.rs:199-254: periodically
+    dump, kill the server, restart it from the last snapshot, and verify
+    clients reconnect and see the snapshotted state."""
+    rt = ms.Runtime(seed=7)
+
+    async def main():
+        h = rt.handle
+        snapshots = {}
+
+        def serve():
+            if "dump" in snapshots:
+                return SimServer.builder().load(snapshots["dump"]).serve(
+                    "10.0.0.1:2379"
+                )
+            return SimServer.builder().serve("10.0.0.1:2379")
+
+        server = (
+            h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+        )
+        client_node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.time.sleep(1.0)
+
+        async def run():
+            client = await Client.connect("10.0.0.1:2379")
+            await client.kv.put("stable", "before-crash")
+            snapshots["dump"] = await client.dump()
+
+            h.kill(server.id)
+            await ms.time.sleep(1.0)
+            h.restart(server.id)  # re-runs init => serves from snapshot
+            await ms.time.sleep(1.0)
+
+            client = await Client.connect("10.0.0.1:2379")
+            resp = await client.kv.get("stable")
+            assert resp.kvs[0].value == b"before-crash"
+            # and the restarted server accepts new writes
+            await client.kv.put("after", "restart")
+            assert (await client.kv.get("after")).kvs[0].value == b"restart"
+            return True
+
+        return await client_node.spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_injected_timeouts():
+    rt = ms.Runtime(seed=3)
+
+    async def main():
+        h = rt.handle
+        h.create_node().name("server").ip("10.0.0.1").init(
+            lambda: SimServer.builder().timeout_rate(0.5).serve("10.0.0.1:2379")
+        ).build()
+        client_node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.time.sleep(1.0)
+
+        async def run():
+            client = await Client.connect("10.0.0.1:2379")
+            timeouts = 0
+            for i in range(20):
+                try:
+                    await client.kv.put(f"k{i}", "v")
+                except etcd.EtcdError as e:
+                    assert "timed out" in str(e)
+                    timeouts += 1
+            assert 0 < timeouts < 20  # some injected, some pass
+            return True
+
+        return await client_node.spawn(run())
+
+    assert rt.block_on(main())

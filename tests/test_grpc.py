@@ -26,6 +26,14 @@ class Greeter(grpc.Service):
         return {"message": "finally"}
 
     @grpc.unary
+    async def slow_whoami(self, request):
+        # read metadata only AFTER an await: interleaved concurrent requests
+        # must still each see their own metadata
+        await ms.time.sleep(request.get("delay", 0.5))
+        md = grpc.current_metadata()
+        return {"user": md.get("user", "<anon>")}
+
+    @grpc.unary
     async def fail_not_found(self, request):
         raise grpc.Status.not_found("no such thing")
 
@@ -186,6 +194,36 @@ def test_interceptor_metadata():
             with pytest.raises(grpc.Status) as e:
                 await stub2.whoami({})
             assert e.value.code == grpc.Code.PERMISSION_DENIED
+            return True
+
+        return await state["client"].spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_concurrent_requests_keep_own_metadata():
+    # Two in-flight RPCs whose handlers read metadata only after awaits:
+    # each must see its own request's metadata, not the other's (metadata is
+    # per-request/per-task, never a module global).
+    rt, state, setup = make_cluster()
+
+    async def main():
+        await setup()
+
+        async def run():
+            async def one_call(user, delay):
+                def auth(msg, metadata, user=user):
+                    metadata["user"] = user
+
+                channel = await grpc.connect("http://10.0.0.1:50051", interceptor=auth)
+                stub = grpc.client_for(Greeter, channel)
+                return await stub.slow_whoami({"delay": delay})
+
+            t1 = ms.spawn(one_call("alice", 0.8))
+            t2 = ms.spawn(one_call("bob", 0.3))
+            r1, r2 = await t1, await t2
+            assert r1 == {"user": "alice"}
+            assert r2 == {"user": "bob"}
             return True
 
         return await state["client"].spawn(run())

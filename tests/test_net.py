@@ -139,6 +139,48 @@ def test_rpc_with_data():
     assert rt.block_on(main()) == ("ok", b"cba")
 
 
+def test_rpc_timeout_prunes_mailbox():
+    # A timed-out rpc call must not park its late response in the mailbox
+    # forever (memory leak on long lossy fuzz runs); the one-shot response tag
+    # is forgotten and the late arrival is dropped.
+    rt = make_rt()
+
+    @rpc.rpc_request
+    class Slow:
+        pass
+
+    async def main():
+        h = rt.handle
+        srv = h.create_node().ip("10.0.0.1").build()
+        cli = h.create_node().ip("10.0.0.2").build()
+
+        async def server():
+            ep = await Endpoint.bind("10.0.0.1:9000")
+
+            async def handle(req):
+                await ms.time.sleep(5.0)  # longer than the caller's timeout
+                return "late"
+
+            rpc.add_rpc_handler(ep, Slow, handle)
+
+        async def client():
+            ep = await Endpoint.bind("10.0.0.2:0")
+            with pytest.raises(ms.time.Elapsed):
+                await rpc.call_timeout(ep, "10.0.0.1:9000", Slow(), 1.0)
+            # let the late response arrive, then check nothing parked
+            await ms.time.sleep(10.0)
+            mailbox = ep._socket.mailbox
+            assert mailbox.msgs == []
+            assert mailbox.registered == []
+            return True
+
+        srv.spawn(server())
+        await ms.time.sleep(0.1)
+        return await cli.spawn(client())
+
+    assert rt.block_on(main())
+
+
 def test_packet_loss_datagrams_dropped():
     rt = make_rt(seed=3, packet_loss_rate=1.0)
 

@@ -30,6 +30,13 @@ def bench_tpu(lanes: int, virtual_secs: float, client_rate: float) -> dict:
         crash_interval_hi_us=3_000_000,
         restart_delay_lo_us=300_000,
         restart_delay_hi_us=2_000_000,
+        # partition chaos on: random bipartitions every 0.3-1.5s, healing
+        # after 0.5-2s (the host baseline runs the same partition schedule
+        # rate via fuzz_one_seed(partitions=True))
+        partition_interval_lo_us=300_000,
+        partition_interval_hi_us=1_500_000,
+        partition_heal_lo_us=500_000,
+        partition_heal_hi_us=2_000_000,
     )
     sim = BatchedSim(spec, cfg)
     max_steps = int(virtual_secs * 600) + 2000  # generous event budget
@@ -58,11 +65,15 @@ def bench_cpu_baseline(n_seeds: int, virtual_secs: float, client_rate: float) ->
     from madsim_tpu.workloads.raft_host import fuzz_one_seed
 
     # warm one seed (imports, code paths)
-    fuzz_one_seed(999_983, virtual_secs=virtual_secs, client_rate=client_rate)
+    fuzz_one_seed(
+        999_983, virtual_secs=virtual_secs, client_rate=client_rate, partitions=True
+    )
     t0 = time.perf_counter()
     events = 0
     for seed in range(n_seeds):
-        r = fuzz_one_seed(seed, virtual_secs=virtual_secs, client_rate=client_rate)
+        r = fuzz_one_seed(
+            seed, virtual_secs=virtual_secs, client_rate=client_rate, partitions=True
+        )
         events += r["events"]
     wall = time.perf_counter() - t0
     return {

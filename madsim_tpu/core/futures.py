@@ -89,7 +89,23 @@ class Future(Generic[T]):
 
     def __await__(self) -> Generator[Any, None, T]:
         if not self._done:
-            yield self
+            from . import context
+
+            if context.try_current_task() is not None:
+                # simulation mode: yield to the DES executor
+                yield self
+            else:
+                # production mode: the same Future (and so every sync
+                # primitive built on it) works under a real asyncio loop —
+                # the dual-mode boundary of reference lib.rs:14-23
+                import asyncio
+
+                loop = asyncio.get_running_loop()
+                afut = loop.create_future()
+                self.add_done_callback(
+                    lambda f: afut.done() or afut.set_result(None)
+                )
+                yield from afut.__await__()
         if not self._done:
             raise RuntimeError("task resumed but future is not done")
         return self.result()

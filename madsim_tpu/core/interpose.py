@@ -1,0 +1,263 @@
+"""Stdlib interposition: make user code deterministic inside a simulation.
+
+Analog of the reference's libc interposition (rand.rs:195-263 fakes
+getrandom/getentropy, time/system_time.rs:4-110 fakes gettimeofday/
+clock_gettime, task/mod.rs:753-769 errors pthread creation). The reference
+dlsym-interposes libc so *std* types are deterministic under the sim and
+untouched outside it; the Python analog patches the stdlib entry points with
+dispatchers that consult the TLS simulation context:
+
+  - inside a sim: `time.time/monotonic/perf_counter` (+ `_ns` variants) read
+    the virtual clock; `random.*` module functions and `os.urandom` draw from
+    the seeded GlobalRng (which also makes `uuid.uuid4()`, `random.Random()`
+    seeding, and `secrets` deterministic, since they bottom out in urandom);
+    `threading.Thread.start`, `asyncio.run`, and `time.sleep` raise — real
+    threads / event loops / blocking sleeps inside a sim are bugs.
+  - outside a sim: every patch passes straight through to the original.
+
+Installed lazily at first Runtime construction (install() is idempotent);
+uninstall() restores everything (used by tests).
+
+Known limitation vs the reference: `datetime.datetime.now()` reads the system
+clock in C without going through `time.time`, so it is NOT virtualized —
+use `time.time()` or madsim_tpu.time. (The reference covers this case only
+because libc interposition sits below everything.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random as random_mod
+import threading
+import time as time_mod
+from typing import Any, Dict, Optional
+
+from . import context
+
+_originals: Dict[str, Any] = {}
+_installed = False
+
+
+def _handle():
+    return context.try_current_handle()
+
+
+class SimForbiddenError(RuntimeError):
+    """A nondeterministic primitive was used inside a simulation."""
+
+
+# --------------------------------------------------------------------- time
+
+
+def _make_time_patch(name: str, virtual_fn):
+    orig = getattr(time_mod, name)
+
+    def patched(*args, **kwargs):
+        h = _handle()
+        if h is None:
+            return orig(*args, **kwargs)
+        return virtual_fn(h)
+
+    patched.__name__ = name
+    return patched
+
+
+def _patched_sleep(seconds):
+    h = _handle()
+    if h is None:
+        return _originals["time.sleep"](seconds)
+    raise SimForbiddenError(
+        "time.sleep() blocks the real clock inside a simulation; "
+        "use `await madsim_tpu.time.sleep(...)` instead"
+    )
+
+
+# ------------------------------------------------------------------- random
+
+
+def _rng_bytes(h, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        out += h.rng.next_u64().to_bytes(8, "little")
+    return bytes(out[:n])
+
+
+class _SimRandom(random_mod.Random):
+    """A Random whose entropy is the simulation's GlobalRng.
+
+    Overriding random()/getrandbits() routes every distribution method
+    (uniform, gauss, choice, shuffle, sample, ...) through the seeded,
+    record/replay-logged GlobalRng.
+    """
+
+    def random(self) -> float:  # type: ignore[override]
+        return context.current_handle().rng.random()
+
+    def getrandbits(self, k: int) -> int:  # type: ignore[override]
+        h = context.current_handle()
+        out = 0
+        filled = 0
+        while filled < k:
+            take = min(64, k - filled)
+            out |= (h.rng.next_u64() >> (64 - take)) << filled
+            filled += take
+        return out
+
+    def seed(self, *args, **kwargs) -> None:  # type: ignore[override]
+        # reseeding the global stream inside a sim is ignored: determinism
+        # comes from the simulation seed (mirrors std RandomState seeding,
+        # reference rand.rs:176-244)
+        return None
+
+    def getstate(self):  # type: ignore[override]
+        raise SimForbiddenError(
+            "random.getstate() inside a simulation is not supported"
+        )
+
+    def setstate(self, state) -> None:  # type: ignore[override]
+        raise SimForbiddenError(
+            "random.setstate() inside a simulation is not supported"
+        )
+
+
+def _sim_random_for(h) -> _SimRandom:
+    """Per-Runtime _SimRandom: distribution methods carry internal state
+    (e.g. gauss caches its pair) that must not leak across simulations."""
+    sr = getattr(h, "_sim_random", None)
+    if sr is None:
+        sr = _SimRandom()
+        h._sim_random = sr
+    return sr
+
+
+# module-level functions worth dispatching (bound methods of the hidden
+# global Random instance in CPython)
+_RANDOM_FNS = [
+    "random", "uniform", "triangular", "randint", "choice", "randrange",
+    "sample", "shuffle", "choices", "normalvariate", "lognormvariate",
+    "expovariate", "vonmisesvariate", "gammavariate", "gauss", "betavariate",
+    "paretovariate", "weibullvariate", "getrandbits", "randbytes", "seed",
+]
+
+
+def _make_random_patch(name: str):
+    orig = getattr(random_mod, name)
+
+    def patched(*args, **kwargs):
+        h = _handle()
+        if h is None:
+            return orig(*args, **kwargs)
+        return getattr(_sim_random_for(h), name)(*args, **kwargs)
+
+    patched.__name__ = name
+    return patched
+
+
+def _patched_urandom(n: int) -> bytes:
+    h = _handle()
+    if h is None:
+        return _originals["os.urandom"](n)
+    return _rng_bytes(h, n)
+
+
+class _DispatchRandom(random_mod.Random):
+    """Replacement for `random.Random`: unseeded construction inside a sim is
+    deterministic. CPython's `_random.Random.__new__` draws real entropy in C
+    (not interceptable from Python), so reseed from the GlobalRng after."""
+
+    def __init__(self, x=None) -> None:
+        super().__init__(x)
+        h = _handle()
+        if x is None and h is not None:
+            self.seed(int.from_bytes(_rng_bytes(h, 32), "little"))
+
+
+# ------------------------------------------------------------------ threads
+
+
+def _patched_thread_start(self: threading.Thread) -> None:
+    if _handle() is not None:
+        raise SimForbiddenError(
+            "spawning a real thread inside a simulation breaks determinism "
+            "(reference forbids pthread creation, task/mod.rs:753-769); "
+            "use madsim_tpu.spawn for concurrency"
+        )
+    return _originals["threading.Thread.start"](self)
+
+
+def _patched_asyncio_run(*args, **kwargs):
+    if _handle() is not None:
+        raise SimForbiddenError(
+            "asyncio.run() inside a simulation would run a real event loop; "
+            "madsim_tpu IS the event loop — spawn tasks with madsim_tpu.spawn"
+        )
+    return _originals["asyncio.run"](*args, **kwargs)
+
+
+# ------------------------------------------------------------------ install
+
+
+def install() -> None:
+    """Patch the stdlib (idempotent). Dispatch is per-call on TLS context."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    for name, fn in [
+        ("time", lambda h: h.time.now_time()),
+        ("time_ns", lambda h: h.time.now_time_ns()),
+        ("monotonic", lambda h: h.time.elapsed()),
+        ("monotonic_ns", lambda h: h.time.elapsed_ns()),
+        ("perf_counter", lambda h: h.time.elapsed()),
+        ("perf_counter_ns", lambda h: h.time.elapsed_ns()),
+    ]:
+        _originals[f"time.{name}"] = getattr(time_mod, name)
+        setattr(time_mod, name, _make_time_patch(name, fn))
+
+    _originals["time.sleep"] = time_mod.sleep
+    time_mod.sleep = _patched_sleep
+
+    for name in _RANDOM_FNS:
+        if not hasattr(random_mod, name):
+            continue
+        _originals[f"random.{name}"] = getattr(random_mod, name)
+        setattr(random_mod, name, _make_random_patch(name))
+
+    _originals["os.urandom"] = os.urandom
+    os.urandom = _patched_urandom
+    # SystemRandom / secrets bottom out in the module-captured urandom ref
+    if hasattr(random_mod, "_urandom"):
+        _originals["random._urandom"] = random_mod._urandom
+        random_mod._urandom = _patched_urandom
+    # unseeded random.Random() seeds from real entropy in C; rebind the
+    # class so in-sim construction reseeds deterministically
+    _originals["random.Random"] = random_mod.Random
+    random_mod.Random = _DispatchRandom
+
+    _originals["threading.Thread.start"] = threading.Thread.start
+    threading.Thread.start = _patched_thread_start
+    _originals["asyncio.run"] = asyncio.run
+    asyncio.run = _patched_asyncio_run
+
+
+def uninstall() -> None:
+    """Restore every patched entry point."""
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    for dotted, orig in _originals.items():
+        mod_name, _, attr = dotted.rpartition(".")
+        if dotted == "threading.Thread.start":
+            threading.Thread.start = orig
+        elif mod_name == "time":
+            setattr(time_mod, attr, orig)
+        elif mod_name == "random":
+            setattr(random_mod, attr, orig)
+        elif mod_name == "os":
+            setattr(os, attr, orig)
+        elif mod_name == "asyncio":
+            setattr(asyncio, attr, orig)
+    _originals.clear()

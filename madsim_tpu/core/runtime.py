@@ -174,6 +174,12 @@ class Runtime:
     """One deterministic simulation lane (runtime/mod.rs:33-192)."""
 
     def __init__(self, seed: int = 0, config: Optional[Config] = None) -> None:
+        # make stdlib time/random/urandom deterministic inside sims (the
+        # libc-interposition analog; patches dispatch on TLS context, so
+        # code outside a sim is untouched)
+        from . import interpose
+
+        interpose.install()
         self.config = config or Config()
         self.rng = GlobalRng(seed)
         self.time = TimeHandle(self.rng)

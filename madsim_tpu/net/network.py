@@ -3,9 +3,9 @@
 Analog of reference madsim/src/sim/net/network.rs:20-313. Pure bookkeeping +
 RNG rolls; all *delivery* happens via timers scheduled by `NetSim`.
 
-On the TPU batched backend the same state lives as tensors — clog masks
-`[lane, node, node]`, per-lane loss/latency draws — see
-`madsim_tpu/tpu/netstate.py`; this class is the single-lane host semantics.
+On the TPU batched backend the same state lives as tensors — link masks
+`[lane, node, node]` (SimState.link_ok), per-lane loss/latency draws — see
+`madsim_tpu/tpu/engine.py`; this class is the single-lane host semantics.
 """
 
 from __future__ import annotations

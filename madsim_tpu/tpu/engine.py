@@ -65,6 +65,9 @@ class SimState(NamedTuple):
     alive: Any  # bool [L,N]
     crashed: Any  # i32 [L] (node id currently down, -1 = none)
     chaos_at: Any  # i32 [L] (next crash/restart event)
+    link_ok: Any  # bool [L,N,N] (directed link up; the clog masks)
+    partitioned: Any  # bool [L] (a partition is currently active)
+    part_at: Any  # i32 [L] (next partition split/heal event)
     timer: Any  # i32 [L,N]
     node: Any  # protocol pytree, leaves [L,N,...]
     msgs: MsgPool
@@ -97,6 +100,17 @@ class BatchedSim:
         self._C = N * spec.max_out_msg + N * spec.max_out
         self._K = max(1, self.config.msg_capacity // self._C)
         self._S = self._C * self._K
+        # source node of each candidate position (static: flat() reshapes
+        # [L,N,e] row-major, so position c within each block maps to node
+        # c // e) — used for send-time link tests
+        import numpy as _np
+
+        self._src_of_c = _np.concatenate(
+            [
+                _np.arange(N * spec.max_out_msg) // spec.max_out_msg,
+                _np.arange(N * spec.max_out) // spec.max_out,
+            ]
+        )
         # scalar-style handlers -> [L,N] batched
         self._v_init = jax.vmap(jax.vmap(spec.init, in_axes=(0, 0)), in_axes=(0, None))
         self._v_on_message = jax.vmap(
@@ -131,6 +145,12 @@ class BatchedSim:
             )
         else:
             chaos_at = jnp.full((L,), INF_US, jnp.int32)
+        if cfg.partition_enabled:
+            part_at = prng.randint(
+                key, 12, cfg.partition_interval_lo_us, cfg.partition_interval_hi_us
+            )
+        else:
+            part_at = jnp.full((L,), INF_US, jnp.int32)
 
         return SimState(
             clock=jnp.zeros((L,), jnp.int32),
@@ -145,6 +165,9 @@ class BatchedSim:
             alive=jnp.ones((L, N), jnp.bool_),
             crashed=jnp.full((L,), -1, jnp.int32),
             chaos_at=chaos_at,
+            link_ok=jnp.ones((L, N, N), jnp.bool_),
+            partitioned=jnp.zeros((L,), jnp.bool_),
+            part_at=part_at,
             timer=jnp.asarray(timer, jnp.int32),
             node=node_state,
             msgs=MsgPool(
@@ -176,7 +199,10 @@ class BatchedSim:
         live_msg = msgs.valid & alive_dst
         t_msg = jnp.where(live_msg, msgs.deliver, INF_US).min(axis=1)
         t_timer = jnp.where(state.alive, state.timer, INF_US).min(axis=1)
-        t_next = jnp.minimum(jnp.minimum(t_msg, t_timer), state.chaos_at)
+        t_next = jnp.minimum(
+            jnp.minimum(jnp.minimum(t_msg, t_timer), state.chaos_at),
+            state.part_at,
+        )
 
         deadlocked = (~state.done) & (t_next >= INF_US)
         active = (~state.done) & (t_next < INF_US)
@@ -262,6 +288,41 @@ class BatchedSim:
             dst_alive_now = (dst_oh & alive[:, None, :]).any(-1)
             valid = valid & dst_alive_now
 
+        # -- 5b. partition chaos: random bipartition splits, later heals ----
+        # (the clog_link masks of network.rs:261-269, lane-batched)
+        link_ok = state.link_ok
+        partitioned, part_at = state.partitioned, state.part_at
+        if cfg.partition_enabled:
+            part_due = active & (state.part_at <= clock)
+            do_split = part_due & ~state.partitioned
+            do_heal = part_due & state.partitioned
+            pkey = prng.fold(key, 106)
+            # each node draws a side; links crossing the cut go down both ways
+            side = (
+                prng.uniform(
+                    pkey[:, None], 7, index=jnp.arange(N, dtype=jnp.uint32)[None, :]
+                )
+                < 0.5
+            )  # [L,N]
+            same_side = side[:, :, None] == side[:, None, :]  # [L,N,N]
+            link_ok = jnp.where(
+                do_split[:, None, None],
+                same_side,
+                jnp.where(do_heal[:, None, None], True, state.link_ok),
+            )
+            partitioned = (state.partitioned | do_split) & ~do_heal
+            heal_delay = prng.randint(
+                pkey, 8, cfg.partition_heal_lo_us, cfg.partition_heal_hi_us
+            )
+            next_split = prng.randint(
+                pkey, 9, cfg.partition_interval_lo_us, cfg.partition_interval_hi_us
+            )
+            part_at = jnp.where(
+                do_split,
+                clock + heal_delay,
+                jnp.where(do_heal, clock + next_split, state.part_at),
+            )
+
         # -- 6. collect outboxes, roll the network, pack into pool ---------
         def flat(out: Outbox, emitting, e):  # [L,N,e,...] -> [L, N*e, ...]
             v = (out.valid & emitting[:, :, None]).reshape(L, N * e)
@@ -295,6 +356,12 @@ class BatchedSim:
         keep = cand_valid & (u >= cfg.loss_rate)
         # sends to currently-dead nodes are dropped (clogged-node semantics)
         keep = keep & (cand_dst_oh & alive[:, None, :]).any(-1)
+        if cfg.partition_enabled:
+            # link test at send time (test_link, network.rs:261-269): the
+            # candidate's source node is static per position, so the link row
+            # is a constant-index gather, then matched against the dst one-hot
+            src_rows = link_ok[:, self._src_of_c, :]  # [L,C,N]
+            keep = keep & (cand_dst_oh & src_rows).any(-1)
         deliver_at = clock[:, None] + lat.astype(jnp.int32)
 
         # pack survivors into their origin's ring region: candidate c owns
@@ -351,6 +418,9 @@ class BatchedSim:
             alive=alive,
             crashed=crashed,
             chaos_at=chaos_at,
+            link_ok=link_ok,
+            partitioned=partitioned,
+            part_at=part_at,
             timer=timer,
             node=node,
             msgs=MsgPool(

@@ -104,8 +104,19 @@ class SimConfig:
     crash_interval_hi_us: int = 0
     restart_delay_lo_us: int = 1_000_000
     restart_delay_hi_us: int = 10_000_000
+    # partition chaos (0 disables): every partition_interval, split the
+    # cluster into two random halves (the [lane,N,N] clog-link masks of
+    # net/network.rs:261-269, batched); heal after partition_heal
+    partition_interval_lo_us: int = 0
+    partition_interval_hi_us: int = 0
+    partition_heal_lo_us: int = 500_000
+    partition_heal_hi_us: int = 3_000_000
     horizon_us: int = 30_000_000  # virtual-time budget per lane
 
     @property
     def chaos_enabled(self) -> bool:
         return self.crash_interval_hi_us > 0
+
+    @property
+    def partition_enabled(self) -> bool:
+        return self.partition_interval_hi_us > 0

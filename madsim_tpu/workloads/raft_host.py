@@ -178,10 +178,18 @@ class RaftNode:
                 ms.spawn(self.append_to(peer))
 
     async def append_to(self, peer: int) -> None:
+        # spawned-task races found by partition fuzzing: between
+        # broadcast_append spawning this task and it running, this node may
+        # have (a) stepped down and adopted a NEWER term — sending its stale
+        # log stamped with that term would forge "current leader" messages
+        # that make followers truncate committed entries — or (b) had its
+        # log truncated, leaving next_idx past the end.
+        if self.role != LEADER:
+            return
         term = self.term
-        ni = self.next_idx.get(peer, 0)
+        ni = min(self.next_idx.get(peer, 0), len(self.log))
         prev_idx = ni - 1
-        prev_term = self.log[prev_idx][0] if prev_idx >= 0 else 0
+        prev_term = self.log[prev_idx][0] if 0 <= prev_idx < len(self.log) else 0
         entry = self.log[ni] if ni < len(self.log) else None
         try:
             rterm, ok, match = await rpc.call_timeout(
@@ -253,6 +261,7 @@ async def _fuzz_body(
     chaos: bool,
     buggy: bool,
     client_rate: float,
+    partitions: bool = False,
 ) -> dict:
     handle = ms.Handle.current()
     from madsim_tpu.net import NetSim
@@ -326,6 +335,23 @@ async def _fuzz_body(
     if chaos:
         ms.spawn(chaos_task())
 
+    async def partition_task() -> None:
+        # random bipartition, hold, heal — mirrors the TPU engine's
+        # partition chaos (SimState.link_ok) on the host NetSim clog masks
+        net = ms.plugin.simulator(NetSim)
+        ids = [n.id for n in nodes]
+        while True:
+            await ms.time.sleep(0.3 + ms.rand() * 1.2)
+            side = [ms.rand() < 0.5 for _ in ids]
+            group_a = [i for i, s_ in zip(ids, side) if s_]
+            group_b = [i for i, s_ in zip(ids, side) if not s_]
+            net.partition(group_a, group_b)
+            await ms.time.sleep(0.5 + ms.rand() * 1.5)
+            net.heal_partition(group_a, group_b)
+
+    if partitions:
+        ms.spawn(partition_task())
+
     t = ms.time.current()
     end = t.elapsed() + virtual_secs
     while t.elapsed() < end:
@@ -346,11 +372,12 @@ def fuzz_one_seed(
     chaos: bool = True,
     buggy: bool = False,
     client_rate: float = 0.5,
+    partitions: bool = False,
 ) -> dict:
     """One complete fuzzed execution (the unit the reference runs per thread)."""
     cfg = ms.Config()
     cfg.net.packet_loss_rate = loss_rate
     rt = ms.Runtime(seed=seed, config=cfg)
     return rt.block_on(
-        _fuzz_body(n_nodes, virtual_secs, chaos, buggy, client_rate)
+        _fuzz_body(n_nodes, virtual_secs, chaos, buggy, client_rate, partitions)
     )

@@ -1,0 +1,49 @@
+"""Greeter in PRODUCTION mode: the exact same service/client code as the
+simulated cluster (examples/greeter.py), against real TCP sockets.
+
+This is the reference's dual-mode promise (lib.rs:14-23; tonic-example's
+real-mode binaries in src/bin/): code written once runs under the
+deterministic simulation for testing and against reality for production.
+
+    python examples/greeter_real.py         # server + client over localhost
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+from madsim_tpu import real
+from madsim_tpu.sims import grpc
+
+# the UNMODIFIED simulation-tested service
+from greeter import Greeter  # noqa: E402
+
+
+async def main() -> None:
+    server = grpc.Server().add_service(Greeter())
+    server_task = real.real_spawn(server.serve("127.0.0.1:50061"))
+    import asyncio
+
+    await asyncio.sleep(0.2)  # let the listener come up
+
+    channel = await grpc.connect("http://127.0.0.1:50061")
+    stub = grpc.client_for(Greeter, channel)
+
+    r = await stub.say_hello({"name": "world"})
+    print("unary:", r)
+    frames = await (await stub.lots_of_replies({"name": "world"})).collect()
+    print("server-streaming:", frames)
+    r = await stub.lots_of_greetings([{"name": n} for n in ("a", "b", "c")])
+    print("client-streaming:", r)
+    out = await (await stub.bidi_hello([{"name": "x"}, {"name": "y"}])).collect()
+    print("bidi:", out)
+
+    server.shutdown()
+    server_task.abort()
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, "examples")
+    real.run(main())

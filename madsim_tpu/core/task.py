@@ -535,13 +535,22 @@ class NodeHandle:
 # ---- free functions over the current context ----
 
 
-def spawn(coro: Coroutine[Any, Any, Any], *, name: Optional[str] = None) -> JoinHandle:
-    """Spawn a task onto the current node."""
+def spawn(coro: Coroutine[Any, Any, Any], *, name: Optional[str] = None):
+    """Spawn a task onto the current node.
+
+    Production (non-sim) mode: with no simulation context, spawns onto the
+    running asyncio loop instead — same user code, real concurrency (the
+    lib.rs:14-23 sim/std switch).
+    """
     task = context.try_current_task()
     if task is not None:
         return task.node_spawner().spawn(coro, name=name)
-    handle = context.current_handle()
-    return Spawner(handle.executor, handle.executor.main_info).spawn(coro, name=name)
+    handle = context.try_current_handle()
+    if handle is not None:
+        return Spawner(handle.executor, handle.executor.main_info).spawn(coro, name=name)
+    from ..real.runtime import real_spawn
+
+    return real_spawn(coro, name=name)
 
 
 spawn_local = spawn  # single-threaded by construction

@@ -245,8 +245,18 @@ class Sleep:
                 time.cancel_timer(self._entry)
 
 
-def sleep(seconds: float) -> Sleep:
-    """Sleep for `seconds` of virtual time."""
+def sleep(seconds: float):
+    """Sleep for `seconds` of virtual time.
+
+    Production (non-sim) mode: with no simulation context this is a real
+    asyncio sleep — same user code against reality (lib.rs:14-23 switch).
+    """
+    from . import context
+
+    if context.try_current_handle() is None:
+        import asyncio
+
+        return asyncio.sleep(seconds)
     t = _current_time()
     return Sleep(t.now_ns() + to_nanos(seconds), t)
 
@@ -271,9 +281,19 @@ Elapsed = TimeoutError_
 
 
 async def timeout(seconds: float, awaitable: Coroutine[Any, Any, Any] | Any) -> Any:
-    """Run `awaitable` with a virtual-time deadline; raise Elapsed on expiry."""
+    """Run `awaitable` with a virtual-time deadline; raise Elapsed on expiry.
+
+    Production (non-sim) mode: real asyncio.wait_for, re-raising Elapsed."""
     from .futures import Future
     from . import context
+
+    if context.try_current_handle() is None:
+        import asyncio
+
+        try:
+            return await asyncio.wait_for(awaitable, seconds)
+        except asyncio.TimeoutError:
+            raise Elapsed() from None
 
     handle = context.current_handle()
     time = handle.time

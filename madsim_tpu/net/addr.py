@@ -51,6 +51,16 @@ async def lookup_host(addr: ToSocketAddrs) -> SocketAddr:
         return (LOCALHOST, port)
     if is_ip_literal(host):
         return (host, port)
+    from ..core import context
+
+    if context.try_current_handle() is None:
+        # production mode: real DNS
+        import socket
+
+        infos = socket.getaddrinfo(host, port, type=socket.SOCK_STREAM)
+        if not infos:
+            raise OSError(f"failed to lookup address information: {host!r}")
+        return (infos[0][4][0], port)
     from .netsim import NetSim
     from ..core.plugin import simulator
 

@@ -143,12 +143,21 @@ class Endpoint:
 
     @staticmethod
     async def bind(addr: ToSocketAddrs) -> "Endpoint":
+        if context.try_current_handle() is None:
+            # production mode: same API over real TCP (std/net/tcp.rs analog)
+            from ..real.net import RealEndpoint
+
+            return await RealEndpoint.bind(addr)  # type: ignore[return-value]
         socket = EndpointSocket()
         guard = await BindGuard.bind(addr, UDP, socket)
         return Endpoint(guard, socket)
 
     @staticmethod
     async def connect(addr: ToSocketAddrs) -> "Endpoint":
+        if context.try_current_handle() is None:
+            from ..real.net import RealEndpoint
+
+            return await RealEndpoint.connect(addr)  # type: ignore[return-value]
         peer = await lookup_host(addr)
         ep = await Endpoint.bind(("0.0.0.0", 0))
         ep._peer = peer

@@ -54,8 +54,13 @@ async def call_with_data(
     ep: Endpoint, dst: ToSocketAddrs, req: Any, data: bytes
 ) -> Tuple[Any, bytes]:
     """Request + raw data payload; returns (response, response data)."""
-    handle = context.current_handle()
-    rsp_tag = handle.rng.next_u64()
+    handle = context.try_current_handle()
+    if handle is not None:
+        rsp_tag = handle.rng.next_u64()
+    else:  # production mode: any unique tag works
+        import os as _os
+
+        rsp_tag = int.from_bytes(_os.urandom(8), "little")
     resolved = await lookup_host(dst)
     await ep.send_to_raw(resolved, _rpc_id(type(req)), (rsp_tag, req, bytes(data)))
     try:

@@ -1,0 +1,17 @@
+"""Production (non-sim) mode: the same APIs against real OS resources.
+
+Analog of the reference's `std/` tree (madsim/src/std/, selected by the
+lib.rs:14-23 cfg switch): the tag-matching `Endpoint` runs over real TCP
+with length-delimited frames (std/net/tcp.rs:22-325), tasks run on asyncio,
+and time is the wall clock. User code written against madsim_tpu — spawn,
+time.sleep/timeout, Endpoint, rpc, the gRPC facade — runs unmodified:
+every entry point dispatches on the TLS simulation context, so "inside a
+Runtime" means simulation and "under plain asyncio" means production.
+
+    # same service/client code as the simulated cluster:
+    from madsim_tpu import real
+    real.run(serve("127.0.0.1:50051"))     # = asyncio.run
+"""
+
+from .net import RealEndpoint  # noqa: F401
+from .runtime import run, real_spawn, RealJoinHandle  # noqa: F401

@@ -1,0 +1,243 @@
+"""Real-network Endpoint: tag-matching over TCP with length-delimited frames.
+
+Analog of reference std/net/tcp.rs:22-325 (the production backend of the
+same Endpoint API): every peer pair communicates over TCP connections
+carrying 4-byte-length-prefixed pickled frames (the LengthDelimitedCodec
+analog). Two connection kinds, declared by a hello frame:
+
+    ("dgram", sender_addr)   — a cached pipe for tagged datagrams
+                               (frames: (tag, payload)); replies go to the
+                               sender's advertised bound address
+    ("conn1", sender_addr)   — one reliable ordered stream (connect1/accept1),
+                               frames are raw payloads
+
+The mailbox tag-matching, rpc layer, and the gRPC facade are byte-for-byte
+the same code as in simulation — only this transport differs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+from ..core.sync import Channel, ChannelClosed
+from ..net.addr import SocketAddr, ToSocketAddrs, lookup_host
+from ..net.endpoint import Mailbox, _Message
+
+_LEN = struct.Struct(">I")
+
+
+def _write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    data = pickle.dumps(obj)
+    writer.write(_LEN.pack(len(data)) + data)
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    try:
+        header = await reader.readexactly(_LEN.size)
+        data = await reader.readexactly(_LEN.unpack(header)[0])
+    except (asyncio.IncompleteReadError, ConnectionError):
+        raise ChannelClosed("connection closed") from None
+    return pickle.loads(data)
+
+
+class RealPayloadSender:
+    """PayloadSender-compatible send half over a TCP stream."""
+
+    __slots__ = ("_writer",)
+
+    def __init__(self, writer: asyncio.StreamWriter) -> None:
+        self._writer = writer
+
+    def send(self, payload: Any) -> None:
+        if self._writer.is_closing():
+            raise ChannelClosed("connection closed")
+        _write_frame(self._writer, payload)
+
+    def is_closed(self) -> bool:
+        return self._writer.is_closing()
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+
+class RealPayloadReceiver:
+    """PayloadReceiver-compatible receive half over a TCP stream."""
+
+    __slots__ = ("_reader", "_writer")
+
+    def __init__(
+        self, reader: asyncio.StreamReader, writer: Optional[asyncio.StreamWriter]
+    ) -> None:
+        self._reader = reader
+        self._writer = writer
+
+    async def recv(self) -> Any:
+        return await _read_frame(self._reader)
+
+    async def try_recv_eof(self) -> Optional[Any]:
+        try:
+            return await self.recv()
+        except ChannelClosed:
+            return None
+
+    def close(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class RealEndpoint:
+    """The Endpoint API over real sockets (duck-type of net.Endpoint)."""
+
+    def __init__(self) -> None:
+        self._mailbox = Mailbox()
+        self._conn_chan: Channel = Channel()  # (tx, rx, peer_addr)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._addr: Optional[SocketAddr] = None
+        self._peer: Optional[SocketAddr] = None
+        # dst -> (writer, pipe task) cache for datagram pipes
+        self._pipes: Dict[SocketAddr, asyncio.StreamWriter] = {}
+
+    # -- constructors --
+
+    @staticmethod
+    async def bind(addr: ToSocketAddrs) -> "RealEndpoint":
+        host, port = await lookup_host(addr)
+        ep = RealEndpoint()
+        ep._server = await asyncio.start_server(ep._on_connection, host, port)
+        sock = ep._server.sockets[0]
+        ep._addr = (host, sock.getsockname()[1])
+        return ep
+
+    @staticmethod
+    async def connect(addr: ToSocketAddrs) -> "RealEndpoint":
+        peer = await lookup_host(addr)
+        ep = await RealEndpoint.bind(("127.0.0.1", 0))
+        ep._peer = peer
+        return ep
+
+    # -- properties --
+
+    def local_addr(self) -> SocketAddr:
+        if self._addr is None:
+            raise OSError("endpoint is not bound")
+        return self._addr
+
+    def peer_addr(self) -> SocketAddr:
+        if self._peer is None:
+            raise OSError("not connected")
+        return self._peer
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for w in self._pipes.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._pipes.clear()
+        self._conn_chan.close()
+
+    def __enter__(self) -> "RealEndpoint":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()
+
+    # -- server side --
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            hello = await _read_frame(reader)
+        except ChannelClosed:
+            writer.close()
+            return
+        kind, sender_addr = hello
+        if kind == "conn1":
+            tx = RealPayloadSender(writer)
+            rx = RealPayloadReceiver(reader, writer)
+            try:
+                self._conn_chan.send_nowait((tx, rx, tuple(sender_addr)))
+            except (ChannelClosed, RuntimeError):
+                writer.close()
+            return
+        # datagram pipe: pump frames into the mailbox
+        from_addr = tuple(sender_addr)
+        while True:
+            try:
+                tag, payload = await _read_frame(reader)
+            except ChannelClosed:
+                writer.close()
+                return
+            self._mailbox.deliver(_Message(tag, payload, from_addr))
+
+    # -- tagged datagrams (same surface as sim Endpoint) --
+
+    async def send_to(self, dst: ToSocketAddrs, tag: int, buf: bytes) -> None:
+        resolved = await lookup_host(dst)
+        await self.send_to_raw(resolved, tag, bytes(buf))
+
+    async def recv_from(self, tag: int) -> Tuple[bytes, SocketAddr]:
+        data, from_addr = await self.recv_from_raw(tag)
+        if not isinstance(data, (bytes, bytearray)):
+            raise TypeError("message is not data")
+        return bytes(data), from_addr
+
+    async def send(self, tag: int, buf: bytes) -> None:
+        await self.send_to(self.peer_addr(), tag, buf)
+
+    async def recv(self, tag: int) -> bytes:
+        peer = self.peer_addr()
+        data, from_addr = await self.recv_from(tag)
+        if from_addr != peer:
+            raise OSError(
+                f"received a message from {from_addr}, not from the connected "
+                f"address {peer}"
+            )
+        return data
+
+    async def send_to_raw(self, dst: SocketAddr, tag: int, data: Any) -> None:
+        writer = self._pipes.get(dst)
+        if writer is None or writer.is_closing():
+            reader, writer = await asyncio.open_connection(dst[0], dst[1])
+            _write_frame(writer, ("dgram", self.local_addr()))
+            self._pipes[dst] = writer
+        _write_frame(writer, (tag, data))
+        await writer.drain()
+
+    async def recv_from_raw(self, tag: int) -> Tuple[Any, SocketAddr]:
+        msg = await self._mailbox.recv(tag)
+        return msg.data, msg.from_addr
+
+    def forget_tag(self, tag: int) -> None:
+        self._mailbox.forget(tag)
+
+    # -- reliable connections --
+
+    async def connect1(
+        self, dst: ToSocketAddrs
+    ) -> Tuple[RealPayloadSender, RealPayloadReceiver, SocketAddr]:
+        resolved = await lookup_host(dst)
+        reader, writer = await asyncio.open_connection(resolved[0], resolved[1])
+        _write_frame(writer, ("conn1", self.local_addr()))
+        return (
+            RealPayloadSender(writer),
+            RealPayloadReceiver(reader, writer),
+            resolved,
+        )
+
+    async def accept1(
+        self,
+    ) -> Tuple[RealPayloadSender, RealPayloadReceiver, SocketAddr]:
+        return await self._conn_chan.recv()

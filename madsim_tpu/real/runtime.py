@@ -1,0 +1,49 @@
+"""Production-mode task facade: asyncio under the same spawn/join surface.
+
+Analog of madsim-tokio's non-sim side (`pub use tokio::*`,
+madsim-tokio/src/lib.rs:1-6): `run()` is the `#[madsim::main]`-in-real-mode
+entry (= tokio::main = asyncio.run), and `real_spawn` backs
+`madsim_tpu.spawn` when no simulation context is active.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Coroutine, Optional
+
+from ..core.task import JoinError
+
+
+class RealJoinHandle:
+    """JoinHandle-compatible wrapper over an asyncio.Task."""
+
+    __slots__ = ("_task",)
+
+    def __init__(self, task: asyncio.Task) -> None:
+        self._task = task
+
+    def __await__(self):
+        return self._gather().__await__()
+
+    async def _gather(self) -> Any:
+        try:
+            return await self._task
+        except asyncio.CancelledError:
+            raise JoinError("task was cancelled", cancelled=True) from None
+
+    def abort(self) -> None:
+        self._task.cancel()
+
+    def is_finished(self) -> bool:
+        return self._task.done()
+
+
+def real_spawn(
+    coro: Coroutine[Any, Any, Any], *, name: Optional[str] = None
+) -> RealJoinHandle:
+    return RealJoinHandle(asyncio.get_running_loop().create_task(coro, name=name))
+
+
+def run(coro: Coroutine[Any, Any, Any]) -> Any:
+    """Run a production-mode main (asyncio.run; `#[madsim::main]` real side)."""
+    return asyncio.run(coro)

@@ -31,11 +31,21 @@ from .status import Status
 # reference carries it on the request itself (madsim-tonic/src/sim.rs:20-42).
 _METADATA_KEY = "grpc_request_metadata"
 
+# production mode: request metadata rides a ContextVar (asyncio-task-scoped);
+# sim mode uses task_locals on the DES task instead
+import contextvars
+
+_real_metadata: "contextvars.ContextVar[Optional[Dict[str, str]]]" = (
+    contextvars.ContextVar("grpc_request_metadata", default=None)
+)
+
 
 def current_metadata() -> Dict[str, str]:
     """Metadata of the request the current task is handling."""
     task = context.try_current_task()
-    if task is None or task.task_locals is None:
+    if task is None:
+        return _real_metadata.get() or {}
+    if task.task_locals is None:
         return {}
     return task.task_locals.get(_METADATA_KEY) or {}
 
@@ -126,10 +136,13 @@ class Server:
             )
             return
 
-        task = context.current_task()
-        if task.task_locals is None:
-            task.task_locals = {}
-        task.task_locals[_METADATA_KEY] = metadata or {}
+        task = context.try_current_task()
+        if task is not None:
+            if task.task_locals is None:
+                task.task_locals = {}
+            task.task_locals[_METADATA_KEY] = metadata or {}
+        else:  # production mode: one asyncio task per connection
+            _real_metadata.set(metadata or {})
         try:
             if mode == svc_mod.UNARY:
                 rsp = await handler(payload)

@@ -51,6 +51,11 @@ class Status(Exception):
     def code_name(self) -> str:
         return Code._NAMES.get(self.code, str(self.code))
 
+    def __reduce__(self):
+        # default Exception pickling would re-init with (message,) as the
+        # code argument; needed in production mode (statuses cross real TCP)
+        return (Status, (self.code, self.message, self.metadata))
+
     def __repr__(self) -> str:
         return f"Status(code={self.code_name()}, message={self.message!r})"
 

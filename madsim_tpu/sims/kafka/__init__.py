@@ -1,0 +1,29 @@
+"""Kafka simulation: in-sim broker + producers/consumers/admin.
+
+Analog of reference madsim-rdkafka's sim side (src/sim/, 2603 LoC): a
+`SimBroker` serving topics/partitions/offsets/watermarks/fetch over the
+simulated network, with `BaseProducer` (buffered sends + flush),
+`BaseConsumer`/`StreamConsumer` (assign/subscribe + poll/stream), and
+`AdminClient` (create_topics) configured through the familiar
+`ClientConfig` key-value API.
+
+    broker.spawn(SimBroker().serve("10.0.0.1:9092"))
+    producer = await ClientConfig({"bootstrap.servers": "10.0.0.1:9092"}).create_producer()
+    producer.send(BaseRecord.to("topic").with_key(b"k").with_payload(b"v"))
+    await producer.flush()
+"""
+
+from .broker import Broker, FetchOptions, OwnedMessage, OwnedRecord  # noqa: F401
+from .client import (  # noqa: F401
+    AdminClient,
+    AdminOptions,
+    BaseConsumer,
+    BaseProducer,
+    BaseRecord,
+    ClientConfig,
+    NewTopic,
+    StreamConsumer,
+)
+from .errors import KafkaError  # noqa: F401
+from .server import SimBroker  # noqa: F401
+from .tpl import OFFSET_BEGINNING, OFFSET_END, OFFSET_INVALID, TopicPartitionList  # noqa: F401

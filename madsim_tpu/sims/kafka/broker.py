@@ -1,0 +1,204 @@
+"""The broker state machine: topics, partitions, offsets, watermarks, fetch.
+
+Analog of reference madsim-rdkafka/src/sim/broker.rs:14-213. One divergence,
+deliberate: the reference round-robins every record across partitions and
+ignores `BaseRecord.partition` entirely; here an explicit partition (or a
+key hash, like real Kafka) wins, with round-robin as the keyless fallback —
+otherwise keyed ordering tests can't be written at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .errors import (
+    KafkaError,
+    invalid_timestamp,
+    no_offset,
+    unknown_partition,
+    unknown_topic,
+)
+from .tpl import OFFSET_BEGINNING, OFFSET_END, OFFSET_INVALID, TopicPartitionList
+
+
+@dataclasses.dataclass
+class OwnedMessage:
+    """A stored record (reference src/sim/message.rs OwnedMessage)."""
+
+    payload: Optional[bytes]
+    key: Optional[bytes]
+    topic: str
+    timestamp: Optional[int]  # ms since epoch (CreateTime), None = unavailable
+    partition: int
+    offset: int
+    headers: Optional[Dict[str, bytes]] = None
+
+    def size(self) -> int:
+        return (
+            len(self.payload or b"")
+            + len(self.key or b"")
+            + sum(len(k) + len(v) for k, v in (self.headers or {}).items())
+        )
+
+
+@dataclasses.dataclass
+class OwnedRecord:
+    """A record to produce (reference broker.rs:232-252)."""
+
+    topic: str
+    partition: Optional[int] = None
+    payload: Optional[bytes] = None
+    key: Optional[bytes] = None
+    timestamp: Optional[int] = None
+    headers: Optional[Dict[str, bytes]] = None
+
+
+@dataclasses.dataclass
+class FetchOptions:
+    """reference broker.rs:254-275."""
+
+    max_partition_fetch_bytes: int = 1_048_576  # 1 MiB
+    fetch_max_bytes: int = 52_428_800  # 50 MiB
+
+
+class _Partition:
+    def __init__(self, id: int) -> None:
+        self.id = id
+        self.log_end_offset = 0
+        self.low_watermark = 0
+        self.high_watermark = 0
+        self.msgs: List[OwnedMessage] = []
+
+    def offset_for_time(self, timestamp: int) -> Optional[int]:
+        """Earliest offset whose timestamp >= the given one (broker.rs:46-59)."""
+        for msg in self.msgs:
+            if (msg.timestamp or 0) >= timestamp:
+                return msg.offset
+        return None
+
+
+class _Topic:
+    def __init__(self, name: str, partitions: int) -> None:
+        self.name = name
+        self.partitions = [_Partition(i) for i in range(partitions)]
+        self.last_partition = 0
+
+
+class Broker:
+    """Topics + partitions + message logs (broker.rs:14-31)."""
+
+    def __init__(self) -> None:
+        self.topics: Dict[str, _Topic] = {}
+
+    def create_topic(self, name: str, partitions: int) -> None:
+        self.topics[name] = _Topic(name, partitions)
+
+    def produce(self, records: List[OwnedRecord]) -> None:
+        for record in records:
+            self._produce_one(record)
+
+    def _produce_one(self, record: OwnedRecord) -> None:
+        topic = self.topics.get(record.topic)
+        if topic is None:
+            raise unknown_topic(record.topic)
+        n = len(topic.partitions)
+        if record.partition is not None:
+            if not 0 <= record.partition < n:
+                raise unknown_partition(record.topic, record.partition)
+            idx = record.partition
+        elif record.key is not None:
+            # stable key hash (Python's hash() is salted per process)
+            import zlib
+
+            idx = zlib.crc32(record.key) % n
+        else:
+            idx = topic.last_partition
+            topic.last_partition = (topic.last_partition + 1) % n
+        partition = topic.partitions[idx]
+        msg = OwnedMessage(
+            payload=record.payload,
+            key=record.key,
+            topic=record.topic,
+            timestamp=record.timestamp,
+            partition=idx,
+            offset=partition.log_end_offset,
+            headers=record.headers,
+        )
+        partition.msgs.append(msg)
+        partition.log_end_offset += 1
+        partition.high_watermark = partition.log_end_offset
+
+    def fetch(
+        self, tpl: TopicPartitionList, opts: Optional[FetchOptions] = None
+    ) -> List[OwnedMessage]:
+        """Fetch from each element's offset, advancing the tpl offsets
+        (broker.rs:113-160). Size caps bound the batch."""
+        opts = opts or FetchOptions()
+        rets: List[OwnedMessage] = []
+        total_bytes = 0
+        for e in tpl.list:
+            partition = self._get_partition(e.topic, e.partition)
+            msgs = partition.msgs
+            if not msgs:
+                continue
+            if e.offset == OFFSET_BEGINNING:
+                start = 0
+            elif e.offset == OFFSET_END:
+                start = len(msgs) - 1
+            elif e.offset == OFFSET_INVALID:
+                raise no_offset()
+            else:
+                start = sum(1 for m in msgs if m.offset < e.offset)
+            bytes_in_partition = 0
+            for msg in msgs[start:]:
+                size = msg.size()
+                if msg.offset >= partition.high_watermark:
+                    continue
+                if (
+                    total_bytes + size > opts.fetch_max_bytes
+                    or bytes_in_partition + size > opts.max_partition_fetch_bytes
+                ):
+                    return rets
+                e.offset = msg.offset + 1
+                rets.append(msg)
+                total_bytes += size
+                bytes_in_partition += size
+        return rets
+
+    def metadata(self) -> Dict[str, List[int]]:
+        """topic -> partition ids (reference Metadata, broker.rs:162-166)."""
+        return {
+            name: [p.id for p in t.partitions] for name, t in self.topics.items()
+        }
+
+    def metadata_of_topic(self, topic: str) -> Dict[str, List[int]]:
+        t = self.topics.get(topic)
+        if t is None:
+            raise unknown_topic(topic)
+        return {topic: [p.id for p in t.partitions]}
+
+    def fetch_watermarks(self, topic: str, partition: int) -> Tuple[int, int]:
+        p = self._get_partition(topic, partition)
+        return (p.low_watermark, p.high_watermark)
+
+    def offsets_for_times(self, tpl: TopicPartitionList) -> TopicPartitionList:
+        """tpl offsets are interpreted as timestamps (broker.rs:184-203)."""
+        ret = TopicPartitionList()
+        for e in tpl.list:
+            partition = self._get_partition(e.topic, e.partition)
+            if e.offset < 0:
+                raise invalid_timestamp()
+            offset = partition.offset_for_time(e.offset)
+            ret.add_partition_offset(
+                e.topic, e.partition, OFFSET_INVALID if offset is None else offset
+            )
+        return ret
+
+    def _get_partition(self, topic: str, partition: int) -> _Partition:
+        t = self.topics.get(topic)
+        if t is None:
+            raise unknown_topic(topic)
+        if not 0 <= partition < len(t.partitions):
+            raise unknown_partition(topic, partition)
+        return t.partitions[partition]

@@ -1,0 +1,283 @@
+"""Kafka clients: ClientConfig, producer, consumers, admin.
+
+Analog of reference madsim-rdkafka/src/sim/{client,config,producer,consumer,
+admin}.rs. `ClientConfig` is the rdkafka-style key-value bag; recognized keys:
+
+    bootstrap.servers         broker address (required)
+    auto.offset.reset         "earliest" (default) | "latest" — where
+                              subscribe() starts when no offset is stored
+    fetch.max.bytes / max.partition.fetch.bytes — fetch size caps
+
+Producers buffer records locally; `flush()` ships the batch (the inflight
+model of producer.rs:218-245). Consumers either `assign()` explicit
+partitions or `subscribe()` whole topics (partition discovery via metadata).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import AsyncIterator, Dict, List, Optional
+
+from ...core.sync import ChannelClosed
+from ...net import Endpoint
+from ...net.addr import lookup_host
+from .broker import FetchOptions, OwnedMessage, OwnedRecord
+from .errors import KafkaError
+from .tpl import OFFSET_BEGINNING, OFFSET_END, TopicPartitionList
+
+
+class BaseRecord:
+    """Fluent record builder (producer.rs:21-86)."""
+
+    def __init__(self, topic: str) -> None:
+        self.topic = topic
+        self.partition: Optional[int] = None
+        self.payload: Optional[bytes] = None
+        self.key: Optional[bytes] = None
+        self.timestamp: Optional[int] = None
+        self.headers: Optional[Dict[str, bytes]] = None
+
+    @staticmethod
+    def to(topic: str) -> "BaseRecord":
+        return BaseRecord(topic)
+
+    def with_partition(self, partition: int) -> "BaseRecord":
+        self.partition = partition
+        return self
+
+    def with_payload(self, payload) -> "BaseRecord":
+        self.payload = payload.encode() if isinstance(payload, str) else bytes(payload)
+        return self
+
+    def with_key(self, key) -> "BaseRecord":
+        self.key = key.encode() if isinstance(key, str) else bytes(key)
+        return self
+
+    def with_timestamp(self, timestamp_ms: int) -> "BaseRecord":
+        self.timestamp = timestamp_ms
+        return self
+
+    def with_headers(self, headers: Dict[str, bytes]) -> "BaseRecord":
+        self.headers = headers
+        return self
+
+    def _to_owned(self) -> OwnedRecord:
+        return OwnedRecord(
+            topic=self.topic,
+            partition=self.partition,
+            payload=self.payload,
+            key=self.key,
+            timestamp=self.timestamp,
+            headers=self.headers,
+        )
+
+
+class _Conn:
+    """One request over one connection (the SimBroker wire discipline)."""
+
+    def __init__(self, ep: Endpoint, addr) -> None:
+        self._ep = ep
+        self._addr = addr
+
+    async def call(self, request):
+        tx, rx, _ = await self._ep.connect1(self._addr)
+        tx.send(request)
+        try:
+            status, payload = await rx.recv()
+        except ChannelClosed as e:
+            raise KafkaError("broker connection closed", "Transport") from e
+        if status == "err":
+            raise payload
+        return payload
+
+
+class ClientConfig:
+    """rdkafka-style config bag (config.rs)."""
+
+    def __init__(self, conf: Optional[Dict[str, str]] = None) -> None:
+        self.conf: Dict[str, str] = dict(conf or {})
+
+    def set(self, key: str, value: str) -> "ClientConfig":
+        self.conf[key] = str(value)
+        return self
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self.conf.get(key, default)
+
+    async def _connect(self) -> _Conn:
+        servers = self.conf.get("bootstrap.servers")
+        if not servers:
+            raise KafkaError("bootstrap.servers is required", "InvalidConfig")
+        addr = await lookup_host(servers.split(",")[0].strip())
+        ep = await Endpoint.bind(("0.0.0.0", 0))
+        return _Conn(ep, addr)
+
+    async def create_producer(self) -> "BaseProducer":
+        return BaseProducer(await self._connect())
+
+    async def create_consumer(self) -> "BaseConsumer":
+        return BaseConsumer(await self._connect(), self)
+
+    async def create_stream_consumer(self) -> "StreamConsumer":
+        return StreamConsumer(await self._connect(), self)
+
+    async def create_admin(self) -> "AdminClient":
+        return AdminClient(await self._connect())
+
+
+class BaseProducer:
+    """Buffering producer (producer.rs:155-245): send() queues locally,
+    flush() ships the whole batch to the broker."""
+
+    def __init__(self, conn: _Conn) -> None:
+        self._conn = conn
+        self._queue: List[OwnedRecord] = []
+
+    def send(self, record: BaseRecord) -> None:
+        self._queue.append(record._to_owned())
+
+    async def flush(self, timeout: Optional[float] = None) -> None:
+        if not self._queue:
+            return
+        batch, self._queue = self._queue, []
+        try:
+            await self._conn.call(("produce", batch))
+        except BaseException:
+            self._queue = batch + self._queue  # retryable: batch not lost
+            raise
+
+    async def poll(self, timeout: Optional[float] = None) -> int:
+        """Deliver queued records; returns how many were shipped."""
+        n = len(self._queue)
+        await self.flush(timeout)
+        return n
+
+    def in_flight_count(self) -> int:
+        return len(self._queue)
+
+
+@dataclasses.dataclass
+class _ConsumerState:
+    tpl: TopicPartitionList = dataclasses.field(default_factory=TopicPartitionList)
+    subscribed: List[str] = dataclasses.field(default_factory=list)
+    buffer: List[OwnedMessage] = dataclasses.field(default_factory=list)
+
+
+class BaseConsumer:
+    """Pull consumer (consumer.rs:64-254): explicit assign() or topic
+    subscribe(); poll() returns one message or None when caught up."""
+
+    def __init__(self, conn: _Conn, config: ClientConfig) -> None:
+        self._conn = conn
+        self._config = config
+        self._state = _ConsumerState()
+        self._fetch_opts = FetchOptions(
+            fetch_max_bytes=int(config.get("fetch.max.bytes", "52428800")),
+            max_partition_fetch_bytes=int(
+                config.get("max.partition.fetch.bytes", "1048576")
+            ),
+        )
+
+    def assign(self, assignment: TopicPartitionList) -> None:
+        reset = self._initial_offset()
+        tpl = TopicPartitionList()
+        for e in assignment.list:
+            offset = e.offset if e.offset >= 0 else reset
+            tpl.add_partition_offset(e.topic, e.partition, offset)
+        self._state.tpl = tpl
+
+    def subscribe(self, topics: List[str]) -> None:
+        self._state.subscribed = list(topics)
+
+    def _initial_offset(self) -> int:
+        return (
+            OFFSET_END
+            if self._config.get("auto.offset.reset", "earliest") == "latest"
+            else OFFSET_BEGINNING
+        )
+
+    async def _resolve_subscription(self) -> None:
+        if not self._state.subscribed:
+            return
+        topics, self._state.subscribed = self._state.subscribed, []
+        reset = self._initial_offset()
+        for topic in topics:
+            meta = await self._conn.call(("fetch_metadata", topic))
+            for partition in meta[topic]:
+                self._state.tpl.add_partition_offset(topic, partition, reset)
+
+    async def poll(self, timeout: Optional[float] = None) -> Optional[OwnedMessage]:
+        """Next message, or None if nothing new is available."""
+        await self._resolve_subscription()
+        if not self._state.buffer:
+            if not self._state.tpl.list:
+                raise KafkaError("no partitions assigned", "NoAssignment")
+            msgs, tpl = await self._conn.call(("fetch", self._state.tpl, self._fetch_opts))
+            self._state.tpl = tpl  # offsets advanced by the broker
+            self._state.buffer.extend(msgs)
+        if self._state.buffer:
+            return self._state.buffer.pop(0)
+        return None
+
+    async def fetch_watermarks(self, topic: str, partition: int):
+        return await self._conn.call(("fetch_watermarks", topic, partition))
+
+    async def offsets_for_times(self, tpl: TopicPartitionList) -> TopicPartitionList:
+        return await self._conn.call(("offsets_for_times", tpl))
+
+    async def fetch_metadata(self, topic: Optional[str] = None):
+        return await self._conn.call(("fetch_metadata", topic))
+
+
+class StreamConsumer(BaseConsumer):
+    """Async-iterating consumer (consumer.rs:256-301 + MessageStream)."""
+
+    def stream(self, idle_wait: float = 0.05) -> "MessageStream":
+        return MessageStream(self, idle_wait)
+
+
+class MessageStream:
+    """Endless async iterator over a StreamConsumer's messages."""
+
+    def __init__(self, consumer: StreamConsumer, idle_wait: float) -> None:
+        self._consumer = consumer
+        self._idle_wait = idle_wait
+
+    def __aiter__(self) -> "AsyncIterator[OwnedMessage]":
+        return self
+
+    async def __anext__(self) -> OwnedMessage:
+        from ...core.vtime import sleep
+
+        while True:
+            msg = await self._consumer.poll()
+            if msg is not None:
+                return msg
+            await sleep(self._idle_wait)
+
+
+@dataclasses.dataclass
+class NewTopic:
+    """admin.rs:155-188 (replication is accepted and ignored, like the sim)."""
+
+    name: str
+    num_partitions: int
+    replication: int = 1
+
+
+@dataclasses.dataclass
+class AdminOptions:
+    request_timeout: Optional[float] = None
+
+
+class AdminClient:
+    """admin.rs:66-112."""
+
+    def __init__(self, conn: _Conn) -> None:
+        self._conn = conn
+
+    async def create_topics(
+        self, topics: List[NewTopic], options: Optional[AdminOptions] = None
+    ) -> None:
+        for t in topics:
+            await self._conn.call(("create_topic", t.name, t.num_partitions))

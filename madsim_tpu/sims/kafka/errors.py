@@ -1,0 +1,31 @@
+"""Kafka error type (reference madsim-rdkafka/src/sim/error.rs)."""
+
+from __future__ import annotations
+
+
+class KafkaError(Exception):
+    """A Kafka operation failed; `code` is an RDKafkaErrorCode-style name."""
+
+    def __init__(self, message: str, code: str = "Unknown") -> None:
+        super().__init__(f"{code}: {message}" if code != "Unknown" else message)
+        self.message = message
+        self.code = code
+
+    def __reduce__(self):
+        return (type(self), (self.message, self.code))
+
+
+def unknown_topic(name: str) -> KafkaError:
+    return KafkaError(f"unknown topic: {name}", "UnknownTopic")
+
+
+def unknown_partition(topic: str, partition: int) -> KafkaError:
+    return KafkaError(f"unknown partition: {topic}/{partition}", "UnknownPartition")
+
+
+def no_offset() -> KafkaError:
+    return KafkaError("no offset stored", "NoOffset")
+
+
+def invalid_timestamp() -> KafkaError:
+    return KafkaError("invalid timestamp", "InvalidTimestamp")

@@ -1,0 +1,72 @@
+"""SimBroker: the broker served over the simulated network.
+
+Analog of reference madsim-rdkafka/src/sim/sim_broker.rs:14-77: one request
+per `connect1` connection, wire enum as plain tuples, responses
+("ok", value) or ("err", KafkaError).
+"""
+
+from __future__ import annotations
+
+from ...core import task as task_mod
+from ...core.sync import ChannelClosed
+from ...net import Endpoint
+from .broker import Broker, FetchOptions
+from .errors import KafkaError
+
+
+class SimBroker:
+    """A simulated Kafka broker (sim_broker.rs:10-50)."""
+
+    def __init__(self) -> None:
+        self._broker = Broker()
+
+    async def serve(self, addr) -> None:
+        ep = await Endpoint.bind(addr)
+        while True:
+            try:
+                tx, rx, _peer = await ep.accept1()
+            except ChannelClosed:
+                return
+            task_mod.spawn(self._serve_conn(tx, rx), name="kafka-conn")
+
+    async def _serve_conn(self, tx, rx) -> None:
+        try:
+            request = await rx.recv()
+        except ChannelClosed:
+            return
+        op, *args = request
+        b = self._broker
+        try:
+            if op == "create_topic":
+                name, partitions = args
+                b.create_topic(name, partitions)
+                rsp = None
+            elif op == "produce":
+                (records,) = args
+                b.produce(records)
+                rsp = None
+            elif op == "fetch":
+                tpl, opts = args
+                msgs = b.fetch(tpl, opts or FetchOptions())
+                rsp = (msgs, tpl)  # tpl comes back with advanced offsets
+            elif op == "fetch_metadata":
+                (topic,) = args
+                rsp = b.metadata() if topic is None else b.metadata_of_topic(topic)
+            elif op == "fetch_watermarks":
+                topic, partition = args
+                rsp = b.fetch_watermarks(topic, partition)
+            elif op == "offsets_for_times":
+                (tpl,) = args
+                rsp = b.offsets_for_times(tpl)
+            else:
+                raise KafkaError(f"unknown request: {op}")
+        except KafkaError as e:
+            try:
+                tx.send(("err", e))
+            except ChannelClosed:
+                pass
+            return
+        try:
+            tx.send(("ok", rsp))
+        except ChannelClosed:
+            pass

@@ -1,0 +1,39 @@
+"""TopicPartitionList: (topic, partition, offset) triples.
+
+Analog of reference madsim-rdkafka/src/sim/topic_partition_list.rs. Offsets
+use librdkafka's integer sentinels: OFFSET_BEGINNING (-2), OFFSET_END (-1),
+OFFSET_INVALID (-1001); any value >= 0 is a concrete offset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+OFFSET_BEGINNING = -2
+OFFSET_END = -1
+OFFSET_INVALID = -1001
+
+
+@dataclasses.dataclass
+class TopicPartitionListElem:
+    topic: str
+    partition: int
+    offset: int = OFFSET_INVALID
+
+
+@dataclasses.dataclass
+class TopicPartitionList:
+    list: List[TopicPartitionListElem] = dataclasses.field(default_factory=list)
+
+    def add_partition(self, topic: str, partition: int) -> None:
+        self.list.append(TopicPartitionListElem(topic, partition))
+
+    def add_partition_offset(self, topic: str, partition: int, offset: int) -> None:
+        self.list.append(TopicPartitionListElem(topic, partition, offset))
+
+    def count(self) -> int:
+        return len(self.list)
+
+    def elements(self) -> List[TopicPartitionListElem]:
+        return list(self.list)

@@ -1,0 +1,23 @@
+"""S3 simulation: in-sim object store + client over the simulated network.
+
+Analog of reference madsim-aws-sdk-s3 (1520 LoC): buckets, objects,
+multipart upload assembly, ranged gets, list-objects-v2, bucket lifecycle
+configuration — an `S3Service` served over the Endpoint connection API plus
+a pythonic `Client` mirroring the fluent aws-sdk surface.
+
+    server.spawn(S3Server().serve("10.0.0.1:9000"))
+    s3 = await Client.connect("10.0.0.1:9000")
+    await s3.create_bucket("b")
+    await s3.put_object("b", "k", b"data")
+    out = await s3.get_object("b", "k", range="bytes=1-3")
+"""
+
+from .client import Client  # noqa: F401
+from .errors import (  # noqa: F401
+    NoSuchBucket,
+    NoSuchKey,
+    NoSuchUpload,
+    S3Error,
+)
+from .service import LifecycleRule, ObjectInfo, S3Service  # noqa: F401
+from .server import S3Server  # noqa: F401

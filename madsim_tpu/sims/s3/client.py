@@ -1,0 +1,110 @@
+"""S3 client: the aws-sdk fluent surface, pythonically.
+
+Analog of reference src/client.rs + src/operation/ fluent builders: each
+operation is one method with keyword options, shipped as one request over
+one `connect1` connection (the rpc_server wire discipline).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ...core.sync import ChannelClosed
+from ...net import Endpoint
+from .errors import S3Error
+from .service import LifecycleRule, ObjectInfo
+
+
+class Client:
+    """Async S3 client over the simulated network."""
+
+    def __init__(self, ep: Endpoint, server_addr) -> None:
+        self._ep = ep
+        self._addr = server_addr
+
+    @staticmethod
+    async def connect(addr) -> "Client":
+        ep = await Endpoint.connect(addr)
+        return Client(ep, ep.peer_addr())
+
+    async def _call(self, request):
+        tx, rx, _ = await self._ep.connect1(self._addr)
+        tx.send(request)
+        try:
+            status, payload = await rx.recv()
+        except ChannelClosed as e:
+            raise S3Error("s3 server connection closed") from e
+        if status == "err":
+            raise payload
+        return payload
+
+    # -- buckets / objects --
+
+    async def create_bucket(self, bucket: str) -> None:
+        await self._call(("create_bucket", bucket))
+
+    async def put_object(self, bucket: str, key: str, body: bytes) -> None:
+        await self._call(("put_object", bucket, key, bytes(body)))
+
+    async def get_object(
+        self,
+        bucket: str,
+        key: str,
+        range: Optional[str] = None,
+        part_number: Optional[int] = None,
+    ) -> bytes:
+        return await self._call(("get_object", bucket, key, range, part_number))
+
+    async def head_object(self, bucket: str, key: str) -> Tuple[int, Optional[float]]:
+        return await self._call(("head_object", bucket, key))
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        await self._call(("delete_object", bucket, key))
+
+    async def delete_objects(self, bucket: str, keys: List[str]) -> None:
+        await self._call(("delete_objects", bucket, list(keys)))
+
+    async def list_objects_v2(
+        self, bucket: str, prefix: Optional[str] = None
+    ) -> List[ObjectInfo]:
+        return await self._call(("list_objects_v2", bucket, prefix))
+
+    # -- multipart --
+
+    async def create_multipart_upload(self, bucket: str, key: str) -> str:
+        return await self._call(("create_multipart_upload", bucket, key))
+
+    async def upload_part(
+        self, bucket: str, key: str, upload_id: str, part_number: int, body: bytes
+    ) -> str:
+        return await self._call(
+            ("upload_part", bucket, key, upload_id, part_number, bytes(body))
+        )
+
+    async def complete_multipart_upload(
+        self,
+        bucket: str,
+        key: str,
+        upload_id: str,
+        parts: List[Tuple[int, Optional[str]]],
+    ) -> None:
+        await self._call(
+            ("complete_multipart_upload", bucket, key, upload_id, list(parts))
+        )
+
+    async def abort_multipart_upload(
+        self, bucket: str, key: str, upload_id: str
+    ) -> None:
+        await self._call(("abort_multipart_upload", bucket, key, upload_id))
+
+    # -- lifecycle --
+
+    async def get_bucket_lifecycle_configuration(
+        self, bucket: str
+    ) -> List[LifecycleRule]:
+        return await self._call(("get_bucket_lifecycle_configuration", bucket))
+
+    async def put_bucket_lifecycle_configuration(
+        self, bucket: str, rules: List[LifecycleRule]
+    ) -> None:
+        await self._call(("put_bucket_lifecycle_configuration", bucket, list(rules)))

@@ -1,0 +1,44 @@
+"""S3 error types (reference src/server/service.rs:608-625 error ctors)."""
+
+from __future__ import annotations
+
+
+class S3Error(Exception):
+    def __reduce__(self):
+        return (type(self), tuple(self.args))
+
+
+class NoSuchBucket(S3Error):
+    def __init__(self, bucket: str) -> None:
+        super().__init__(f"no such bucket: {bucket}")
+        self.bucket = bucket
+
+    def __reduce__(self):
+        return (NoSuchBucket, (self.bucket,))
+
+
+class NoSuchKey(S3Error):
+    def __init__(self, key: str) -> None:
+        super().__init__(f"no such key: {key}")
+        self.key = key
+
+    def __reduce__(self):
+        return (NoSuchKey, (self.key,))
+
+
+class NoSuchUpload(S3Error):
+    def __init__(self, upload_id: str) -> None:
+        super().__init__(f"no such upload: {upload_id}")
+        self.upload_id = upload_id
+
+    def __reduce__(self):
+        return (NoSuchUpload, (self.upload_id,))
+
+
+class InvalidRange(S3Error):
+    def __init__(self, detail: str) -> None:
+        super().__init__(f"invalid range: {detail}")
+        self.detail = detail
+
+    def __reduce__(self):
+        return (InvalidRange, (self.detail,))

@@ -1,0 +1,49 @@
+"""S3Server: the object store served over the simulated network.
+
+Analog of reference src/server/rpc_server.rs: one request per `connect1`
+connection, ("ok", value) / ("err", S3Error) responses.
+"""
+
+from __future__ import annotations
+
+from ...core import task as task_mod
+from ...core.sync import ChannelClosed
+from ...net import Endpoint
+from .errors import S3Error
+from .service import S3Service
+
+
+class S3Server:
+    def __init__(self) -> None:
+        self.service = S3Service()
+
+    async def serve(self, addr) -> None:
+        ep = await Endpoint.bind(addr)
+        while True:
+            try:
+                tx, rx, _peer = await ep.accept1()
+            except ChannelClosed:
+                return
+            task_mod.spawn(self._serve_conn(tx, rx), name="s3-conn")
+
+    async def _serve_conn(self, tx, rx) -> None:
+        try:
+            request = await rx.recv()
+        except ChannelClosed:
+            return
+        op, *args = request
+        try:
+            method = getattr(self.service, op, None)
+            if method is None or op.startswith("_"):
+                raise S3Error(f"unknown request: {op}")
+            rsp = method(*args)
+        except (S3Error, ValueError) as e:
+            try:
+                tx.send(("err", e))
+            except ChannelClosed:
+                pass
+            return
+        try:
+            tx.send(("ok", rsp))
+        except ChannelClosed:
+            pass

@@ -1,0 +1,234 @@
+"""The S3 state machine (reference src/server/service.rs:203-606).
+
+Buckets map keys to objects; an object is complete (visible) after
+put_object or complete_multipart_upload. Multipart uploads accumulate
+e-tagged parts per upload id and assemble in part-number order on
+completion. Ranged gets follow RFC 9110 `bytes=` semantics. The reference
+leaves get-by-part-number a todo!(); here it returns that part's bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ...core import context
+from .errors import InvalidRange, NoSuchBucket, NoSuchKey, NoSuchUpload
+
+
+@dataclasses.dataclass
+class LifecycleRule:
+    """One bucket lifecycle rule (id + expiration days; enough for parity
+    tests — the reference stores aws-sdk rule structs opaquely)."""
+
+    id: str = ""
+    expiration_days: Optional[int] = None
+    prefix: str = ""
+    status: str = "Enabled"
+
+
+@dataclasses.dataclass
+class ObjectInfo:
+    """list_objects_v2 entry (reference types::Object)."""
+
+    key: str
+    size: int
+    last_modified: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _Part:
+    part_number: int
+    body: bytes
+    e_tag: str
+
+
+class _Object:
+    __slots__ = ("body", "completed", "parts", "last_modified")
+
+    def __init__(self) -> None:
+        self.body = b""
+        self.completed = False
+        self.parts: Dict[str, List[_Part]] = {}  # upload_id -> parts
+        self.last_modified: Optional[float] = None
+
+
+def _parse_range(range_header: str, body: bytes) -> bytes:
+    """RFC 9110 bytes= range (service.rs:386-419)."""
+    unit, _, range_set = range_header.partition("=")
+    if unit != "bytes" or not range_set:
+        raise InvalidRange(range_header)
+    begin_str, sep, end_str = range_set.partition("-")
+    if not sep:
+        raise InvalidRange(range_header)
+    try:
+        begin = int(begin_str) if begin_str else None
+        end = int(end_str) if end_str else None
+    except ValueError:
+        raise InvalidRange(range_header) from None
+    if begin is not None and end is not None:
+        return body[begin : end + 1]
+    if begin is not None:
+        return body[begin:]
+    if end is not None:  # suffix form: last N bytes
+        return body[len(body) - end :]
+    raise InvalidRange(range_header)
+
+
+class S3Service:
+    """Synchronous object-store state machine."""
+
+    def __init__(self) -> None:
+        # bucket -> key -> object
+        self.storage: Dict[str, Dict[str, _Object]] = {}
+        self.lifecycle: Dict[str, List[LifecycleRule]] = {}
+
+    # -- buckets --
+
+    def create_bucket(self, name: str) -> None:
+        if name in self.storage:
+            raise ValueError(f"bucket already exists: {name}")
+        self.storage[name] = {}
+
+    def _bucket(self, name: str) -> Dict[str, _Object]:
+        bucket = self.storage.get(name)
+        if bucket is None:
+            raise NoSuchBucket(name)
+        return bucket
+
+    def _object(self, bucket: str, key: str) -> _Object:
+        obj = self._bucket(bucket).get(key)
+        if obj is None:
+            raise NoSuchKey(key)
+        return obj
+
+    # -- plain objects --
+
+    def put_object(self, bucket: str, key: str, body: bytes) -> None:
+        obj = self._bucket(bucket).setdefault(key, _Object())
+        obj.body = bytes(body)
+        obj.completed = True
+        obj.last_modified = self._now()
+
+    def get_object(
+        self,
+        bucket: str,
+        key: str,
+        range: Optional[str] = None,
+        part_number: Optional[int] = None,
+    ) -> bytes:
+        obj = self._object(bucket, key)
+        if not obj.completed:
+            raise NoSuchKey(key)
+        if range is not None:
+            return _parse_range(range, obj.body)
+        if part_number is not None:
+            raise InvalidRange(f"part number gets need an active upload: {part_number}")
+        return obj.body
+
+    def head_object(self, bucket: str, key: str) -> Tuple[int, Optional[float]]:
+        obj = self._object(bucket, key)
+        if not obj.completed:
+            raise NoSuchKey(key)
+        return (len(obj.body), obj.last_modified)
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._bucket(bucket).pop(key, None)
+
+    def delete_objects(self, bucket: str, keys: List[str]) -> None:
+        b = self._bucket(bucket)
+        for key in keys:
+            b.pop(key, None)
+
+    def list_objects_v2(
+        self, bucket: str, prefix: Optional[str] = None
+    ) -> List[ObjectInfo]:
+        b = self._bucket(bucket)
+        out = []
+        for key in sorted(b):
+            obj = b[key]
+            if not obj.completed:
+                continue
+            if prefix is not None and not key.startswith(prefix):
+                continue
+            out.append(
+                ObjectInfo(key=key, size=len(obj.body), last_modified=obj.last_modified)
+            )
+        return out
+
+    # -- multipart (service.rs:242-366) --
+
+    def create_multipart_upload(self, bucket: str, key: str) -> str:
+        obj = self._bucket(bucket).setdefault(key, _Object())
+        while True:
+            upload_id = str(self._rand_u32())
+            if upload_id not in obj.parts:
+                obj.parts[upload_id] = []
+                return upload_id
+
+    def upload_part(
+        self, bucket: str, key: str, upload_id: str, part_number: int, body: bytes
+    ) -> str:
+        obj = self._object(bucket, key)
+        parts = obj.parts.get(upload_id)
+        if parts is None:
+            raise NoSuchUpload(upload_id)
+        e_tag = str(self._rand_u32())
+        parts.append(_Part(part_number, bytes(body), e_tag))
+        return e_tag
+
+    def complete_multipart_upload(
+        self,
+        bucket: str,
+        key: str,
+        upload_id: str,
+        completed_parts: List[Tuple[int, Optional[str]]],
+    ) -> None:
+        """Assemble parts in part-number order; a part matches by number and
+        (when given) e-tag (service.rs:301-345)."""
+        obj = self._object(bucket, key)
+        parts = obj.parts.pop(upload_id, None)
+        if parts is None:
+            raise NoSuchUpload(upload_id)
+        body = bytearray()
+        for part_number, e_tag in sorted(completed_parts, key=lambda p: p[0]):
+            for part in parts:
+                if part.part_number == part_number and (
+                    e_tag is None or e_tag == part.e_tag
+                ):
+                    body.extend(part.body)
+                    break
+        obj.body = bytes(body)
+        obj.completed = True
+        obj.last_modified = self._now()
+
+    def abort_multipart_upload(self, bucket: str, key: str, upload_id: str) -> None:
+        obj = self._object(bucket, key)
+        if obj.parts.pop(upload_id, None) is None:
+            raise NoSuchUpload(upload_id)
+
+    # -- lifecycle (service.rs:580-606) --
+
+    def get_bucket_lifecycle_configuration(self, bucket: str) -> List[LifecycleRule]:
+        return list(self.lifecycle.setdefault(bucket, []))
+
+    def put_bucket_lifecycle_configuration(
+        self, bucket: str, rules: List[LifecycleRule]
+    ) -> None:
+        self.lifecycle[bucket] = list(rules)
+
+    # -- deterministic helpers --
+
+    @staticmethod
+    def _rand_u32() -> int:
+        h = context.try_current_handle()
+        if h is not None:
+            return h.rng.next_u64() & 0xFFFF_FFFF
+        import os
+
+        return int.from_bytes(os.urandom(4), "little")
+
+    @staticmethod
+    def _now() -> Optional[float]:
+        h = context.try_current_handle()
+        return h.time.now_time() if h is not None else None

@@ -1,0 +1,248 @@
+"""Kafka sim tests — mirrors reference madsim-rdkafka/tests/test.rs: a
+broker node, an admin creating a 3-partition topic, two producers, and two
+consumers (Base + Stream) splitting the partitions; totals must match.
+Plus broker-level unit tests for offsets/watermarks/size caps."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.sims import kafka
+from madsim_tpu.sims.kafka import (
+    AdminClient,
+    BaseRecord,
+    Broker,
+    ClientConfig,
+    FetchOptions,
+    KafkaError,
+    NewTopic,
+    OwnedRecord,
+    SimBroker,
+    TopicPartitionList,
+)
+from madsim_tpu.sims.kafka.tpl import OFFSET_BEGINNING, OFFSET_INVALID
+
+
+def test_broker_produce_fetch_roundtrip():
+    b = Broker()
+    b.create_topic("t", 3)
+    for i in range(9):
+        b.produce([OwnedRecord(topic="t", payload=bytes([i]))])
+    # keyless records round-robin over 3 partitions
+    assert [p.log_end_offset for p in b.topics["t"].partitions] == [3, 3, 3]
+
+    tpl = TopicPartitionList()
+    for p in range(3):
+        tpl.add_partition_offset("t", p, OFFSET_BEGINNING)
+    msgs = b.fetch(tpl)
+    assert len(msgs) == 9
+    # offsets advanced: nothing more to fetch
+    assert b.fetch(tpl) == []
+    # explicit partition wins
+    b.produce([OwnedRecord(topic="t", partition=2, payload=b"x")])
+    assert b.topics["t"].partitions[2].log_end_offset == 4
+    # keyed records are stable
+    b.produce([OwnedRecord(topic="t", key=b"k", payload=b"a")])
+    b.produce([OwnedRecord(topic="t", key=b"k", payload=b"b")])
+    import zlib
+
+    kp = zlib.crc32(b"k") % 3
+    part_msgs = b.topics["t"].partitions[kp].msgs
+    assert [m.payload for m in part_msgs[-2:]] == [b"a", b"b"]
+
+
+def test_broker_watermarks_and_times():
+    b = Broker()
+    b.create_topic("t", 1)
+    for i, ts in enumerate([100, 200, 300]):
+        b.produce([OwnedRecord(topic="t", payload=bytes([i]), timestamp=ts)])
+    assert b.fetch_watermarks("t", 0) == (0, 3)
+    tpl = TopicPartitionList()
+    tpl.add_partition_offset("t", 0, 150)  # timestamp query
+    out = b.offsets_for_times(tpl)
+    assert out.list[0].offset == 1  # earliest ts >= 150 is offset 1
+    tpl2 = TopicPartitionList()
+    tpl2.add_partition_offset("t", 0, 999)
+    assert b.offsets_for_times(tpl2).list[0].offset == OFFSET_INVALID
+
+
+def test_broker_fetch_size_caps():
+    b = Broker()
+    b.create_topic("t", 1)
+    for i in range(10):
+        b.produce([OwnedRecord(topic="t", payload=b"x" * 100)])
+    tpl = TopicPartitionList()
+    tpl.add_partition_offset("t", 0, OFFSET_BEGINNING)
+    msgs = b.fetch(tpl, FetchOptions(fetch_max_bytes=350))
+    assert len(msgs) == 3  # 4th record would exceed the cap
+    msgs = b.fetch(tpl, FetchOptions(fetch_max_bytes=10_000))
+    assert len(msgs) == 7  # resumes where the tpl left off
+
+
+def test_broker_errors():
+    b = Broker()
+    with pytest.raises(KafkaError, match="unknown topic"):
+        b.produce([OwnedRecord(topic="nope", payload=b"")])
+    b.create_topic("t", 1)
+    with pytest.raises(KafkaError, match="unknown partition"):
+        b.fetch_watermarks("t", 5)
+    tpl = TopicPartitionList()
+    tpl.add_partition("t", 0)  # OFFSET_INVALID
+    b.produce([OwnedRecord(topic="t", payload=b"x")])
+    with pytest.raises(KafkaError, match="no offset"):
+        b.fetch(tpl)
+
+
+def test_cluster_producers_consumers():
+    """The reference's flagship test (tests/test.rs): 2 producers x 30
+    records into 3 partitions; BaseConsumer takes partitions 0+1, a
+    StreamConsumer takes partition 2; every payload is consumed once."""
+    rt = ms.Runtime(seed=11)
+
+    async def main():
+        h = rt.handle
+        broker = h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve("10.0.0.1:9092")
+        ).build()
+        ms.net.NetSim.current().add_dns_record("broker", "10.0.0.1")
+        await ms.time.sleep(1.0)
+
+        cfg = lambda: ClientConfig({"bootstrap.servers": "broker:9092"})
+
+        admin_node = h.create_node().name("admin").ip("10.0.0.2").build()
+
+        async def admin():
+            client = await cfg().create_admin()
+            await client.create_topics([NewTopic("topic", 3)])
+
+        await admin_node.spawn(admin())
+
+        async def producer(pid, count, interval):
+            p = await cfg().create_producer()
+            for i in range(1, count + 1):
+                p.send(
+                    BaseRecord.to("topic")
+                    .with_key(f"{pid}.{i}")
+                    .with_payload(bytes([i]))
+                )
+                await ms.time.sleep(interval)
+                if i % 10 == 0:
+                    await p.flush()
+            await p.flush()
+
+        p1 = h.create_node().name("producer-1").ip("10.0.1.1").build()
+        p2 = h.create_node().name("producer-2").ip("10.0.1.2").build()
+        t1 = p1.spawn(producer(1, 30, 0.1))
+        t2 = p2.spawn(producer(2, 30, 0.2))
+
+        seen = []
+
+        async def base_consumer():
+            c = await cfg().create_consumer()
+            tpl = TopicPartitionList()
+            tpl.add_partition("topic", 0)
+            tpl.add_partition("topic", 1)
+            c.assign(tpl)
+            while True:
+                msg = await c.poll()
+                if msg is None:
+                    await ms.time.sleep(0.1)
+                    continue
+                seen.append(msg.payload[0])
+
+        async def stream_consumer():
+            c = await cfg().create_stream_consumer()
+            tpl = TopicPartitionList()
+            tpl.add_partition("topic", 2)
+            c.assign(tpl)
+            async for msg in c.stream():
+                seen.append(msg.payload[0])
+
+        c1 = h.create_node().name("consumer-1").ip("10.0.2.1").build()
+        c2 = h.create_node().name("consumer-2").ip("10.0.2.2").build()
+        c1.spawn(base_consumer())
+        c2.spawn(stream_consumer())
+
+        await t1
+        await t2
+        await ms.time.sleep(5.0)
+        return seen
+
+    seen = rt.block_on(main())
+    assert len(seen) == 60
+    assert sum(seen) == 2 * sum(range(1, 31))
+
+
+def test_subscribe_discovers_partitions():
+    rt = ms.Runtime(seed=3)
+
+    async def main():
+        h = rt.handle
+        h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve("10.0.0.1:9092")
+        ).build()
+        client_node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.time.sleep(1.0)
+
+        async def run():
+            cfg = ClientConfig({"bootstrap.servers": "10.0.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([NewTopic("logs", 4)])
+
+            p = await cfg.create_producer()
+            for i in range(8):
+                p.send(BaseRecord.to("logs").with_payload(bytes([i])))
+            await p.flush()
+
+            c = await cfg.create_consumer()
+            c.subscribe(["logs"])
+            got = []
+            while len(got) < 8:
+                msg = await c.poll()
+                if msg is None:
+                    await ms.time.sleep(0.05)
+                    continue
+                got.append(msg.payload[0])
+            assert sorted(got) == list(range(8))
+
+            # metadata sees all four partitions
+            meta = await c.fetch_metadata("logs")
+            assert meta == {"logs": [0, 1, 2, 3]}
+            return True
+
+        return await client_node.spawn(run())
+
+    assert rt.block_on(main())
+
+
+def test_latest_offset_reset_skips_history():
+    rt = ms.Runtime(seed=5)
+
+    async def main():
+        h = rt.handle
+        h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve("10.0.0.1:9092")
+        ).build()
+        client_node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.time.sleep(1.0)
+
+        async def run():
+            cfg = ClientConfig({"bootstrap.servers": "10.0.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([NewTopic("t", 1)])
+            p = await cfg.create_producer()
+            for i in range(5):
+                p.send(BaseRecord.to("t").with_payload(bytes([i])))
+            await p.flush()
+
+            late = await cfg.set("auto.offset.reset", "latest").create_consumer()
+            tpl = TopicPartitionList()
+            tpl.add_partition("t", 0)
+            late.assign(tpl)
+            first = await late.poll()
+            # "latest" starts at the final existing record
+            assert first is not None and first.payload == bytes([4])
+            return True
+
+        return await client_node.spawn(run())
+
+    assert rt.block_on(main())

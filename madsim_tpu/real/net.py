@@ -120,9 +120,26 @@ class RealEndpoint:
     @staticmethod
     async def connect(addr: ToSocketAddrs) -> "RealEndpoint":
         peer = await lookup_host(addr)
-        ep = await RealEndpoint.bind(("127.0.0.1", 0))
+        # bind all interfaces: the reply address we advertise is derived
+        # per-connection from the socket's own view (see _advertised), so
+        # cross-host peers can reach us — unlike a hardwired 127.0.0.1
+        ep = await RealEndpoint.bind(("0.0.0.0", 0))
         ep._peer = peer
         return ep
+
+    def _advertised(self, writer: asyncio.StreamWriter) -> SocketAddr:
+        """The reply address a peer on the other end of `writer` can reach.
+
+        A wildcard bind ('0.0.0.0'/'::') is unreachable as a destination;
+        use the outgoing connection's source address (the route the OS
+        actually picked toward that peer) with our server's listen port.
+        """
+        host, port = self.local_addr()
+        if host in ("0.0.0.0", "::"):
+            sockname = writer.get_extra_info("sockname")
+            if sockname:
+                host = sockname[0]
+        return (host, port)
 
     # -- properties --
 
@@ -211,7 +228,7 @@ class RealEndpoint:
         writer = self._pipes.get(dst)
         if writer is None or writer.is_closing():
             reader, writer = await asyncio.open_connection(dst[0], dst[1])
-            _write_frame(writer, ("dgram", self.local_addr()))
+            _write_frame(writer, ("dgram", self._advertised(writer)))
             self._pipes[dst] = writer
         _write_frame(writer, (tag, data))
         await writer.drain()
@@ -230,7 +247,7 @@ class RealEndpoint:
     ) -> Tuple[RealPayloadSender, RealPayloadReceiver, SocketAddr]:
         resolved = await lookup_host(dst)
         reader, writer = await asyncio.open_connection(resolved[0], resolved[1])
-        _write_frame(writer, ("conn1", self.local_addr()))
+        _write_frame(writer, ("conn1", self._advertised(writer)))
         return (
             RealPayloadSender(writer),
             RealPayloadReceiver(reader, writer),

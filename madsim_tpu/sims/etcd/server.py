@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ...core import task as task_mod
-from ...core.sync import ChannelClosed
+from ...core.sync import ChannelClosed, select
 from ...net import Endpoint
 from .errors import EtcdError
 from .service import EtcdService, Txn
@@ -90,8 +90,25 @@ class SimServer:
             elif op == "lease_leases":
                 rsp = await service.lease_leases()
             elif op == "campaign":
+                # a campaign can block on watch events indefinitely; race it
+                # against client disconnect so the task (and its EventBus
+                # subscription) is reclaimed when the caller goes away — the
+                # select_biased!-on-tx.closed() of reference server.rs:64-69
                 name, value, lease = args
-                rsp = await service.campaign(name, value, lease)
+
+                async def _client_gone():
+                    # the client sends nothing else on a campaign stream:
+                    # recv only resolves (with ChannelClosed) on disconnect
+                    try:
+                        await rx.recv()
+                    except ChannelClosed:
+                        pass
+
+                which, rsp = await select(
+                    service.campaign(name, value, lease), _client_gone()
+                )
+                if which == 1:
+                    return
             elif op == "proclaim":
                 leader, value = args
                 rsp = await service.proclaim(leader, value)

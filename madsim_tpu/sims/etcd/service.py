@@ -271,7 +271,15 @@ class EventBus:
     def __init__(self) -> None:
         self._subs: List[Tuple[bytes, Channel]] = []
 
-    def subscribe(self, prefix: bytes, capacity: int = 4) -> Channel:
+    def subscribe(self, prefix: bytes, capacity: Optional[int] = None) -> Channel:
+        """Subscribe to events under a prefix.
+
+        Internal subscriptions (campaign waits, observe loops) default to
+        unbounded so an event burst — e.g. lease_revoke deleting several
+        election keys at once — cannot close a parked waiter's channel and
+        surface as a spurious 'server closed' error. Client-driven `watch`
+        streams pass an explicit capacity (backpressure stays real there).
+        """
         ch = Channel(capacity=capacity)
         self._subs.append((prefix, ch))
         return ch

@@ -23,7 +23,7 @@ from ...net import Endpoint
 from ...net.addr import lookup_host
 from .broker import FetchOptions, OwnedMessage, OwnedRecord
 from .errors import KafkaError
-from .tpl import OFFSET_BEGINNING, OFFSET_END, TopicPartitionList
+from .tpl import OFFSET_BEGINNING, OFFSET_END, OFFSET_INVALID, TopicPartitionList
 
 
 class BaseRecord:
@@ -182,7 +182,9 @@ class BaseConsumer:
         reset = self._initial_offset()
         tpl = TopicPartitionList()
         for e in assignment.list:
-            offset = e.offset if e.offset >= 0 else reset
+            # only OFFSET_INVALID falls back to auto.offset.reset; explicit
+            # OFFSET_BEGINNING/OFFSET_END sentinels pass through to the broker
+            offset = reset if e.offset == OFFSET_INVALID else e.offset
             tpl.add_partition_offset(e.topic, e.partition, offset)
         self._state.tpl = tpl
 

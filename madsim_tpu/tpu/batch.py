@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import inspect
 import os
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -188,6 +189,13 @@ def batch_test(
                 result.raise_on_violation()
             return fn(result, *args, **kwargs)
 
+        # pytest resolves __wrapped__'s signature and would demand a fixture
+        # named 'result'; advertise the signature minus the injected first
+        # parameter so the decorated test collects cleanly
+        del wrapper.__wrapped__
+        sig = inspect.signature(fn)
+        params = list(sig.parameters.values())[1:]
+        wrapper.__signature__ = sig.replace(parameters=params)  # type: ignore[attr-defined]
         return wrapper
 
     return deco

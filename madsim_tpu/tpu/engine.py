@@ -225,9 +225,36 @@ class BatchedSim:
             & active[:, None, None]
         )
         t_ln = jnp.where(due_ln, msgs.deliver[:, None, :], INF_US)
-        slot = jnp.argmin(t_ln, axis=2)  # [L,N]
-        slot_oh = due_ln & (jnp.arange(S)[None, None, :] == slot[:, :, None])  # [L,N,S]
+        if cfg.sched_randomize:
+            # random tie-break among equal-timestamp due messages — the
+            # scheduling-nondeterminism amplifier (utils/mpsc.rs:71-84):
+            # seeds that share a chaos schedule still explore different
+            # delivery orders, the reference's biggest bug-finding lever
+            t_min = t_ln.min(axis=2, keepdims=True)  # [L,N,1]
+            tied = due_ln & (t_ln == t_min)
+            prio = prng.bits(
+                prng.fold(key, 107)[:, None], 1,
+                index=jnp.arange(S, dtype=jnp.uint32)[None, :],
+            )  # u32 [L,S]
+            prio_ln = jnp.where(tied, prio[:, None, :], jnp.uint32(0xFFFFFFFF))
+            slot = jnp.argmin(prio_ln, axis=2)  # [L,N]
+            slot_oh = tied & (jnp.arange(S)[None, None, :] == slot[:, :, None])
+        else:
+            slot = jnp.argmin(t_ln, axis=2)  # [L,N]
+            slot_oh = due_ln & (jnp.arange(S)[None, None, :] == slot[:, :, None])
         has_msg = slot_oh.any(-1)
+
+        if cfg.sched_randomize:
+            # message-vs-timer order: when a node has both a due message and
+            # a due timer, half the time the timer fires first — the message
+            # is deferred to the next step (its deliver time has passed, so
+            # the clock does not advance past it; net effect is exactly a
+            # reordering of same-instant events)
+            due_t_pre = state.alive & active[:, None] & (state.timer <= clock[:, None])
+            timer_first = prng.bernoulli(prng.fold(node_key, 108), 1, 0.5)  # [L,N]
+            defer_msg = has_msg & due_t_pre & timer_first
+            has_msg = has_msg & ~defer_msg
+            slot_oh = slot_oh & ~defer_msg[:, :, None]
 
         slot_ohi = slot_oh.astype(jnp.int32)
         m_src = (msgs.src[:, None, :] * slot_ohi).sum(-1)

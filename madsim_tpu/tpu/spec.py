@@ -112,6 +112,12 @@ class SimConfig:
     partition_heal_lo_us: int = 500_000
     partition_heal_hi_us: int = 3_000_000
     horizon_us: int = 30_000_000  # virtual-time budget per lane
+    # scheduling-order nondeterminism (the utils/mpsc.rs:71-84 random-pop
+    # analog, on device): break equal-timestamp delivery ties by a random
+    # per-slot priority, and randomize message-vs-timer firing order when
+    # both are due at the same instant. Off => deterministic argmin ties
+    # (the round-2 behavior; useful for A/B-ing ordering sensitivity).
+    sched_randomize: bool = True
 
     @property
     def chaos_enabled(self) -> bool:

@@ -111,3 +111,17 @@ def test_batch_test_decorator_raises_on_violation(monkeypatch):
 def test_runtime_run_batch_entry_point():
     result = ms.Runtime.run_batch(range(16), raft_workload(virtual_secs=1.0))
     assert result.violations == 0
+
+
+def test_batch_test_decorator_is_pytest_collectable():
+    """pytest resolves fixture names from the wrapper's signature: the
+    injected `result` parameter must not leak (it would demand a fixture
+    named 'result' at collection time)."""
+    import inspect
+
+    @batch_test(raft_workload(virtual_secs=1.0))
+    def my_test(result):
+        pass
+
+    assert not hasattr(my_test, "__wrapped__")
+    assert "result" not in inspect.signature(my_test).parameters

@@ -220,6 +220,120 @@ def test_prng_quality_rough():
     assert set(np.asarray(r).tolist()) == {10, 11, 12, 13, 14}
 
 
+def _deterministic_gossip_spec(n_nodes=4):
+    """A protocol whose handlers use NO randomness: every node broadcasts on
+    a fixed-period timer and folds received (src, value) pairs into an
+    order-sensitive accumulator. With fixed latency, zero loss, and no
+    chaos, the ONLY seed-dependent behavior is the engine's scheduling
+    (tie-break + message-vs-timer order)."""
+    from madsim_tpu.tpu.spec import Outbox, ProtocolSpec
+
+    N = n_nodes
+    peers = jnp.arange(N, dtype=jnp.int32)
+
+    from typing import NamedTuple
+
+    class GS(NamedTuple):
+        acc: jnp.ndarray
+        round: jnp.ndarray
+
+    def init(key, nid):
+        return GS(acc=jnp.int32(1), round=jnp.int32(0)), jnp.int32(1_000)
+
+    def on_message(s, nid, src, kind, payload, now, key):
+        # order-sensitive fold: delivering A-then-B differs from B-then-A
+        acc = s.acc * jnp.int32(31) + src * jnp.int32(7) + payload[0]
+        out = Outbox(
+            valid=jnp.zeros((1,), jnp.bool_),
+            dst=jnp.zeros((1,), jnp.int32),
+            kind=jnp.zeros((1,), jnp.int32),
+            payload=jnp.zeros((1, 1), jnp.int32),
+        )
+        return s._replace(acc=acc), out, jnp.int32(-1)
+
+    def on_timer(s, nid, now, key):
+        # also fold the timer event itself: message-vs-timer order matters
+        acc = s.acc * jnp.int32(17) + jnp.int32(5)
+        out = Outbox(
+            valid=peers != nid,
+            dst=peers,
+            kind=jnp.zeros((N,), jnp.int32),
+            payload=jnp.broadcast_to(s.round[None, None], (N, 1)),
+        )
+        return s._replace(acc=acc, round=s.round + 1), out, now + jnp.int32(100_000)
+
+    def on_restart(s, nid, now, key):
+        return s, jnp.int32(1_000)
+
+    def check_invariants(ns, alive, now):
+        return jnp.bool_(True)
+
+    return ProtocolSpec(
+        name="gossip",
+        n_nodes=N,
+        payload_width=1,
+        max_out=N,
+        max_out_msg=1,
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+    )
+
+
+def test_scheduling_order_nondeterminism_diverges():
+    """Identical chaos schedules (none), fixed latency, zero loss — the only
+    randomness left is delivery ordering. Seeds must still diverge (the
+    utils/mpsc.rs:71-84 random-pop analog), and turning sched_randomize off
+    must collapse every lane onto one identical trajectory."""
+    spec = _deterministic_gossip_spec(4)
+    cfg = dict(
+        horizon_us=1_000_000,
+        latency_lo_us=1_000,
+        latency_hi_us=1_000,  # lo == hi: constant latency, no jitter
+        loss_rate=0.0,
+    )
+
+    sim = BatchedSim(spec, SimConfig(**cfg, sched_randomize=True))
+    state = sim.run(jnp.arange(16), max_steps=5_000)
+    accs = np.asarray(state.node.acc)
+    assert len({tuple(row) for row in accs.tolist()}) > 1, (
+        "seeds with identical chaos schedules must diverge purely from "
+        "delivery ordering"
+    )
+
+    det = BatchedSim(spec, SimConfig(**cfg, sched_randomize=False))
+    dstate = det.run(jnp.arange(16), max_steps=5_000)
+    daccs = np.asarray(dstate.node.acc)
+    assert len({tuple(row) for row in daccs.tolist()}) == 1, (
+        "with sched_randomize off and no other randomness, every lane must "
+        "follow the same trajectory"
+    )
+
+
+def test_deposed_leader_restamp_bug_caught_on_device():
+    """The interleaving bug the round-2 HOST fuzz found (commit 9229fd2): a
+    deposed leader re-stamps its stale log with the newly adopted term,
+    making committed prefixes disagree in term. The device fuzz must catch
+    this class too."""
+    spec = make_raft_spec(5, client_rate=0.8)
+
+    def buggy_on_message(s, nid, src, kind, payload, now, key):
+        state, out, timer = spec.on_message(s, nid, src, kind, payload, now, key)
+        deposed = (s.role == raft_mod.LEADER) & (state.role != raft_mod.LEADER)
+        log_idx = jnp.arange(s.log_term.shape[0], dtype=jnp.int32)
+        in_log = log_idx < state.log_len
+        log_term = jnp.where(deposed & in_log, state.term, state.log_term)
+        return state._replace(log_term=log_term), out, timer
+
+    buggy = dataclasses.replace(spec, on_message=buggy_on_message)
+    sim = BatchedSim(buggy, partition_config(loss_rate=0.1))
+    state = sim.run(jnp.arange(256), max_steps=60_000)
+    s = summarize(state)
+    assert s["violations"] > 0
+
+
 def test_deadlock_detection():
     # a protocol with no timers and no messages deadlocks immediately
     spec = make_raft_spec(5)

@@ -61,6 +61,33 @@ def bench_tpu(lanes: int, virtual_secs: float, client_rate: float) -> dict:
     }
 
 
+def bench_kv(lanes: int, virtual_secs: float) -> dict:
+    """Second device protocol: replicated-KV linearizability under
+    partitions (BASELINE config #4 / SURVEY §7 step 5). Client histories
+    recorded per lane; the invariant is real-time revision monotonicity."""
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu import BatchedSim, summarize
+    from madsim_tpu.tpu.kv import kv_workload, make_kv_spec
+
+    wl = kv_workload(virtual_secs=virtual_secs)
+    sim = BatchedSim(wl.spec, wl.config)
+    max_steps = int(virtual_secs * 1200) + 2000
+
+    state = sim.run(jnp.arange(lanes), max_steps=max_steps)  # compile + warm
+    state.clock.block_until_ready()
+    t0 = time.perf_counter()
+    state = sim.run(jnp.arange(lanes, 2 * lanes), max_steps=max_steps)
+    state.clock.block_until_ready()
+    wall = time.perf_counter() - t0
+    s = summarize(state, wl.spec)
+    return {
+        "wall_s": wall,
+        "seeds_per_sec": lanes / wall,
+        "summary": s,
+    }
+
+
 def bench_cpu_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
     from madsim_tpu.workloads.raft_host import fuzz_one_seed
 
@@ -96,6 +123,7 @@ def main() -> None:
 
     cpu = bench_cpu_baseline(args.cpu_seeds, args.virtual_secs, args.client_rate)
     tpu = bench_tpu(args.lanes, args.virtual_secs, args.client_rate)
+    kv = bench_kv(args.lanes // 4, args.virtual_secs)
 
     result = {
         "metric": "raft5_fuzz_seeds_per_sec",
@@ -111,6 +139,12 @@ def main() -> None:
         "violations": tpu["summary"]["violations"],
         "overflow": tpu["summary"]["total_overflow"],
         "log_saturated_lanes": tpu["summary"].get("log_saturated_lanes", 0),
+        # second device protocol (replicated-KV linearizability, partitions on)
+        "kv_seeds_per_sec": round(kv["seeds_per_sec"], 2),
+        "kv_lanes": args.lanes // 4,
+        "kv_violations": kv["summary"]["violations"],
+        "kv_mean_acked_ops": round(kv["summary"].get("mean_acked_ops", 0.0), 2),
+        "kv_history_wrapped_lanes": kv["summary"].get("history_wrapped_lanes", 0),
         "backend": tpu["backend"],
     }
     print(json.dumps(result))

@@ -51,10 +51,17 @@ def test_raft_elects_and_replicates(quiet_sim):
     assert (np.sum(roles == raft_mod.LEADER, axis=1) == 1).all()  # one leader/lane
     commits = np.asarray(state.node.commit)
     assert (commits >= 0).all()  # every node committed something
-    # committed entries agree across nodes (spot-check lane 0)
+    # committed window entries agree across nodes where windows overlap
+    # (spot-check lane 0; full prefix agreement is the chain-hash invariant,
+    # already asserted via violations == 0)
     cmds = np.asarray(state.node.log_cmd)[0]
-    c = commits[0].min()
-    assert (cmds[:, : c + 1] == cmds[0, : c + 1]).all()
+    bases = np.asarray(state.node.base)[0]
+    lo, hi = bases.max(), commits[0].min()
+    for n in range(1, cmds.shape[0]):
+        a = cmds[0][lo - bases[0] : hi + 1 - bases[0]]
+        b = cmds[n][lo - bases[n] : hi + 1 - bases[n]]
+        if hi >= lo:
+            assert (a == b).all()
 
 
 def test_chaos_run_no_violations(chaos_sim):
@@ -191,6 +198,39 @@ def test_partition_split_brain_bug_caught():
     state = sim.run(jnp.arange(256), max_steps=60_000)
     s = summarize(state)
     assert s["violations"] > 0
+
+
+def test_log_compaction_unbounded_writes_through_bounded_window():
+    """The VERDICT r2 weak-#2 fix: a lane writes far more commands than the
+    window holds (compaction folds the committed prefix into a chain hash),
+    and a crash-restarted laggard catches up via InstallSnapshot — all with
+    zero saturated lanes and zero violations."""
+    sim = BatchedSim(
+        make_raft_spec(5, client_rate=0.8),
+        SimConfig(
+            horizon_us=6_000_000,
+            loss_rate=0.05,
+            crash_interval_lo_us=1_000_000,
+            crash_interval_hi_us=2_000_000,
+            restart_delay_lo_us=1_000_000,
+            restart_delay_hi_us=2_000_000,
+        ),
+    )
+    state = sim.run(jnp.arange(32), max_steps=60_000)
+    s = summarize(state, sim.spec)
+    assert s["violations"] == 0
+    assert s["log_saturated_lanes"] == 0
+    log_len = np.asarray(state.node.log_len)
+    base = np.asarray(state.node.base)
+    LOG = 24
+    # most lanes wrote beyond the window capacity => compaction really ran
+    assert (log_len.max(axis=1) > LOG).mean() > 0.8
+    assert (base > 0).any()
+    # crash victims caught back up (InstallSnapshot): by the horizon every
+    # node's commit is near the lane's max in the vast majority of lanes
+    commit = np.asarray(state.node.commit)
+    caught_up = commit.min(axis=1) > (commit.max(axis=1) - LOG)
+    assert caught_up.mean() > 0.7
 
 
 def test_message_pool_overflow_counted():

@@ -170,6 +170,44 @@ class NodeBuilder:
         return self._handle.executor
 
 
+_warned_hash_randomization = False
+
+
+def _check_hash_randomization() -> None:
+    """Warn (once) when str-hash randomization is live.
+
+    The reference seeds std's RandomState from the sim RNG so HashMap
+    iteration order is part of the deterministic trajectory (rand.rs:176-244).
+    CPython's str/bytes hash seed is fixed at interpreter start and CANNOT be
+    re-seeded at runtime, so the only way to make str-keyed set/dict
+    iteration reproducible ACROSS PROCESSES is launching with PYTHONHASHSEED
+    pinned. Within one process determinism is unaffected (the hash seed is
+    constant), but a repro seed handed to a colleague — or a determinism
+    check that compares against a previous process's trace — silently
+    diverges if user code iterates a str-keyed set. Detect and say so loudly
+    instead of letting `check_determinism` chase ghosts.
+    """
+    global _warned_hash_randomization
+    if _warned_hash_randomization:
+        return
+    import sys
+
+    if sys.flags.hash_randomization:
+        import warnings
+
+        _warned_hash_randomization = True
+        warnings.warn(
+            "madsim_tpu: PYTHONHASHSEED is not pinned — str-keyed dict/set "
+            "iteration order will differ across processes, so simulations "
+            "whose user code iterates str-keyed collections are NOT "
+            "reproducible across processes (within this process they are). "
+            "Launch with PYTHONHASHSEED=0 for cross-process repro "
+            "(reference madsim seeds HashMap's RandomState for the same "
+            "reason, rand.rs:176-244).",
+            stacklevel=3,
+        )
+
+
 class Runtime:
     """One deterministic simulation lane (runtime/mod.rs:33-192)."""
 
@@ -180,6 +218,7 @@ class Runtime:
         from . import interpose
 
         interpose.install()
+        _check_hash_randomization()
         self.config = config or Config()
         self.rng = GlobalRng(seed)
         self.time = TimeHandle(self.rng)

@@ -15,10 +15,18 @@ calls it synchronously:
 Env vars (same names as the reference):
     MADSIM_TEST_SEED               first seed (default: OS entropy)
     MADSIM_TEST_NUM                number of seeds to sweep (default 1)
-    MADSIM_TEST_JOBS               concurrent OS threads (default 1)
+    MADSIM_TEST_JOBS               concurrent worker processes (default 1;
+                                   forked, so seeds sweep in true parallel)
     MADSIM_TEST_CONFIG             path to a TOML config file
     MADSIM_TEST_TIME_LIMIT         virtual-time limit in seconds
     MADSIM_TEST_CHECK_DETERMINISM  run every seed twice + compare RNG traces
+
+Cross-process reproducibility needs `PYTHONHASHSEED` pinned (e.g. =0):
+CPython randomizes the str hash seed per process and cannot re-seed it at
+runtime, so user code iterating str-keyed sets/dicts diverges across
+processes otherwise. `Runtime` warns when it detects the unpinned case
+(the reference instead seeds HashMap's RandomState from the sim RNG,
+rand.rs:176-244 — possible there because Rust lets it pick the seed).
 
 The TPU batched backend (`madsim_tpu.tpu`) replaces exactly this thread
 fan-out for device-expressible workloads.
@@ -34,6 +42,21 @@ from typing import Any, Callable, Coroutine, List, Optional
 
 from .core.config import Config
 from .core.runtime import Runtime, check_determinism
+
+
+class UnpicklableResult:
+    """Placeholder for a seed result that could not cross the worker pipe.
+
+    Forked sweeps (jobs > 1) return results by pickling; a value that can't
+    be pickled comes back as this wrapper around its repr — explicit, so
+    callers never silently receive a bare string where an object was
+    expected (run a seed with jobs=1 to get the live object)."""
+
+    def __init__(self, repr_: str) -> None:
+        self.repr = repr_
+
+    def __repr__(self) -> str:
+        return f"UnpicklableResult({self.repr})"
 
 
 class TestFailure(AssertionError):
@@ -102,9 +125,14 @@ class Builder:
     def run(self, make_coro: Callable[[], Coroutine]) -> Any:
         """Sweep seeds [seed, seed+count); returns the last seed's result.
 
-        With jobs > 1, seeds run on that many OS threads concurrently
-        (deterministic per seed regardless; the GIL serializes CPU work but
-        semantics match the reference's thread-per-seed model).
+        With jobs > 1, seeds run across that many forked worker PROCESSES —
+        real per-seed CPU parallelism, matching the reference's
+        thread-per-seed model (runtime/builder.rs:118-136; Rust threads
+        parallelize, GIL-bound Python threads do not). Fork inherits the
+        test closure, so arbitrary (unpicklable) test functions work; forked
+        children also inherit the parent's str-hash seed, so jobs>1 cannot
+        introduce cross-process hash nondeterminism into a sweep. Platforms
+        without fork fall back to threads (same semantics, serialized CPU).
         """
         seeds = list(range(self.seed, self.seed + self.count))
         if self.jobs <= 1 or len(seeds) <= 1:
@@ -115,7 +143,128 @@ class Builder:
                 except BaseException as e:  # noqa: BLE001 - annotate with repro seed
                     raise TestFailure(seed, e) from e
             return result
+        if hasattr(os, "fork"):
+            return self._run_forked(seeds, make_coro)
+        return self._run_threaded(seeds, make_coro)
 
+    def _run_forked(self, seeds: List[int], make_coro: Callable[[], Coroutine]) -> Any:
+        """Forked seed sweep. Each worker streams one length-prefixed pickle
+        frame per finished seed, so the parent always knows exactly which
+        seed was in flight when a worker died (the repro-seed promise), can
+        stop the whole sweep the moment any seed fails (the threaded path's
+        early-stop), and an unpicklable result degrades only its own seed
+        (to an UnpicklableResult wrapper), not its whole worker's share."""
+        import pickle
+        import select
+        import signal
+        import struct
+
+        jobs = min(self.jobs, len(seeds))
+        workers: dict = {}  # rfd -> {pid, seeds, reported, buf}
+        for w in range(jobs):
+            my_seeds = seeds[w::jobs]  # deterministic round-robin split
+            rfd, wfd = os.pipe()
+            pid = os.fork()
+            if pid == 0:  # child: run my share, stream frames, hard-exit
+                os.close(rfd)
+                try:
+                    with os.fdopen(wfd, "wb") as f:
+
+                        def emit(frame: tuple) -> None:
+                            payload = pickle.dumps(frame)
+                            f.write(struct.pack("<I", len(payload)))
+                            f.write(payload)
+                            f.flush()
+
+                        for seed in my_seeds:
+                            try:
+                                value = self.run_seed(seed, make_coro)
+                            except BaseException as e:  # noqa: BLE001
+                                emit(("fail", seed, type(e).__name__, str(e)))
+                                break
+                            try:
+                                emit(("ok", seed, value))
+                            except Exception:
+                                emit(("ok", seed, UnpicklableResult(repr(value))))
+                except BaseException:
+                    os._exit(1)
+                os._exit(0)
+            os.close(wfd)
+            os.set_blocking(rfd, False)
+            workers[rfd] = {"pid": pid, "seeds": my_seeds, "reported": [], "buf": b""}
+
+        results: dict = {}
+        failures: List[TestFailure] = []
+
+        def drain_frames(w: dict) -> None:
+            buf = w["buf"]
+            while len(buf) >= 4:
+                (n,) = struct.unpack("<I", buf[:4])
+                if len(buf) < 4 + n:
+                    break
+                frame = pickle.loads(buf[4 : 4 + n])
+                buf = buf[4 + n :]
+                if frame[0] == "ok":
+                    _, seed, value = frame
+                    results[seed] = value
+                    w["reported"].append(seed)
+                else:
+                    _, seed, etype, msg = frame
+                    w["reported"].append(seed)
+                    w["failed"] = True
+                    failures.append(TestFailure(seed, RuntimeError(f"{etype}: {msg}")))
+            w["buf"] = buf
+
+        try:
+            open_fds = set(workers)
+            while open_fds and not failures:
+                ready, _, _ = select.select(list(open_fds), [], [])
+                for rfd in ready:
+                    w = workers[rfd]
+                    try:
+                        chunk = os.read(rfd, 1 << 16)
+                    except BlockingIOError:
+                        continue
+                    if chunk:
+                        w["buf"] += chunk
+                        drain_frames(w)
+                    else:  # EOF: worker finished (or died mid-seed)
+                        open_fds.discard(rfd)
+                        if w.get("failed"):
+                            continue  # stopped early on purpose, after a failure
+                        done = set(w["reported"])
+                        in_flight = next(
+                            (s for s in w["seeds"] if s not in done), None
+                        )
+                        if in_flight is not None:
+                            failures.append(
+                                TestFailure(
+                                    in_flight,
+                                    RuntimeError(
+                                        "worker process died without reporting "
+                                        f"(while running seed {in_flight})"
+                                    ),
+                                )
+                            )
+        finally:
+            # a failure (or worker death) stops the sweep: the other workers'
+            # remaining seeds are moot, don't burn CPU finishing them
+            for rfd, w in workers.items():
+                if failures:
+                    try:
+                        os.kill(w["pid"], signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                os.close(rfd)
+                try:
+                    os.waitpid(w["pid"], 0)
+                except ChildProcessError:
+                    pass
+        if failures:
+            raise min(failures, key=lambda f: f.seed)
+        return results.get(seeds[-1])
+
+    def _run_threaded(self, seeds: List[int], make_coro: Callable[[], Coroutine]) -> Any:
         failures: List[TestFailure] = []
         results: dict = {}
         lock = threading.Lock()

@@ -83,6 +83,10 @@ class BatchResult:
     summary: Dict[str, Any]
     state: SimState  # final engine state (chunked runs: last chunk only)
     host_repros: Dict[int, Any] = dataclasses.field(default_factory=dict)
+    # per-seed device event traces for violating seeds (trace.TraceEvent
+    # lists): the full trajectory that violated — deliveries, timers,
+    # crashes, partitions — debuggable with no host twin
+    traces: Dict[int, list] = dataclasses.field(default_factory=dict)
 
     @property
     def violations(self) -> int:
@@ -106,6 +110,7 @@ def run_batch(
     repro_on_host: bool = True,
     max_host_repros: int = 4,
     chunk: int = DEFAULT_CHUNK,
+    max_traces: int = 2,
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
 
@@ -143,6 +148,9 @@ def run_batch(
 
     violated = np.concatenate(violated_parts)
     deadlocked = np.concatenate(deadlocked_parts)
+    # GLOBAL violation lane indices (summarize's are chunk-local; correlating
+    # those against the global seeds array mislabels lanes on chunked runs)
+    totals["violation_lanes"] = np.nonzero(violated)[0].tolist()[:32]
     result = BatchResult(
         seeds=seeds_arr,
         violated=violated,
@@ -150,6 +158,17 @@ def run_batch(
         summary=totals,
         state=state,
     )
+
+    if result.violations and max_traces > 0:
+        # device-side microscope: re-run violating seeds with event capture
+        # (same jitted step fn => bit-identical trajectory to the batch lane)
+        from .trace import trace_seed
+
+        for seed in result.violating_seeds[:max_traces]:
+            result.traces[seed] = trace_seed(
+                sim, seed, max_steps=workload.max_steps,
+                kind_names=workload.spec.msg_kind_names,
+            )
 
     if repro_on_host and workload.host_repro is not None and result.violations:
         for seed in result.violating_seeds[:max_host_repros]:

@@ -52,6 +52,32 @@ class MsgPool(NamedTuple):
     payload: Any  # i32 [L,S,P]
 
 
+class TraceRecord(NamedTuple):
+    """One step's observable events, for per-lane violation traces.
+
+    The reference's DX promise is an exact, inspectable repro from the
+    printed seed (runtime/mod.rs:194-199). On device the equivalent is this
+    record stream: re-running one violating seed through the SAME jitted
+    step function yields every delivery, timer fire, crash/restart and
+    partition event with virtual timestamps — debuggable without the host
+    twin. All leaves are [L, ...]; tracing runs use L=1.
+    """
+
+    clock: Any  # i32 [L]
+    msg_fired: Any  # bool [L,N] message delivered to node n this step
+    msg_src: Any  # i32 [L,N]
+    msg_kind: Any  # i32 [L,N]
+    msg_payload: Any  # i32 [L,N,P]
+    timer_fired: Any  # bool [L,N]
+    crash: Any  # i32 [L] node crashed this step, -1 = none
+    restart: Any  # i32 [L] node restarted this step, -1 = none
+    split: Any  # bool [L] partition split happened this step
+    heal: Any  # bool [L] partition healed this step
+    side_mask: Any  # i32 [L] bitmask of nodes on side A after a split
+    violation: Any  # bool [L] invariant first violated this step
+    deadlock: Any  # bool [L]
+
+
 class SimState(NamedTuple):
     clock: Any  # i32 [L]
     key: Any  # u32 [L] (hash-chain, prng.py)
@@ -183,6 +209,13 @@ class BatchedSim:
     # ------------------------------------------------------------------ step
 
     def _step(self, state: SimState) -> SimState:
+        return self._step_traced(state)[0]
+
+    def _step_traced(self, state: SimState) -> Tuple[SimState, TraceRecord]:
+        """One engine step + the step's TraceRecord.
+
+        Untraced callers discard the record; XLA dead-code-eliminates its
+        construction, so the trace costs nothing unless collected."""
         spec, cfg = self.spec, self.config
         N, S, E, P = spec.n_nodes, self._S, spec.max_out, spec.payload_width
         L = state.clock.shape[0]
@@ -280,6 +313,8 @@ class BatchedSim:
         # -- 5. crash/restart chaos (Handle::kill/restart analog) ----------
         alive = state.alive
         crashed, chaos_at = state.crashed, state.chaos_at
+        tr_crash = jnp.full((L,), -1, jnp.int32)
+        tr_restart = jnp.full((L,), -1, jnp.int32)
         if cfg.chaos_enabled:
             chaos_due = active & (state.chaos_at <= clock)
             is_restart = state.crashed >= 0
@@ -305,6 +340,8 @@ class BatchedSim:
             crashed = jnp.where(
                 do_crash, victim, jnp.where(do_restart, -1, state.crashed)
             )
+            tr_crash = jnp.where(do_crash, victim, -1)
+            tr_restart = jnp.where(do_restart, restart_node, -1)
             chaos_at = jnp.where(
                 do_crash,
                 clock + restart_delay,
@@ -319,6 +356,9 @@ class BatchedSim:
         # (the clog_link masks of network.rs:261-269, lane-batched)
         link_ok = state.link_ok
         partitioned, part_at = state.partitioned, state.part_at
+        tr_split = jnp.zeros((L,), jnp.bool_)
+        tr_heal = jnp.zeros((L,), jnp.bool_)
+        tr_side = jnp.zeros((L,), jnp.int32)
         if cfg.partition_enabled:
             part_due = active & (state.part_at <= clock)
             do_split = part_due & ~state.partitioned
@@ -349,6 +389,10 @@ class BatchedSim:
                 clock + heal_delay,
                 jnp.where(do_heal, clock + next_split, state.part_at),
             )
+            tr_split, tr_heal = do_split, do_heal
+            tr_side = (
+                side.astype(jnp.int32) * (1 << jnp.arange(N, dtype=jnp.int32))
+            ).sum(-1)
 
         # -- 6. collect outboxes, roll the network, pack into pool ---------
         def flat(out: Outbox, emitting, e):  # [L,N,e,...] -> [L, N*e, ...]
@@ -430,7 +474,7 @@ class BatchedSim:
         reached_horizon = clock >= cfg.horizon_us
         done = state.done | deadlocked | reached_horizon | violated
 
-        return SimState(
+        new_state = SimState(
             clock=clock,
             key=key,
             done=done,
@@ -459,6 +503,22 @@ class BatchedSim:
                 payload=new_payload,
             ),
         )
+        record = TraceRecord(
+            clock=clock,
+            msg_fired=has_msg,
+            msg_src=m_src,
+            msg_kind=m_kind,
+            msg_payload=m_pay,
+            timer_fired=due_t,
+            crash=tr_crash,
+            restart=tr_restart,
+            split=tr_split,
+            heal=tr_heal,
+            side_mask=tr_side,
+            violation=new_violation,
+            deadlock=deadlocked,
+        )
+        return new_state, record
 
     # ------------------------------------------------------------------ run
 
@@ -488,6 +548,26 @@ class BatchedSim:
 
         final, _ = jax.lax.scan(body, state, None, length=n_steps)
         return final
+
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def _run_traced(self, state: SimState, n_steps: int):
+        def body(s, _):
+            s2, rec = self._step_traced(s)
+            return s2, rec
+
+        return jax.lax.scan(body, state, None, length=n_steps)
+
+    def run_traced(self, seed: int, max_steps: int = 20_000):
+        """Re-run ONE seed with full event capture (the violation microscope).
+
+        Returns (final_state, TraceRecord with [T, 1, ...] leaves). Use
+        trace.extract_trace to turn the records into readable events. The
+        trajectory is bit-identical to the same seed inside any batch: the
+        step function is the same jitted program and all randomness is
+        derived from the lane seed, never from lane position.
+        """
+        state = self.init(jnp.asarray([seed], jnp.uint32))
+        return self._run_traced(state, max_steps)
 
     # ------------------------------------------------------------ sharding
 

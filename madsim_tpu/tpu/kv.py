@@ -510,6 +510,10 @@ def make_kv_spec(
         on_restart=on_restart,
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
+        msg_kind_names=(
+            "HB", "CLAIM", "CLAIM_ACK", "WRITE_REP", "WRITE_ACK",
+            "READ_PROBE", "READ_ACK", "CLIENT_REQ", "CLIENT_RSP",
+        ),
     )
 
 

@@ -343,6 +343,12 @@ def make_raft_spec(
         return state, no_out(), timer
 
     def h_append(s: RaftState, nid, src, f, now, key):
+        # Followers must compact here: their election timer (the only other
+        # compaction site) is reset by every valid AppendEntries, so a healthy
+        # leader would otherwise starve follower compaction forever — the
+        # window fills, writes stall at capacity, and the leader's majority
+        # commit wedges with it (the round-2 "silently saturated lane" bug).
+        s = compact(s)
         l_term, prev_idx, prev_term, e_term, e_cmd, l_commit = (
             f[0], f[1], f[2], f[3], f[4], f[5],
         )
@@ -517,12 +523,16 @@ def make_raft_spec(
 
     def lane_metrics(node):
         # node leaves are [L,N,...]; a lane is saturated only if a node's
-        # window is full AND compaction cannot free space (commit stuck at
-        # base-1) — transient pressure that compaction will clear is not
-        # saturation. With InstallSnapshot this should be ~0 at the bench
-        # config; regressions must be visible (engine.summarize).
+        # window is full AND compaction has nothing it can free — i.e. the
+        # next compact() would not advance base (note commit == base-1 is the
+        # NORMAL post-compaction resting state, not a stuck one). Transient
+        # pressure that the next compaction will clear is not saturation.
+        # With follower-side compaction + InstallSnapshot this should be 0 at
+        # the bench config; regressions must be visible (engine.summarize).
+        KEEP = max(LOG // 4, 2)
         window_full = (node.log_len - node.base) >= LOG
-        cannot_compact = node.commit < node.base
+        freeable = jnp.minimum(node.commit + 1, node.log_len - KEEP)
+        cannot_compact = freeable <= node.base
         return {
             "log_saturated_lanes": (window_full & cannot_compact).any(axis=-1),
             "mean_log_len": node.log_len.astype(jnp.float32).mean(axis=-1),
@@ -541,6 +551,7 @@ def make_raft_spec(
         on_restart=on_restart,
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
+        msg_kind_names=("REQUEST_VOTE", "VOTE_RESP", "APPEND", "APPEND_RESP", "SNAP"),
     )
 
 

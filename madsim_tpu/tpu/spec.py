@@ -83,6 +83,9 @@ class ProtocolSpec:
     # -> dict of [L] arrays, surfaced by engine.summarize (e.g. a fuzz that
     # silently saturates a fixed-capacity log must report it, not hide it)
     lane_metrics: Any = None
+    # optional: human names for message kinds, indexed by kind int —
+    # used by trace.extract_trace to render violation traces readably
+    msg_kind_names: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,154 @@
+"""Per-lane violation traces: the device-side repro microscope.
+
+The reference prints the failing seed so the developer can replay the exact
+trajectory under a debugger (runtime/mod.rs:194-199). The batched engine's
+analog: re-run a violating seed single-lane through the SAME jitted step
+function with event capture on (`BatchedSim.run_traced`), then render the
+captured TraceRecord stream as a readable event log — every message
+delivery (src→dst, kind, payload), timer fire, crash/restart and partition
+split/heal, stamped with step index and virtual time, ending at the exact
+step the invariant broke. No host twin needed: the trace IS the trajectory
+that violated, bit-identical to the lane inside the original batch.
+
+    state, recs = sim.run_traced(bad_seed)
+    events = extract_trace(recs, kind_names=["REQUEST_VOTE", ...])
+    print(format_trace(events[-200:]))     # the tail leading to the bug
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import BatchedSim, TraceRecord
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    step: int
+    t_us: int
+    kind: str  # deliver | timer | crash | restart | split | heal | violation | deadlock
+    node: int = -1  # acting node (dst for deliver)
+    src: int = -1  # sender (deliver only)
+    msg_kind: int = -1  # protocol message kind (deliver only)
+    msg_name: str = ""  # human name for msg_kind, if provided
+    payload: Optional[tuple] = None
+    detail: str = ""
+
+    def __str__(self) -> str:
+        t = self.t_us / 1e6
+        if self.kind == "deliver":
+            name = self.msg_name or str(self.msg_kind)
+            return (
+                f"[{t:9.6f}s #{self.step}] node{self.node} <- node{self.src} "
+                f"{name} {list(self.payload or ())}"
+            )
+        if self.kind == "timer":
+            return f"[{t:9.6f}s #{self.step}] node{self.node} timer fired"
+        if self.kind in ("crash", "restart"):
+            return f"[{t:9.6f}s #{self.step}] {self.kind} node{self.node}"
+        if self.kind == "split":
+            return f"[{t:9.6f}s #{self.step}] partition split {self.detail}"
+        if self.kind == "heal":
+            return f"[{t:9.6f}s #{self.step}] partition healed"
+        return f"[{t:9.6f}s #{self.step}] {self.kind.upper()} {self.detail}"
+
+
+def extract_trace(
+    recs: TraceRecord,
+    kind_names: Optional[Sequence[str]] = None,
+    lane: int = 0,
+) -> List[TraceEvent]:
+    """Flatten a [T, L, ...] TraceRecord into a chronological event list.
+
+    Steps after the lane finished record no events (active lanes only), so
+    the list self-truncates at the violation/horizon.
+    """
+    clock = np.asarray(recs.clock)[:, lane]
+    msg_fired = np.asarray(recs.msg_fired)[:, lane]  # [T,N]
+    msg_src = np.asarray(recs.msg_src)[:, lane]
+    msg_kind = np.asarray(recs.msg_kind)[:, lane]
+    msg_payload = np.asarray(recs.msg_payload)[:, lane]  # [T,N,P]
+    timer_fired = np.asarray(recs.timer_fired)[:, lane]
+    crash = np.asarray(recs.crash)[:, lane]
+    restart = np.asarray(recs.restart)[:, lane]
+    split = np.asarray(recs.split)[:, lane]
+    heal = np.asarray(recs.heal)[:, lane]
+    side_mask = np.asarray(recs.side_mask)[:, lane]
+    violation = np.asarray(recs.violation)[:, lane]
+    deadlock = np.asarray(recs.deadlock)[:, lane]
+
+    T, N = msg_fired.shape
+    events: List[TraceEvent] = []
+    # steps with any activity (cheap pre-filter: most post-done steps are empty)
+    busy = (
+        msg_fired.any(1) | timer_fired.any(1) | (crash >= 0) | (restart >= 0)
+        | split | heal | violation | deadlock
+    )
+    for t in np.nonzero(busy)[0]:
+        t = int(t)
+        t_us = int(clock[t])
+        for n in range(N):
+            if msg_fired[t, n]:
+                mk = int(msg_kind[t, n])
+                events.append(
+                    TraceEvent(
+                        step=t, t_us=t_us, kind="deliver", node=n,
+                        src=int(msg_src[t, n]), msg_kind=mk,
+                        msg_name=(
+                            kind_names[mk]
+                            if kind_names and 0 <= mk < len(kind_names)
+                            else ""
+                        ),
+                        payload=tuple(int(x) for x in msg_payload[t, n]),
+                    )
+                )
+        for n in range(N):
+            if timer_fired[t, n]:
+                events.append(TraceEvent(step=t, t_us=t_us, kind="timer", node=n))
+        if crash[t] >= 0:
+            events.append(
+                TraceEvent(step=t, t_us=t_us, kind="crash", node=int(crash[t]))
+            )
+        if restart[t] >= 0:
+            events.append(
+                TraceEvent(step=t, t_us=t_us, kind="restart", node=int(restart[t]))
+            )
+        if split[t]:
+            sides = int(side_mask[t])
+            a = [n for n in range(N) if sides >> n & 1]
+            b = [n for n in range(N) if not sides >> n & 1]
+            events.append(
+                TraceEvent(step=t, t_us=t_us, kind="split", detail=f"{a} | {b}")
+            )
+        if heal[t]:
+            events.append(TraceEvent(step=t, t_us=t_us, kind="heal"))
+        if violation[t]:
+            events.append(
+                TraceEvent(
+                    step=t, t_us=t_us, kind="violation",
+                    detail="invariant check failed",
+                )
+            )
+        if deadlock[t]:
+            events.append(
+                TraceEvent(step=t, t_us=t_us, kind="deadlock", detail="no runnable events")
+            )
+    return events
+
+
+def format_trace(events: Sequence[TraceEvent]) -> str:
+    return "\n".join(str(e) for e in events)
+
+
+def trace_seed(
+    sim: BatchedSim,
+    seed: int,
+    max_steps: int = 20_000,
+    kind_names: Optional[Sequence[str]] = None,
+) -> List[TraceEvent]:
+    """One-call microscope: re-run `seed` traced and return its event list."""
+    _, recs = sim.run_traced(seed, max_steps=max_steps)
+    return extract_trace(recs, kind_names=kind_names)

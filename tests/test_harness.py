@@ -18,14 +18,113 @@ def test_builder_sweeps_seeds():
     assert seen == [100, 101, 102, 103, 104]
 
 
-def test_builder_jobs_threads():
-    seen = []
+def test_builder_jobs_forked_processes(tmp_path):
+    # jobs>1 forks worker PROCESSES (true per-seed CPU parallelism, matching
+    # the reference's thread-per-seed model in Rust where threads really run
+    # in parallel); results come back over pipes, so the bodies talk to the
+    # parent via the filesystem here
+    async def body():
+        seed = ms.Handle.current().seed
+        (tmp_path / f"seed{seed}").write_text(str(os.getpid()))
+        return seed
+
+    out = Builder(seed=10, count=8, jobs=4).run(lambda: body())
+    assert out == 17  # the last seed's result
+    ran = sorted(int(p.name[4:]) for p in tmp_path.glob("seed*"))
+    assert ran == list(range(10, 18))
+    pids = {(tmp_path / f"seed{s}").read_text() for s in ran}
+    assert len(pids) == 4  # really 4 distinct worker processes
+    assert str(os.getpid()) not in pids
+
+
+def test_builder_jobs_failure_reports_seed_across_fork():
+    async def body():
+        if ms.Handle.current().seed == 13:
+            raise RuntimeError("found a bug")
+
+    with pytest.raises(TestFailure, match="MADSIM_TEST_SEED=13"):
+        Builder(seed=10, count=8, jobs=4).run(lambda: body())
+
+
+def test_builder_jobs_worker_death_blames_in_flight_seed():
+    # per-seed result frames mean a worker that dies mid-seed is blamed on
+    # the seed it was actually running, not the first seed of its share
+    async def body():
+        if ms.Handle.current().seed == 16:  # 3rd seed of worker 0's share
+            os._exit(42)  # simulated hard crash: no exception, no frame
+
+    with pytest.raises(TestFailure, match="MADSIM_TEST_SEED=16"):
+        Builder(seed=10, count=8, jobs=2).run(lambda: body())
+
+
+def test_builder_jobs_unpicklable_result_degrades_only_itself():
+    from madsim_tpu.testing import UnpicklableResult
 
     async def body():
-        seen.append(ms.Handle.current().seed)
+        if ms.Handle.current().seed == 17:  # the returned (last) seed
+            return lambda: None  # unpicklable
+        return ms.Handle.current().seed
 
-    Builder(seed=10, count=8, jobs=4).run(lambda: body())
-    assert sorted(seen) == list(range(10, 18))
+    out = Builder(seed=10, count=8, jobs=4).run(lambda: body())
+    assert isinstance(out, UnpicklableResult)
+    assert "lambda" in out.repr or "function" in out.repr
+
+
+def _machine_parallelism() -> float:
+    """Raw fork calibration: ratio of 2-parallel-burns wall to 1 burn.
+
+    Sandboxed CI often advertises N vCPUs but delivers ~1 core of real
+    throughput; the framework can't beat physics, so the speedup assertion
+    only runs where parallel forks actually overlap (ratio well under 2).
+    """
+    import time as _time
+
+    def burn() -> int:
+        x = 1
+        for _ in range(2_000_000):
+            x = (x * 1103515245 + 12345) & 0xFFFFFFFF
+        return x
+
+    t0 = _time.perf_counter()
+    burn()
+    one = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    pids = []
+    for _ in range(2):
+        pid = os.fork()
+        if pid == 0:  # child burns and exits immediately — no grandchildren
+            burn()
+            os._exit(0)
+        pids.append(pid)
+    for pid in pids:
+        os.waitpid(pid, 0)
+    two = _time.perf_counter() - t0
+    return two / one
+
+
+def test_builder_jobs_parallel_speedup():
+    # the round-2 weakness: GIL-bound thread jobs gave no speedup. Forked
+    # jobs give real per-seed CPU parallelism wherever the machine has it.
+    # Calibrate first: throttled/shared sandboxes advertise N vCPUs but
+    # deliver ~1 core erratically — only assert timing where two raw forked
+    # burns reliably overlap (best of 2 trials, solidly parallel).
+    if min(_machine_parallelism(), _machine_parallelism()) > 1.4:
+        pytest.skip("machine can't reliably run 2 CPU-bound processes in parallel")
+    import time as _time
+
+    async def body():
+        x = ms.Handle.current().seed
+        for _ in range(600_000):
+            x = (x * 1103515245 + 12345) & 0xFFFFFFFF
+        return x
+
+    t0 = _time.perf_counter()
+    Builder(seed=0, count=8, jobs=1).run(lambda: body())
+    serial = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    Builder(seed=0, count=8, jobs=2).run(lambda: body())
+    forked = _time.perf_counter() - t0
+    assert forked < serial / 1.3, (serial, forked)
 
 
 def test_failure_reports_repro_seed():

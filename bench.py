@@ -25,6 +25,10 @@ def bench_tpu(lanes: int, virtual_secs: float, client_rate: float) -> dict:
     spec = make_raft_spec(n_nodes=5, client_rate=client_rate)
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
+        # 4 slots per origin region: r02's 64 (2/region) overflowed 894
+        # messages over the sweep — unaccounted loss outside loss_rate;
+        # headline config must drop NOTHING the network didn't roll to drop
+        msg_capacity=128,
         loss_rate=0.10,
         crash_interval_lo_us=500_000,
         crash_interval_hi_us=3_000_000,
@@ -88,6 +92,44 @@ def bench_kv(lanes: int, virtual_secs: float) -> dict:
     }
 
 
+def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
+    """The HONEST CPU denominator: a compiled thread-per-seed DES fuzzer
+    (native/raft_bench.cpp) running the same protocol + chaos + invariant
+    checks as the device spec, single-core — what the reference's compiled
+    Rust executor model achieves per core on this workload. Compiled on
+    demand with g++ -O2; returns None when no C++ toolchain exists.
+    """
+    import pathlib
+    import shutil
+    import subprocess
+
+    src = pathlib.Path(__file__).parent / "madsim_tpu" / "native" / "raft_bench.cpp"
+    out = pathlib.Path(__file__).parent / "build" / "raft_bench"
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None or not src.exists():
+        return None
+    if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+        out.parent.mkdir(exist_ok=True)
+        r = subprocess.run(
+            [gxx, "-O2", "-std=c++17", "-o", str(out), str(src)],
+            capture_output=True, text=True,
+        )
+        if r.returncode != 0:
+            return None
+    try:
+        r = subprocess.run(
+            [str(out), str(n_seeds), str(virtual_secs), str(client_rate), "0.1"],
+            capture_output=True, text=True, timeout=600,
+        )
+        if r.returncode != 0:
+            return None
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        # degrade to the python_host denominator, like the missing-toolchain
+        # and compile-failure paths — never kill the bench
+        return None
+
+
 def bench_cpu_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
     from madsim_tpu.workloads.raft_host import fuzz_one_seed
 
@@ -122,20 +164,39 @@ def main() -> None:
     args = parser.parse_args()
 
     cpu = bench_cpu_baseline(args.cpu_seeds, args.virtual_secs, args.client_rate)
+    cpp = bench_cpp_baseline(
+        max(args.cpu_seeds * 16, 256), args.virtual_secs, args.client_rate
+    )
     tpu = bench_tpu(args.lanes, args.virtual_secs, args.client_rate)
     kv = bench_kv(args.lanes // 4, args.virtual_secs)
 
+    # vs_baseline is computed against the STRONGEST CPU execution available:
+    # the compiled C++ thread-per-seed DES (the reference's execution model)
+    # when a toolchain exists, else the Python host runtime. Both
+    # denominators are reported; the C++ one is single-core (the reference
+    # sweeps seeds thread-per-core, so per-core is the honest unit).
+    strongest = max(
+        cpu["seeds_per_sec"], cpp["seeds_per_sec"] if cpp else 0.0
+    )
     result = {
         "metric": "raft5_fuzz_seeds_per_sec",
         "value": round(tpu["seeds_per_sec"], 2),
         "unit": "seeds/s",
-        "vs_baseline": round(tpu["seeds_per_sec"] / cpu["seeds_per_sec"], 2),
+        "vs_baseline": round(tpu["seeds_per_sec"] / strongest, 2),
+        "baseline_kind": "cpp_compiled_single_core" if cpp else "python_host",
         "lanes": args.lanes,
         "virtual_secs": args.virtual_secs,
         "tpu_wall_s": round(tpu["wall_s"], 3),
         "tpu_events_per_sec": round(tpu["events_per_sec"], 1),
         "cpu_baseline_seeds_per_sec": round(cpu["seeds_per_sec"], 3),
         "cpu_baseline_events_per_sec": round(cpu["events_per_sec"], 1),
+        "cpp_baseline_seeds_per_sec": (
+            round(cpp["seeds_per_sec"], 2) if cpp else None
+        ),
+        "cpp_baseline_events_per_sec": (
+            round(cpp["events_per_sec"], 1) if cpp else None
+        ),
+        "vs_python_host": round(tpu["seeds_per_sec"] / cpu["seeds_per_sec"], 2),
         "violations": tpu["summary"]["violations"],
         "overflow": tpu["summary"]["total_overflow"],
         "log_saturated_lanes": tpu["summary"].get("log_saturated_lanes", 0),

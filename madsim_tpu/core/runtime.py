@@ -190,9 +190,14 @@ def _check_hash_randomization() -> None:
     global _warned_hash_randomization
     if _warned_hash_randomization:
         return
-    import sys
+    import os
 
-    if sys.flags.hash_randomization:
+    # NB: sys.flags.hash_randomization is 1 for ANY env value except "0" —
+    # including pinned nonzero seeds like PYTHONHASHSEED=12345, which ARE
+    # cross-process reproducible. The env var is the ground truth.
+    seed = os.environ.get("PYTHONHASHSEED", "")
+    pinned = seed.isdigit()  # any fixed integer pins the hash seed
+    if not pinned:
         import warnings
 
         _warned_hash_randomization = True

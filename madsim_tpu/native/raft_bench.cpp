@@ -1,0 +1,520 @@
+/* The honest CPU baseline: a compiled thread-per-seed Raft DES fuzzer.
+ *
+ * The reference executes one seed per OS thread in compiled Rust
+ * (runtime/builder.rs:118-136). Python host seeds/s is therefore not an
+ * honest denominator for the TPU engine's seeds/s — this program is: a
+ * from-scratch C++ discrete-event simulator running the SAME protocol,
+ * chaos model and invariant checks as the device spec (madsim_tpu/tpu/
+ * raft.py + engine.py), as fast as a single CPU core can go. bench.py
+ * compiles it on demand (g++ -O2) and reports its seeds/s alongside the
+ * Python host number; vs_baseline is computed against the STRONGEST CPU
+ * execution available.
+ *
+ * Semantic parity with the device spec (not bit parity — per-backend
+ * determinism is the contract, SURVEY.md §7 step 1):
+ *   - 5-node Raft: randomized elections, single-entry AppendEntries,
+ *     majority commit, client writes at the leader, sliding-window log
+ *     with chain-hash compaction + InstallSnapshot (raft.py).
+ *   - chaos: message loss, 1-10ms latency, crash/restart cycles, random
+ *     bipartitions with heal (engine.py steps 5/5b).
+ *   - invariants after every event-batch step: election safety + committed
+ *     prefix agreement via chain hashes (raft.py check_invariants).
+ *   - event loop: advance clock to next event, deliver due messages (at
+ *     most one per node per step, random tie-break), fire due timers,
+ *     chaos, then check — the engine.py step structure on one lane.
+ *
+ * Usage: raft_bench <n_seeds> <virtual_secs> <client_rate> <loss_rate>
+ * Prints one JSON line: {"seeds": N, "wall_s": ..., "seeds_per_sec": ...,
+ *                        "events_per_sec": ..., "violations": 0}
+ */
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int N = 5;
+constexpr int LOG = 24;
+constexpr int KEEP = LOG / 4;  // raft.py compact(): max(LOG//4, 2)
+constexpr int PAYLOAD = 6;
+constexpr int64_t INF_US = INT64_MAX / 4;
+
+// message kinds (raft.py:49)
+enum { REQUEST_VOTE = 0, VOTE_RESP, APPEND, APPEND_RESP, SNAP };
+enum { FOLLOWER = 0, CANDIDATE, LEADER };
+
+/* ----- PRNG: xoshiro256++ per seed (rng.py / _core.cpp family) ---------- */
+static inline uint64_t rotl64(uint64_t x, int k) {
+  return (x << k) | (x >> (64 - k));
+}
+
+struct Rng {
+  uint64_t s[4];
+  void seed(uint64_t v) {
+    uint64_t st = v;
+    for (int i = 0; i < 4; i++) {
+      st += 0x9E3779B97F4A7C15ULL;
+      uint64_t z = st;
+      z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+      z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+      s[i] = z ^ (z >> 31);
+    }
+  }
+  uint64_t next() {
+    uint64_t r = rotl64(s[0] + s[3], 23) + s[0];
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl64(s[3], 45);
+    return r;
+  }
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+  int64_t randint(int64_t lo, int64_t hi) {  // [lo, hi)
+    if (hi <= lo) return lo;
+    return lo + (int64_t)(next() % (uint64_t)(hi - lo));
+  }
+};
+
+/* ----- chain hash: murmur fmix32 fold (prng.py mix/fold family) --------- */
+static inline uint32_t fmix32(uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x85EBCA6Bu;
+  x ^= x >> 13;
+  x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return x;
+}
+static inline uint32_t fold(uint32_t h, uint32_t w) {
+  return fmix32(h ^ (w * 0x9E3779B9u));
+}
+static inline uint32_t chain_fold(uint32_t h, int32_t term, int32_t cmd) {
+  return fold(fold(h, (uint32_t)term), (uint32_t)cmd);
+}
+
+/* ----- per-node Raft state (raft.py RaftState) -------------------------- */
+struct Node {
+  int32_t term, voted_for, role, votes;
+  int32_t base, base_term;
+  uint32_t base_hash;
+  int32_t log_term[LOG], log_cmd[LOG];
+  int32_t log_len;  // absolute
+  int32_t commit;   // absolute
+  int32_t next_idx[N], match_idx[N];
+  int32_t next_cmd;
+
+  void init() {
+    std::memset(this, 0, sizeof(*this));
+    voted_for = -1;
+    base_hash = 0x9E37u;
+    commit = -1;
+    for (int i = 0; i < N; i++) match_idx[i] = -1;
+    next_cmd = 1;
+  }
+  int32_t term_at(int32_t i) const {  // raft.py term_at
+    if (i == base - 1) return base_term;
+    int32_t rel = i - base;
+    return (rel >= 0 && rel < LOG) ? log_term[rel] : 0;
+  }
+  int32_t cmd_at(int32_t i) const {
+    int32_t rel = i - base;
+    return (rel >= 0 && rel < LOG) ? log_cmd[rel] : 0;
+  }
+  uint32_t hash_at(int32_t i) const {  // chain hash of prefix [0, i]
+    if (i == base - 1) return base_hash;
+    uint32_t h = base_hash;
+    for (int32_t r = 0; r <= i - base; r++) h = chain_fold(h, log_term[r], log_cmd[r]);
+    return h;
+  }
+  void compact() {  // raft.py compact()
+    if (log_len - base <= LOG / 2) return;
+    int32_t nb = std::min(commit + 1, log_len - KEEP);
+    nb = std::max(nb, base);
+    if (nb <= base) return;
+    uint32_t h = hash_at(nb - 1);
+    int32_t bt = term_at(nb - 1);
+    int32_t d = nb - base;
+    for (int r = 0; r < LOG; r++) {
+      log_term[r] = (r + d < LOG) ? log_term[r + d] : 0;
+      log_cmd[r] = (r + d < LOG) ? log_cmd[r + d] : 0;
+    }
+    base = nb;
+    base_hash = h;
+    base_term = bt;
+  }
+};
+
+struct Msg {
+  int64_t deliver;
+  uint32_t tiebreak;  // scheduling-order nondeterminism (mpsc.rs:71-84 analog)
+  int32_t src, dst, kind;
+  int32_t pay[PAYLOAD];
+};
+
+struct Config {
+  int64_t horizon_us;
+  double loss_rate, client_rate;
+  bool buggy = false;  // injected single-ack-commit bug (detector validation)
+  int64_t lat_lo = 1'000, lat_hi = 10'000;
+  int64_t crash_lo = 500'000, crash_hi = 3'000'000;
+  int64_t restart_lo = 300'000, restart_hi = 2'000'000;
+  int64_t part_lo = 300'000, part_hi = 1'500'000;
+  int64_t heal_lo = 500'000, heal_hi = 2'000'000;
+  int64_t election_lo = 150'000, election_hi = 300'000;
+  int64_t heartbeat = 50'000;
+};
+
+/* ----- one lane: the engine.py step loop on one seed -------------------- */
+struct Sim {
+  const Config& cfg;
+  Rng rng;
+  int64_t clock = 0;
+  Node node[N];
+  bool alive[N];
+  int64_t timer[N];
+  std::vector<Msg> pool;  // in-flight messages (small: scan beats a heap)
+  int crashed = -1;
+  int64_t chaos_at, part_at;
+  bool partitioned = false;
+  uint8_t side = 0;  // bipartition side bitmask
+  int64_t events = 0;
+  bool violated = false;
+
+  explicit Sim(const Config& c, uint64_t seed) : cfg(c) {
+    rng.seed(seed);
+    for (int i = 0; i < N; i++) {
+      node[i].init();
+      alive[i] = true;
+      timer[i] = rng.randint(cfg.election_lo, cfg.election_hi);
+    }
+    pool.reserve(64);
+    chaos_at = rng.randint(cfg.crash_lo, cfg.crash_hi);
+    part_at = rng.randint(cfg.part_lo, cfg.part_hi);
+  }
+
+  bool link_ok(int a, int b) const {
+    if (!partitioned) return true;
+    return ((side >> a) & 1) == ((side >> b) & 1);
+  }
+
+  void send(int src, int dst, int kind, const int32_t pay[PAYLOAD]) {
+    if (dst == src || !alive[dst] || !link_ok(src, dst)) return;
+    if (rng.uniform() < cfg.loss_rate) return;
+    Msg m;
+    m.deliver = clock + rng.randint(cfg.lat_lo, cfg.lat_hi);
+    m.tiebreak = (uint32_t)rng.next();
+    m.src = src;
+    m.dst = dst;
+    m.kind = kind;
+    std::memcpy(m.pay, pay, sizeof(m.pay));
+    pool.push_back(m);
+  }
+
+  /* -- protocol handlers: raft.py on_timer / on_message ported ---------- */
+
+  void on_timer(int nid) {
+    Node& s = node[nid];
+    s.compact();
+    if (s.role == LEADER) {
+      // maybe append a client command
+      if (s.log_len - s.base < LOG && rng.uniform() < cfg.client_rate) {
+        int32_t rel = s.log_len - s.base;
+        s.log_cmd[rel] = nid * 100'000 + s.next_cmd;
+        s.log_term[rel] = s.term;
+        s.log_len++;
+        s.next_cmd++;
+      }
+      for (int p = 0; p < N; p++) {
+        if (p == nid) continue;
+        if (s.next_idx[p] < s.base) {  // lagging follower: InstallSnapshot
+          int32_t pay[PAYLOAD] = {s.term, s.base - 1, s.base_term,
+                                  (int32_t)s.base_hash, 0, s.commit};
+          send(nid, p, SNAP, pay);
+        } else {
+          int32_t prev = s.next_idx[p] - 1;
+          bool has = s.next_idx[p] < s.log_len;
+          int32_t pay[PAYLOAD] = {s.term, prev, s.term_at(prev),
+                                  has ? s.term_at(s.next_idx[p]) : 0,
+                                  has ? s.cmd_at(s.next_idx[p]) : 0, s.commit};
+          send(nid, p, APPEND, pay);
+        }
+      }
+      timer[nid] = clock + cfg.heartbeat;
+    } else {  // election timeout
+      s.term++;
+      s.voted_for = nid;
+      s.role = CANDIDATE;
+      s.votes = 1 << nid;
+      int32_t last = s.log_len - 1;
+      int32_t pay[PAYLOAD] = {s.term, last, s.term_at(last), 0, 0, 0};
+      for (int p = 0; p < N; p++)
+        if (p != nid) send(nid, p, REQUEST_VOTE, pay);
+      timer[nid] = clock + rng.randint(cfg.election_lo, cfg.election_hi);
+    }
+  }
+
+  void on_message(int nid, const Msg& m) {
+    Node& s = node[nid];
+    const int32_t* f = m.pay;
+    switch (m.kind) {
+      case REQUEST_VOTE: {
+        if (f[0] > s.term) { s.term = f[0]; s.role = FOLLOWER; s.voted_for = -1; }
+        int32_t ml = s.log_len - 1, mt = s.term_at(ml);
+        bool log_ok = f[2] > mt || (f[2] == mt && f[1] >= ml);
+        bool grant = f[0] == s.term &&
+                     (s.voted_for == -1 || s.voted_for == m.src) && log_ok;
+        if (grant) {
+          s.voted_for = m.src;
+          timer[nid] = clock + rng.randint(cfg.election_lo, cfg.election_hi);
+        }
+        int32_t pay[PAYLOAD] = {s.term, grant, 0, 0, 0, 0};
+        send(nid, m.src, VOTE_RESP, pay);
+        break;
+      }
+      case VOTE_RESP: {
+        if (f[0] > s.term) { s.term = f[0]; s.role = FOLLOWER; s.voted_for = -1; }
+        if (s.role == CANDIDATE && f[0] == s.term && f[1]) {
+          s.votes |= 1 << m.src;
+          if (__builtin_popcount((unsigned)s.votes) > N / 2) {
+            s.role = LEADER;
+            for (int p = 0; p < N; p++) {
+              s.next_idx[p] = s.log_len;
+              s.match_idx[p] = (p == nid) ? s.log_len - 1 : -1;
+            }
+            timer[nid] = clock;  // heartbeat immediately
+          }
+        }
+        break;
+      }
+      case APPEND: {
+        bool stale = f[0] < s.term;
+        if (!stale) {
+          if (f[0] > s.term) s.voted_for = -1;
+          s.term = f[0];
+          s.role = FOLLOWER;
+          s.compact();  // follower-side compaction (raft.py h_append)
+          int32_t prev = f[1];
+          bool prev_ok = prev < 0 || (prev < s.log_len && prev >= s.base - 1 &&
+                                      s.term_at(prev) == f[2]);
+          bool has = f[3] > 0;
+          int32_t match = -1;
+          if (prev_ok) {
+            int32_t w = prev + 1, rel = w - s.base;
+            bool in_win = rel >= 0 && rel < LOG;
+            if (has && in_win) {
+              bool same = w < s.log_len && s.term_at(w) == f[3];
+              s.log_term[rel] = f[3];
+              s.log_cmd[rel] = f[4];
+              if (!same) s.log_len = w + 1;
+              match = w;
+            } else {
+              match = prev;
+            }
+            s.commit = std::max(s.commit, std::min(f[5], match));
+          }
+          int32_t pay[PAYLOAD] = {s.term, prev_ok, match, 0, 0, 0};
+          send(nid, m.src, APPEND_RESP, pay);
+          timer[nid] = clock + rng.randint(cfg.election_lo, cfg.election_hi);
+        } else {
+          int32_t pay[PAYLOAD] = {s.term, 0, -1, 0, 0, 0};
+          send(nid, m.src, APPEND_RESP, pay);
+        }
+        break;
+      }
+      case APPEND_RESP: {
+        if (f[0] > s.term) { s.term = f[0]; s.role = FOLLOWER; s.voted_for = -1; break; }
+        if (s.role != LEADER || f[0] != s.term) break;
+        if (f[1]) {
+          s.match_idx[m.src] = std::max(s.match_idx[m.src], f[2]);
+          s.next_idx[m.src] = std::max(s.next_idx[m.src], f[2] + 1);
+        } else {
+          s.next_idx[m.src] = std::max(0, s.next_idx[m.src] - 1);
+        }
+        if (cfg.buggy) {
+          // the classic unsafe commit: any single ack advances commit, no
+          // current-term check (what the device fuzz must also catch)
+          int32_t maj = std::min(f[2], s.log_len - 1);
+          if (f[1] && maj > s.commit) s.commit = maj;
+          break;
+        }
+        int32_t sorted[N];
+        for (int p = 0; p < N; p++)
+          sorted[p] = (p == nid) ? s.log_len - 1 : s.match_idx[p];
+        std::sort(sorted, sorted + N);
+        int32_t maj = sorted[N - (N / 2 + 1)];
+        if (maj > s.commit && s.term_at(maj) == s.term) s.commit = maj;
+        break;
+      }
+      case SNAP: {  // raft.py h_snap
+        bool stale = f[0] < s.term;
+        if (!stale) {
+          if (f[0] > s.term) s.voted_for = -1;
+          s.term = f[0];
+          s.role = FOLLOWER;
+          int32_t snap_idx = f[1];
+          // adopt whenever the snapshot advances commit, discarding the
+          // whole local log (Raft §7; see raft.py h_snap for the SNAP-loop
+          // wedge the old extra log_len condition caused)
+          if (snap_idx > s.commit) {
+            s.base = snap_idx + 1;
+            s.base_term = f[2];
+            s.base_hash = (uint32_t)f[3];
+            std::memset(s.log_term, 0, sizeof(s.log_term));
+            std::memset(s.log_cmd, 0, sizeof(s.log_cmd));
+            s.log_len = snap_idx + 1;
+            s.commit = snap_idx;
+            int32_t pay[PAYLOAD] = {s.term, 1, snap_idx, 0, 0, 0};
+            send(nid, m.src, APPEND_RESP, pay);
+          } else {
+            // only the committed intersection is VERIFIED agreement; acking
+            // log_len - 1 here claimed the unverified tail as matched and
+            // let leaders commit divergent entries (fuzz-found, raft.py
+            // h_snap has the full story)
+            int32_t pay[PAYLOAD] = {s.term, 1, std::min(snap_idx, s.commit),
+                                    0, 0, 0};
+            send(nid, m.src, APPEND_RESP, pay);
+          }
+          timer[nid] = clock + rng.randint(cfg.election_lo, cfg.election_hi);
+        }
+        break;
+      }
+    }
+  }
+
+  void on_restart(int nid) {  // raft.py on_restart: durable state survives
+    Node& s = node[nid];
+    s.role = FOLLOWER;
+    s.votes = 0;
+    s.commit = s.base - 1;
+    for (int p = 0; p < N; p++) { s.next_idx[p] = 0; s.match_idx[p] = -1; }
+    timer[nid] = clock + rng.randint(cfg.election_lo, cfg.election_hi);
+  }
+
+  /* -- invariants (raft.py check_invariants), after every step ---------- */
+  bool check() {
+    // election safety
+    for (int a = 0; a < N; a++)
+      for (int b = a + 1; b < N; b++)
+        if (node[a].role == LEADER && node[b].role == LEADER &&
+            node[a].term == node[b].term)
+          return false;
+    // committed-prefix agreement via chain hashes
+    for (int a = 0; a < N; a++)
+      for (int b = a + 1; b < N; b++) {
+        int32_t m = std::min(node[a].commit, node[b].commit);
+        if (m < 0) continue;
+        bool ka = m >= node[a].base - 1 && m < node[a].log_len;
+        bool kb = m >= node[b].base - 1 && m < node[b].log_len;
+        if (ka && kb && node[a].hash_at(m) != node[b].hash_at(m)) return false;
+      }
+    return true;
+  }
+
+  /* -- the DES loop: engine.py _step on one lane ------------------------ */
+  void run() {
+    while (clock < cfg.horizon_us && !violated) {
+      // next event time across messages, timers, chaos
+      int64_t t = INF_US;
+      for (const Msg& m : pool)
+        if (alive[m.dst]) t = std::min(t, m.deliver);
+      for (int n = 0; n < N; n++)
+        if (alive[n]) t = std::min(t, timer[n]);
+      t = std::min(t, std::min(chaos_at, part_at));
+      if (t >= INF_US) break;  // deadlock (cannot happen with chaos armed)
+      clock = std::max(clock, t);
+
+      // deliver earliest due message per node (random tie-break)
+      for (int n = 0; n < N; n++) {
+        if (!alive[n]) continue;
+        int best = -1;
+        for (int i = 0; i < (int)pool.size(); i++) {
+          const Msg& m = pool[i];
+          if (m.dst != n || m.deliver > clock) continue;
+          if (best < 0 || m.deliver < pool[best].deliver ||
+              (m.deliver == pool[best].deliver && m.tiebreak < pool[best].tiebreak))
+            best = i;
+        }
+        if (best >= 0) {
+          Msg m = pool[best];
+          pool[best] = pool.back();
+          pool.pop_back();
+          on_message(n, m);
+          events++;
+        }
+      }
+      // fire due timers
+      for (int n = 0; n < N; n++)
+        if (alive[n] && timer[n] <= clock) { on_timer(n); events++; }
+
+      // crash/restart chaos
+      if (chaos_at <= clock) {
+        if (crashed < 0) {
+          crashed = (int)rng.randint(0, N);
+          alive[crashed] = false;
+          // in-flight messages to the crashed node are lost
+          pool.erase(std::remove_if(pool.begin(), pool.end(),
+                                    [&](const Msg& m) { return m.dst == crashed; }),
+                     pool.end());
+          chaos_at = clock + rng.randint(cfg.restart_lo, cfg.restart_hi);
+        } else {
+          alive[crashed] = true;
+          on_restart(crashed);
+          crashed = -1;
+          chaos_at = clock + rng.randint(cfg.crash_lo, cfg.crash_hi);
+        }
+      }
+      // partition chaos
+      if (part_at <= clock) {
+        if (!partitioned) {
+          side = 0;
+          for (int n = 0; n < N; n++)
+            if (rng.uniform() < 0.5) side |= (uint8_t)(1 << n);
+          partitioned = true;
+          part_at = clock + rng.randint(cfg.heal_lo, cfg.heal_hi);
+        } else {
+          partitioned = false;
+          part_at = clock + rng.randint(cfg.part_lo, cfg.part_hi);
+        }
+      }
+
+      if (!check()) violated = true;
+    }
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int n_seeds = argc > 1 ? std::atoi(argv[1]) : 64;
+  double virtual_secs = argc > 2 ? std::atof(argv[2]) : 10.0;
+  double client_rate = argc > 3 ? std::atof(argv[3]) : 0.1;
+  double loss_rate = argc > 4 ? std::atof(argv[4]) : 0.1;
+
+  Config cfg;
+  cfg.horizon_us = (int64_t)(virtual_secs * 1e6);
+  cfg.client_rate = client_rate;
+  cfg.loss_rate = loss_rate;
+  cfg.buggy = argc > 5 && std::atoi(argv[5]) != 0;
+
+  int64_t events = 0, violations = 0;
+  auto t0 = std::chrono::steady_clock::now();
+  for (int s = 0; s < n_seeds; s++) {
+    Sim sim(cfg, (uint64_t)s);
+    sim.run();
+    events += sim.events;
+    violations += sim.violated ? 1 : 0;
+  }
+  double wall = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+  std::printf(
+      "{\"seeds\": %d, \"wall_s\": %.4f, \"seeds_per_sec\": %.2f, "
+      "\"events_per_sec\": %.1f, \"violations\": %lld}\n",
+      n_seeds, wall, n_seeds / wall, events / wall, (long long)violations);
+  return 0;
+}

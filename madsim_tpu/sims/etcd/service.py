@@ -418,9 +418,15 @@ class ServiceInner:
 
     def lease_grant(self, ttl: int, id: int = 0) -> LeaseGrantResponse:
         if id == 0:
-            rng = context.current_handle().rng
+            handle = context.try_current_handle()
+            if handle is not None:
+                draw = lambda: handle.rng.next_u64() >> 1  # noqa: E731
+            else:  # production mode: OS entropy (determinism is sim-only)
+                import os as _os
+
+                draw = lambda: int.from_bytes(_os.urandom(8), "little") >> 1  # noqa: E731
             while id == 0 or id in self.lease:
-                id = rng.next_u64() >> 1  # non-negative i64
+                id = draw()  # non-negative i64
         if id in self.lease:
             raise EtcdError("lease ID already exists")
         self.lease[id] = _Lease(ttl=ttl, granted_ttl=ttl)
@@ -626,7 +632,11 @@ class EtcdService:
             self.inner.tick()
 
     async def _timeout(self) -> None:
-        handle = context.current_handle()
+        # production mode has no sim context (and no injected timeouts —
+        # they are a chaos feature of the simulation, lib.rs:14-23 switch)
+        handle = context.try_current_handle()
+        if handle is None:
+            return
         if self.timeout_rate > 0 and handle.rng.random() < self.timeout_rate:
             from ...core.vtime import sleep
 

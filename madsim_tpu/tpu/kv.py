@@ -20,6 +20,18 @@ and per-operation quorum probes):
     revision and becomes PRIMARY on a majority — the state-transfer that
     makes a new primary inherit every committed write (quorum
     intersection).
+  * Mandate recovery: before serving ANYTHING, a new primary re-commits
+    every merged key under its own epoch (fresh revisions, same values)
+    through the normal write-quorum machinery, client requests shed until
+    done. This is Paxos' "adopt the highest accepted value, then re-propose
+    under your own ballot": replicas apply-on-receive, so a claim quorum
+    can hand the claimer values that were never chosen, and serving one
+    straight from the merged store exposes it to clients while a FUTURE
+    claim quorum may not intersect the nodes holding it — an observable
+    revision regression. Found by this framework's own fuzz at 2048 lanes
+    (one seed: an epoch-45 write reached one node, an epoch-69 claimer
+    merged + served it unrecommitted for 2 virtual seconds, an epoch-110
+    claimer never learned it).
   * Writes: client sends CREQ to its believed primary (epoch % N). The
     primary assigns rev = epoch * REV_STRIDE + counter (monotonic across
     epochs), broadcasts WRITE_REP, commits + acks the client only after a
@@ -81,6 +93,8 @@ class KvState(NamedTuple):
     pend_client: jnp.ndarray  # i32               (volatile)
     pend_tinv: jnp.ndarray  # i32                 (volatile)
     pend_t: jnp.ndarray  # i32                    (volatile)
+    pend_recover: jnp.ndarray  # i32 bool: mandate-recovery round (volatile)
+    recover_left: jnp.ndarray  # i32 keys still to re-commit      (volatile)
     wcount: jnp.ndarray  # i32                    (volatile; safe: fresh epoch per mandate)
     # client side
     creq_kind: jnp.ndarray  # i32 0=none          (volatile)
@@ -196,6 +210,7 @@ def make_kv_spec(
             claim_t=z,
             pend_kind=z, pend_key=z, pend_val=z, pend_rev=z,
             pend_acks=z, pend_client=z, pend_tinv=z, pend_t=z,
+            pend_recover=z, recover_left=z,
             wcount=z,
             creq_kind=z, creq_key=z, creq_val=z, creq_t=z,
             ccount=jnp.int32(1),
@@ -232,6 +247,16 @@ def make_kv_spec(
             now - s.pend_t > pend_timeout_us
         )
         pend_kind = jnp.where(pend_expired, 0, s.pend_kind)
+        pend_recover = jnp.where(pend_expired, 0, s.pend_recover)
+
+        # -- mandate recovery: re-commit the next merged key under this
+        #    epoch (normal write-quorum machinery, one round at a time;
+        #    recover_left unchanged on round timeout => same key retries)
+        start_rec = is_primary & (s.recover_left > 0) & (pend_kind == 0)
+        rec_key = jnp.clip(K - s.recover_left, 0, K - 1)
+        rec_at = (kidx == rec_key).astype(jnp.int32)
+        rec_val = (s.kv_val * rec_at).sum()
+        rid_rec = s.epoch * REV_STRIDE + s.wcount + 1
 
         # -- client: expire a stuck request, else maybe issue a new one
         req_expired = (s.creq_kind > 0) & (now - s.creq_t > req_timeout_us)
@@ -250,16 +275,35 @@ def make_kv_spec(
 
         state = s._replace(
             role=role, epoch=new_epoch, claim_acks=claim_acks, claim_t=claim_t,
-            pend_kind=pend_kind,
+            pend_kind=jnp.where(start_rec, OP_WRITE, pend_kind),
+            pend_key=jnp.where(start_rec, rec_key, s.pend_key),
+            pend_val=jnp.where(start_rec, rec_val, s.pend_val),
+            pend_rev=jnp.where(start_rec, rid_rec, s.pend_rev),
+            pend_acks=jnp.where(start_rec, jnp.int32(1) << nid, s.pend_acks),
+            pend_recover=jnp.where(start_rec, 1, pend_recover),
+            pend_t=jnp.where(start_rec, now, s.pend_t),
+            wcount=s.wcount + start_rec.astype(jnp.int32),
             creq_kind=creq_kind, creq_key=creq_key, creq_val=creq_val,
             creq_t=creq_t, ccount=ccount,
         )
 
-        # -- outbox: broadcast (HB when primary, CLAIM when claiming) in the
+        # -- outbox: broadcast (CLAIM when claiming, recovery WREP when
+        #    re-committing a mandate — doubling as the heartbeat, since any
+        #    epoch-fresh quorum traffic feeds last_hb — else HB) in the
         #    first N slots + the client CREQ in slot N
-        bc_kind = jnp.where(claim, CLAIM, HB)
+        bc_kind = jnp.where(claim, CLAIM, jnp.where(start_rec, WREP, HB))
         bc_valid = (peers != nid) & (is_primary | claim)
-        bc_pay = jnp.zeros((N, P), jnp.int32).at[:, 0].set(new_epoch)
+        hb_pay = jnp.zeros((N, P), jnp.int32).at[:, 0].set(new_epoch)
+        rec_pay = (
+            jnp.zeros((P,), jnp.int32)
+            .at[0].set(new_epoch)
+            .at[1].set(rid_rec)
+            .at[2].set(rec_key)
+            .at[3].set(rec_val)
+        )
+        bc_pay = jnp.where(
+            jnp.reshape(start_rec, (1, 1)), rec_pay[None, :], hb_pay
+        )
         creq_pay = (
             jnp.zeros((P,), jnp.int32)
             .at[0].set(state.epoch)
@@ -301,6 +345,7 @@ def make_kv_spec(
             role=jnp.where(accept, REPLICA, s.role),  # deposes a primary
             last_hb=jnp.where(accept, now, s.last_hb),
             pend_kind=jnp.where(accept, 0, s.pend_kind),
+            pend_recover=jnp.where(accept, 0, s.pend_recover),
         )
         fields = [s.epoch] + [s.kv_val[k] for k in range(K)] + [
             s.kv_rev[k] for k in range(K)
@@ -326,6 +371,9 @@ def make_kv_spec(
             role=jnp.where(won, PRIMARY, s.role),
             wcount=jnp.where(won, 0, s.wcount),
             pend_kind=jnp.where(won, 0, s.pend_kind),
+            # mandate recovery: every key must re-commit under this epoch
+            # before any client op is served (see module docstring)
+            recover_left=jnp.where(won, K, s.recover_left),
         )
         return s, no_out(), jnp.int32(-1)
 
@@ -352,14 +400,21 @@ def make_kv_spec(
         )
         at = kidx == s.pend_key
         apply_ = commit & at & (s.pend_rev > s.kv_rev)
+        is_rec = s.pend_recover > 0
         s = s._replace(
             pend_acks=acks,
             kv_val=jnp.where(apply_, s.pend_val, s.kv_val),
             kv_rev=jnp.where(apply_, s.pend_rev, s.kv_rev),
             pend_kind=jnp.where(commit, 0, s.pend_kind),
+            pend_recover=jnp.where(commit, 0, s.pend_recover),
+            # a committed recovery round finishes one key of the mandate
+            recover_left=jnp.where(
+                commit & is_rec, jnp.maximum(s.recover_left - 1, 0),
+                s.recover_left,
+            ),
         )
         out = out_if(
-            commit,
+            commit & ~is_rec,  # recovery rounds have no client to answer
             reply(
                 s.pend_client,
                 CRSP,
@@ -404,9 +459,14 @@ def make_kv_spec(
 
     def h_creq(s: KvState, nid, src, f, now, key):
         op_kind, op_key, op_val, tinv = f[1], f[2], f[3], f[4]
-        # only an idle primary starts a quorum round; otherwise drop (the
-        # client times out and retries — standard overload shedding)
-        start = (s.role == PRIMARY) & (s.pend_kind == 0) & (op_kind > 0)
+        # only an idle primary with a FULLY RECOVERED mandate starts a
+        # quorum round; otherwise drop (the client times out and retries —
+        # standard overload shedding). Serving before recovery completes is
+        # exactly the fuzz-found stale-serve bug (module docstring).
+        start = (
+            (s.role == PRIMARY) & (s.pend_kind == 0) & (op_kind > 0)
+            & (s.recover_left == 0)
+        )
         rid = s.epoch * REV_STRIDE + s.wcount + 1
         s = s._replace(
             pend_kind=jnp.where(start, op_kind, s.pend_kind),
@@ -456,7 +516,7 @@ def make_kv_spec(
             role=jnp.int32(REPLICA),
             last_hb=now,  # grace period before claiming
             claim_acks=z, claim_t=z,
-            pend_kind=z, pend_acks=z,
+            pend_kind=z, pend_acks=z, pend_recover=z, recover_left=z,
             creq_kind=z,
             wcount=z,
         )
@@ -575,6 +635,11 @@ def kv_workload(
 
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
+        # KV fans out 2 quorum rounds per op (N-wide WREP/RPROBE) plus HBs;
+        # the default 64-slot pool left regions 1-deep (C=55) and overflowed
+        # ~36k messages per 2048-lane sweep — unmodeled loss. 4-deep regions
+        # drop nothing at this traffic shape.
+        msg_capacity=256,
         loss_rate=loss_rate,
         partition_interval_lo_us=400_000 if partitions else 0,
         partition_interval_hi_us=2_000_000 if partitions else 0,

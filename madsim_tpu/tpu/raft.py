@@ -439,8 +439,16 @@ def make_raft_spec(
         term = jnp.where(stale, s.term, l_term)
         role = jnp.where(stale, s.role, FOLLOWER)
         voted_for = jnp.where(l_term > s.term, -1, s.voted_for)
-        # adopt only when it truly advances us (our whole log is older)
-        adopt = (~stale) & (snap_idx > s.commit) & (snap_idx >= s.log_len - 1)
+        # Adopt whenever the snapshot advances our commit, DISCARDING the
+        # whole local log (Raft §7: "discard the entire log" on
+        # InstallSnapshot). Everything beyond s.commit is uncommitted
+        # locally, so dropping it is safe — it re-fetches via AppendEntries.
+        # The earlier extra condition (snap_idx >= log_len - 1) refused the
+        # snapshot when a divergent uncommitted tail outgrew it, which could
+        # wedge the follower in a SNAP loop forever: it couldn't adopt, its
+        # ack couldn't move the leader's next_idx past the leader's base,
+        # and each SNAP reset its election timer.
+        adopt = (~stale) & (snap_idx > s.commit)
         state = s._replace(
             term=term, role=role, voted_for=voted_for,
             base=jnp.where(adopt, snap_idx + 1, s.base),
@@ -451,7 +459,18 @@ def make_raft_spec(
             log_len=jnp.where(adopt, snap_idx + 1, s.log_len),
             commit=jnp.where(adopt, snap_idx, s.commit),
         )
-        match = jnp.where(adopt, snap_idx, jnp.where(stale, -1, s.log_len - 1))
+        # match may only claim VERIFIED agreement. On adopt the follower now
+        # holds the leader's exact prefix [0, snap_idx]. On non-adopt, only
+        # the committed intersection is known to agree (Leader Completeness);
+        # the old ack of log_len - 1 claimed the follower's unverified,
+        # possibly-divergent tail as matched, letting the leader advance
+        # commit over entries the follower never had — a split-brain commit
+        # found by this framework's own fuzz (device + C++ baseline, 8/512
+        # lanes under compaction + partition chaos).
+        match = jnp.where(
+            adopt, snap_idx,
+            jnp.where(stale, -1, jnp.minimum(snap_idx, s.commit)),
+        )
         out = reply(src, APPEND_RESP, pack(term, ~stale, match, 0, 0, 0))
         timer = jnp.where(~stale, election_deadline(now, key, 27), jnp.int32(-1))
         return state, out, timer

@@ -107,3 +107,13 @@ def test_runtime_warns_on_unpinned_hash():
     )
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "0"  # pinned => silent
+
+    # a pinned NONZERO seed is also cross-process reproducible: no warning
+    # (sys.flags.hash_randomization is 1 here — the env var is ground truth)
+    env["PYTHONHASHSEED"] = "12345"
+    out = subprocess.run(
+        [sys.executable, "-c", probe], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "0"

@@ -122,3 +122,21 @@ def test_kv_workload_run_batch():
     result = ms.Runtime.run_batch(range(32), kv_workload(virtual_secs=4.0))
     assert result.violations == 0
     assert result.summary["mean_acked_ops"] > 0
+
+
+def test_kv_mandate_recovery_regression_wide_sweep():
+    """The fuzz-found stale-serve bug (round 3, seed 2484 of the 2048-lane
+    bench sweep): replicas apply writes on receive, so a claim quorum can
+    hand a new primary values that never committed; serving them without
+    first re-committing under the new epoch exposed a revision regression
+    two elections later. The fix is mandate recovery (kv.py docstring).
+    This sweep is the regression net at the scale that caught it."""
+    wl = kv_workload(virtual_secs=10.0)
+    sim = BatchedSim(wl.spec, wl.config)
+    # seeds [2048, 3072) keep the catching seed 2484 in the net
+    state = sim.run(jnp.arange(2048, 3072), max_steps=14_000)
+    s = summarize(state, wl.spec)
+    assert s["violations"] == 0
+    assert s["total_overflow"] == 0
+    # recovery doesn't strangle throughput: clients still commit plenty
+    assert s["mean_acked_ops"] > 100

@@ -15,3 +15,4 @@ from .rpc import (  # noqa: F401
 )
 from .tcp import TcpListener, TcpStream  # noqa: F401
 from .udp import UdpSocket  # noqa: F401
+from .unix import UnixDatagram, UnixListener, UnixStream  # noqa: F401

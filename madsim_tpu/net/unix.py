@@ -1,0 +1,233 @@
+"""Unix domain socket simulation: path-addressed streams + datagrams.
+
+The reference only STUBS these (madsim/src/sim/net/unix/{mod,stream,
+datagram}.rs are `#![doc(hidden)]` bodies of `todo!()`); this is a working
+implementation of the API they promise (tokio's `UnixListener`/`UnixStream`/
+`UnixDatagram`), modeled faithfully: a unix socket path is HOST-LOCAL, so
+the namespace is per simulated node — a path bound on one node is invisible
+to every other node, and traffic between tasks of one node is loopback
+(reliable, no loss/latency roll — the kernel, not the network).
+
+Kill/restart semantics: a node's paths are released when the node resets
+(the fs is in-memory; a dead process's sockets vanish with it), mirroring
+how NetSim closes the node's sockets (network.rs:142-147).
+
+    listener = await UnixListener.bind("/tmp/app.sock")
+    stream, peer = await listener.accept()
+    ...
+    client = await UnixStream.connect("/tmp/app.sock")
+    await client.write_all(b"hi")
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ..core import context
+from ..core.sync import Channel, ChannelClosed
+from .tcp import TcpStream
+
+_REGISTRY_ATTR = "_unix_path_registry"
+
+
+class _Pipe:
+    """One direction of a loopback connection (PayloadSender/Receiver duck)."""
+
+    def __init__(self, chan: Channel) -> None:
+        self._chan = chan
+
+    def send(self, payload: object) -> None:
+        try:
+            self._chan.send_nowait(payload)
+        except (RuntimeError, ChannelClosed):
+            raise ChannelClosed("peer closed") from None
+
+    async def recv(self) -> object:
+        return await self._chan.recv()
+
+    def close(self) -> None:
+        self._chan.close()
+
+    def is_closed(self) -> bool:
+        return self._chan.closed
+
+
+def _registry() -> Dict[Tuple[int, str], object]:
+    """Per-runtime (node_id, path) -> bound socket registry, reset-aware."""
+    handle = context.current_handle()
+    reg = getattr(handle, _REGISTRY_ATTR, None)
+    if reg is None:
+        reg = {}
+        setattr(handle, _REGISTRY_ATTR, reg)
+
+        def on_reset(node_id: int) -> None:
+            for key in [k for k in reg if k[0] == int(node_id)]:
+                sock = reg.pop(key)
+                close = getattr(sock, "_release", None)
+                if close is not None:
+                    close()
+
+        handle.executor.on_node_reset.append(on_reset)
+    return reg
+
+
+def _here() -> int:
+    return int(context.current_task().node.id)
+
+
+def _bind(path: str, sock: object) -> Tuple[int, str]:
+    reg = _registry()
+    key = (_here(), str(path))
+    if key in reg:
+        raise OSError(f"address already in use: {path}")
+    reg[key] = sock
+    return key
+
+
+def _unbind(key: Tuple[int, str]) -> None:
+    handle = context.try_current_handle()
+    if handle is None:
+        return
+    reg = getattr(handle, _REGISTRY_ATTR, None)
+    if reg is not None:
+        reg.pop(key, None)
+
+
+def _lookup(path: str) -> object:
+    reg = _registry()
+    sock = reg.get((_here(), str(path)))
+    if sock is None:
+        raise ConnectionRefusedError(f"connection refused: {path}")
+    return sock
+
+
+class UnixStream(TcpStream):
+    """Byte stream over a node-local path (stream.rs:36-64's promise).
+
+    Inherits the flush-based write buffer / EOF read semantics of the TCP
+    sim; the transport is a loopback channel pair instead of NetSim.
+    """
+
+    @staticmethod
+    async def connect(path: str) -> "UnixStream":  # type: ignore[override]
+        listener = _lookup(path)
+        if not isinstance(listener, _UnixListenerSocket):
+            raise ConnectionRefusedError(f"not a stream socket: {path}")
+        a2b: Channel = Channel()
+        b2a: Channel = Channel()
+        stream = UnixStream(_Pipe(a2b), _Pipe(b2a), "", str(path))
+        try:
+            listener.conn_chan.send_nowait(
+                (UnixStream(_Pipe(b2a), _Pipe(a2b), str(path), ""), "")
+            )
+        except (RuntimeError, ChannelClosed):
+            raise ConnectionRefusedError(f"connection refused: {path}") from None
+        return stream
+
+    @staticmethod
+    def pair() -> Tuple["UnixStream", "UnixStream"]:
+        """Connected anonymous pair (socketpair(2) / tokio's pair())."""
+        a2b: Channel = Channel()
+        b2a: Channel = Channel()
+        return (
+            UnixStream(_Pipe(a2b), _Pipe(b2a), "", ""),
+            UnixStream(_Pipe(b2a), _Pipe(a2b), "", ""),
+        )
+
+
+class _UnixListenerSocket:
+    def __init__(self) -> None:
+        self.conn_chan: Channel = Channel()
+
+    def _release(self) -> None:
+        self.conn_chan.close()
+
+
+class UnixListener:
+    def __init__(self, key: Tuple[int, str], socket: _UnixListenerSocket) -> None:
+        self._key = key
+        self._socket = socket
+
+    @staticmethod
+    async def bind(path: str) -> "UnixListener":
+        socket = _UnixListenerSocket()
+        return UnixListener(_bind(path, socket), socket)
+
+    def local_addr(self) -> str:
+        return self._key[1]
+
+    async def accept(self) -> Tuple[UnixStream, str]:
+        try:
+            stream, peer = await self._socket.conn_chan.recv()
+        except ChannelClosed:
+            raise OSError("listener closed") from None
+        return stream, peer
+
+    def close(self) -> None:
+        _unbind(self._key)
+        self._socket.conn_chan.close()
+
+    def __enter__(self) -> "UnixListener":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()
+
+
+class UnixDatagram:
+    """Connectionless node-local datagrams (datagram.rs:6-30's promise)."""
+
+    def __init__(self, key: Optional[Tuple[int, str]]) -> None:
+        self._key = key
+        self._chan: Channel = Channel()
+        self._peer: Optional[str] = None
+
+    def _release(self) -> None:
+        self._chan.close()
+
+    @staticmethod
+    async def bind(path: str) -> "UnixDatagram":
+        dg = UnixDatagram(None)
+        dg._key = _bind(path, dg)
+        return dg
+
+    @staticmethod
+    async def unbound() -> "UnixDatagram":
+        return UnixDatagram(None)
+
+    def local_addr(self) -> Optional[str]:
+        return self._key[1] if self._key else None
+
+    def connect(self, path: str) -> None:
+        _lookup(path)  # fail fast like the kernel
+        self._peer = str(path)
+
+    async def send_to(self, buf: bytes, path: str) -> int:
+        target = _lookup(path)
+        if not isinstance(target, UnixDatagram):
+            raise ConnectionRefusedError(f"not a datagram socket: {path}")
+        src = self._key[1] if self._key else ""
+        try:
+            target._chan.send_nowait((bytes(buf), src))
+        except (RuntimeError, ChannelClosed):
+            raise ConnectionRefusedError(f"connection refused: {path}") from None
+        return len(buf)
+
+    async def send(self, buf: bytes) -> int:
+        if self._peer is None:
+            raise OSError("datagram socket not connected")
+        return await self.send_to(buf, self._peer)
+
+    async def recv_from(self) -> Tuple[bytes, str]:
+        try:
+            return await self._chan.recv()
+        except ChannelClosed:
+            raise OSError("datagram socket closed") from None
+
+    async def recv(self) -> bytes:
+        return (await self.recv_from())[0]
+
+    def close(self) -> None:
+        if self._key is not None:
+            _unbind(self._key)
+        self._chan.close()

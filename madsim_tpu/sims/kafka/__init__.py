@@ -21,6 +21,7 @@ from .client import (  # noqa: F401
     BaseProducer,
     BaseRecord,
     ClientConfig,
+    NewPartitions,
     NewTopic,
     StreamConsumer,
 )

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .errors import (
     KafkaError,
+    invalid_partitions,
     invalid_timestamp,
     no_offset,
     unknown_partition,
@@ -93,6 +94,17 @@ class Broker:
 
     def create_topic(self, name: str, partitions: int) -> None:
         self.topics[name] = _Topic(name, partitions)
+
+    def create_partitions(self, name: str, new_total: int) -> None:
+        """Grow a topic to `new_total` partitions (admin.rs NewPartitions);
+        shrinking is rejected like real Kafka."""
+        topic = self.topics.get(name)
+        if topic is None:
+            raise unknown_topic(name)
+        if new_total <= len(topic.partitions):
+            raise invalid_partitions(name, new_total)
+        for i in range(len(topic.partitions), new_total):
+            topic.partitions.append(_Partition(i))
 
     def produce(self, records: List[OwnedRecord]) -> None:
         for record in records:

@@ -22,7 +22,7 @@ from ...core.sync import ChannelClosed
 from ...net import Endpoint
 from ...net.addr import lookup_host
 from .broker import FetchOptions, OwnedMessage, OwnedRecord
-from .errors import KafkaError
+from .errors import KafkaError, invalid_transaction_state, queue_full
 from .tpl import OFFSET_BEGINNING, OFFSET_END, OFFSET_INVALID, TopicPartitionList
 
 
@@ -113,7 +113,7 @@ class ClientConfig:
         return _Conn(ep, addr)
 
     async def create_producer(self) -> "BaseProducer":
-        return BaseProducer(await self._connect())
+        return BaseProducer(await self._connect(), self)
 
     async def create_consumer(self) -> "BaseConsumer":
         return BaseConsumer(await self._connect(), self)
@@ -126,19 +126,84 @@ class ClientConfig:
 
 
 class BaseProducer:
-    """Buffering producer (producer.rs:155-245): send() queues locally,
-    flush() ships the whole batch to the broker."""
+    """Buffering producer with transactions (producer.rs:155-320).
 
-    def __init__(self, conn: _Conn) -> None:
+    State machine mirrors the reference's Inner enum (producer.rs:162-175):
+    INIT until the first send() (-> NON_TXN) or init_transactions()
+    (-> TXN). A transactional producer buffers sends while a transaction is
+    open; commit ships the whole buffer as ONE produce request — atomic on
+    the broker by construction (the sim broker appends a batch
+    synchronously) — and abort discards it. A non-transactional producer
+    buffers records until flush()/poll(), raising QueueFull when a send
+    finds more than 10 already queued (the reference's exact simulated
+    queue-full boundary, producer.rs:196-198).
+    """
+
+    _INIT, _NON_TXN, _TXN = 0, 1, 2
+
+    def __init__(self, conn: _Conn, config: Optional["ClientConfig"] = None) -> None:
         self._conn = conn
+        self._config = config
         self._queue: List[OwnedRecord] = []
+        self._state = self._INIT
+        self._in_txn = False
 
     def send(self, record: BaseRecord) -> None:
+        if self._state == self._INIT:
+            self._state = self._NON_TXN
+        if self._state == self._NON_TXN:
+            if len(self._queue) > 10:  # simulated queue full (producer.rs:191)
+                raise queue_full()
+            self._queue.append(record._to_owned())
+            return
+        if not self._in_txn:
+            raise invalid_transaction_state(
+                "messages should only be sent when a transaction is active"
+            )
         self._queue.append(record._to_owned())
 
+    # -- transactions (producer.rs:246-320) --
+
+    async def init_transactions(self, timeout: Optional[float] = None) -> None:
+        tid = self._config.get("transactional.id") if self._config else None
+        if not tid:
+            raise invalid_transaction_state("transactional ID not set")
+        if self._state != self._INIT:
+            raise invalid_transaction_state(
+                "init_transactions must be called before any operations"
+            )
+        self._state = self._TXN
+
+    def begin_transaction(self) -> None:
+        if self._state != self._TXN:
+            raise invalid_transaction_state("transaction not initialized")
+        if self._in_txn:
+            raise invalid_transaction_state("transaction already in progress")
+        self._in_txn = True
+
+    async def commit_transaction(self, timeout: Optional[float] = None) -> None:
+        if self._state != self._TXN or not self._in_txn:
+            raise invalid_transaction_state("no opened transaction")
+        batch, self._queue = self._queue, []
+        try:
+            if batch:
+                await self._conn.call(("produce", batch))
+        except BaseException:
+            self._queue = batch  # commit retryable: buffer not lost
+            raise
+        self._in_txn = False
+
+    async def abort_transaction(self, timeout: Optional[float] = None) -> None:
+        if self._state != self._TXN or not self._in_txn:
+            raise invalid_transaction_state("no opened transaction")
+        self._queue.clear()
+        self._in_txn = False
+
+    # -- delivery --
+
     async def flush(self, timeout: Optional[float] = None) -> None:
-        if not self._queue:
-            return
+        if self._state == self._TXN or not self._queue:
+            return  # txn buffers ship on commit, never on flush
         batch, self._queue = self._queue, []
         try:
             await self._conn.call(("produce", batch))
@@ -148,6 +213,8 @@ class BaseProducer:
 
     async def poll(self, timeout: Optional[float] = None) -> int:
         """Deliver queued records; returns how many were shipped."""
+        if self._state == self._TXN:
+            return 0
         n = len(self._queue)
         await self.flush(timeout)
         return n
@@ -268,6 +335,14 @@ class NewTopic:
 
 
 @dataclasses.dataclass
+class NewPartitions:
+    """admin.rs:184-208: grow a topic's partition count."""
+
+    topic_name: str
+    new_partition_count: int
+
+
+@dataclasses.dataclass
 class AdminOptions:
     request_timeout: Optional[float] = None
 
@@ -283,3 +358,12 @@ class AdminClient:
     ) -> None:
         for t in topics:
             await self._conn.call(("create_topic", t.name, t.num_partitions))
+
+    async def create_partitions(
+        self, partitions: List[NewPartitions], options: Optional[AdminOptions] = None
+    ) -> None:
+        """Grow topics' partition counts (admin.rs:205 NewPartitions op)."""
+        for p in partitions:
+            await self._conn.call(
+                ("create_partitions", p.topic_name, p.new_partition_count)
+            )

@@ -29,3 +29,17 @@ def no_offset() -> KafkaError:
 
 def invalid_timestamp() -> KafkaError:
     return KafkaError("invalid timestamp", "InvalidTimestamp")
+
+
+def invalid_transaction_state(msg: str) -> KafkaError:
+    return KafkaError(msg, "InvalidTransactionalState")
+
+
+def queue_full() -> KafkaError:
+    return KafkaError("producer queue full", "QueueFull")
+
+
+def invalid_partitions(topic: str, count: int) -> KafkaError:
+    return KafkaError(
+        f"cannot shrink {topic} to {count} partitions", "InvalidPartitions"
+    )

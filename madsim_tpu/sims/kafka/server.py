@@ -41,6 +41,10 @@ class SimBroker:
                 name, partitions = args
                 b.create_topic(name, partitions)
                 rsp = None
+            elif op == "create_partitions":
+                name, new_total = args
+                b.create_partitions(name, new_total)
+                rsp = None
             elif op == "produce":
                 (records,) = args
                 b.produce(records)

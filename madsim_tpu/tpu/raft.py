@@ -61,6 +61,13 @@ class RaftState(NamedTuple):
     base_term: jnp.ndarray  # i32 term of entry base-1      (durable)
     log_term: jnp.ndarray  # i32 [LOG] window      (durable)
     log_cmd: jnp.ndarray  # i32 [LOG] window       (durable)
+    # cached chain hashes: log_chain[r] = hash of absolute prefix
+    # [0, base + r]. Maintained incrementally (append/overwrite fold from
+    # the predecessor slot; compaction shifts; snapshot clears) because the
+    # naive recompute is a 24-step SEQUENTIAL fold per (lane, node) per
+    # step — measured at >half the whole engine step cost. Values are
+    # prefix-absolute, so the compaction shift is sound.
+    log_chain: jnp.ndarray  # u32 [LOG]            (durable, derived)
     log_len: jnp.ndarray  # i32 absolute           (durable)
     commit: jnp.ndarray  # i32 absolute last committed (restarts at base-1)
     next_idx: jnp.ndarray  # i32 [N] absolute      (leader volatile)
@@ -102,21 +109,11 @@ def make_raft_spec(
         win = at_abs(s, s.log_term, i_arr)
         return jnp.where(i_arr == s.base - 1, s.base_term, win)
 
-    def chain(s: RaftState):
-        """Chain hash at every window slot: chain[r] = hash of the absolute
-        prefix [0, base + r]. Unrolled over the static LOG (small)."""
-        hs = []
-        h = s.base_hash.astype(jnp.uint32)
-        for r in range(LOG):
-            h = _chain_fold(h, s.log_term[r], s.log_cmd[r])
-            hs.append(h)
-        return jnp.stack(hs)  # u32 [LOG]
-
     def hash_at(s: RaftState, i):
-        """Chain hash of prefix [0, i] at absolute i; validity checked by
-        caller (known iff base-1 <= i < log_len)."""
+        """Chain hash of prefix [0, i] at absolute i, from the cache;
+        validity checked by caller (known iff base-1 <= i < log_len)."""
         i_arr = jnp.asarray(i)
-        win = (chain(s) * (ridx == (i_arr - s.base)).astype(jnp.uint32)).sum(
+        win = (s.log_chain * (ridx == (i_arr - s.base)).astype(jnp.uint32)).sum(
             -1, dtype=jnp.uint32
         )
         return jnp.where(
@@ -156,6 +153,7 @@ def make_raft_spec(
             base_term=jnp.int32(0),
             log_term=jnp.zeros((LOG,), jnp.int32),
             log_cmd=jnp.zeros((LOG,), jnp.int32),
+            log_chain=jnp.zeros((LOG,), jnp.uint32),
             log_len=jnp.int32(0),
             commit=jnp.int32(-1),
             next_idx=jnp.zeros((N,), jnp.int32),
@@ -188,10 +186,14 @@ def make_raft_spec(
         nb_term = term_at(s, new_base - 1)
 
         # shift window left by d: shifted[r] = window[r + d] (one-hot matmul;
-        # LOG is small so this stays a tiny VPU contraction)
+        # LOG is small so this stays a tiny VPU contraction). The chain cache
+        # shifts identically: its values are absolute-prefix hashes.
         shift_oh = (ridx[None, :] == (ridx[:, None] + d)).astype(jnp.int32)
         log_term = (shift_oh * s.log_term[None, :]).sum(-1)
         log_cmd = (shift_oh * s.log_cmd[None, :]).sum(-1)
+        log_chain = (shift_oh.astype(jnp.uint32) * s.log_chain[None, :]).sum(
+            -1, dtype=jnp.uint32
+        )
 
         return s._replace(
             base=jnp.where(do, new_base, s.base),
@@ -199,6 +201,7 @@ def make_raft_spec(
             base_term=jnp.where(do, nb_term, s.base_term),
             log_term=jnp.where(do, log_term, s.log_term),
             log_cmd=jnp.where(do, log_cmd, s.log_cmd),
+            log_chain=jnp.where(do, log_chain, s.log_chain),
         )
 
     # ----------------------------------------------------------------- timer
@@ -211,10 +214,17 @@ def make_raft_spec(
         can_append = (s.log_len - s.base) < LOG
         do_append = is_leader & can_append & (prng.uniform(key, 26) < client_rate)
         at_end = ridx == (s.log_len - s.base)
-        log_cmd = jnp.where(do_append & at_end, nid * 100_000 + s.next_cmd, s.log_cmd)
+        new_cmd = nid * 100_000 + s.next_cmd
+        log_cmd = jnp.where(do_append & at_end, new_cmd, s.log_cmd)
         log_term = jnp.where(do_append & at_end, s.term, s.log_term)
+        # chain cache: fold the new entry onto the hash of the prefix below
+        append_h = _chain_fold(hash_at(s, s.log_len - 1), s.term, new_cmd)
+        log_chain = jnp.where(do_append & at_end, append_h, s.log_chain)
         log_len = s.log_len + do_append.astype(jnp.int32)
-        s_app = s._replace(log_term=log_term, log_cmd=log_cmd, log_len=log_len)
+        s_app = s._replace(
+            log_term=log_term, log_cmd=log_cmd, log_chain=log_chain,
+            log_len=log_len,
+        )
 
         prev_idx = s.next_idx - 1  # [N] absolute
         prev_term = term_at(s_app, prev_idx)
@@ -375,6 +385,10 @@ def make_raft_spec(
         same = (write_at < s.log_len) & (existing_term == e_term)
         log_term_new = jnp.where(do_write & at_w, e_term, s.log_term)
         log_cmd_new = jnp.where(do_write & at_w, e_cmd, s.log_cmd)
+        # chain cache: fold onto the predecessor's hash (same index + same
+        # term => same entry in Raft, so the `same` overwrite is a no-op)
+        write_h = _chain_fold(hash_at(s, write_at - 1), e_term, e_cmd)
+        log_chain_new = jnp.where(do_write & at_w, write_h, s.log_chain)
         log_len_new = jnp.where(
             do_write, jnp.where(same, s.log_len, write_at + 1), s.log_len
         )
@@ -384,7 +398,8 @@ def make_raft_spec(
         )
         state = s._replace(
             term=term, role=role, voted_for=voted_for,
-            log_term=log_term_new, log_cmd=log_cmd_new, log_len=log_len_new,
+            log_term=log_term_new, log_cmd=log_cmd_new,
+            log_chain=log_chain_new, log_len=log_len_new,
             commit=commit,
         )
         out = reply(src, APPEND_RESP, pack(term, ok, match, 0, 0, 0))
@@ -456,6 +471,7 @@ def make_raft_spec(
             base_term=jnp.where(adopt, snap_term, s.base_term),
             log_term=jnp.where(adopt, 0, s.log_term),
             log_cmd=jnp.where(adopt, 0, s.log_cmd),
+            log_chain=jnp.where(adopt, jnp.uint32(0), s.log_chain),
             log_len=jnp.where(adopt, snap_idx + 1, s.log_len),
             commit=jnp.where(adopt, snap_idx, s.commit),
         )
@@ -508,7 +524,7 @@ def make_raft_spec(
 
         # committed-prefix agreement via chain hashes: compare prefix hash
         # at m = min(commit_a, commit_b) whenever both nodes retain index m
-        h_all = _chain_all(ns)  # u32 [N, LOG]
+        h_all = ns.log_chain  # u32 [N, LOG] — the maintained cache
         m = jnp.minimum(ns.commit[:, None], ns.commit[None, :])  # [N,N]
         # hash of node a's prefix at m (one-hot over window + boundary case)
         rel = m[:, :, None] - ns.base[:, None, None]  # a's window offset
@@ -528,15 +544,6 @@ def make_raft_spec(
         log_matching = ~(comparable & (h_a != h_b)).any()
 
         return election_safety & log_matching
-
-    def _chain_all(ns: RaftState):
-        """Chain hashes for all N nodes' windows: u32 [N, LOG]."""
-        h = ns.base_hash.astype(jnp.uint32)  # [N]
-        hs = []
-        for r in range(LOG):
-            h = _chain_fold(h, ns.log_term[:, r], ns.log_cmd[:, r])
-            hs.append(h)
-        return jnp.stack(hs, axis=1)
 
     # ------------------------------------------------------------ diagnostics
 
@@ -572,6 +579,42 @@ def make_raft_spec(
         lane_metrics=lane_metrics,
         msg_kind_names=("REQUEST_VOTE", "VOTE_RESP", "APPEND", "APPEND_RESP", "SNAP"),
     )
+
+
+def verify_chain_cache(node) -> bool:
+    """Debug oracle for the incremental chain cache: recompute every
+    (lane, node) chain hash from base_hash + the raw window in numpy and
+    compare against the maintained `log_chain` (valid slots only). The
+    invariant check trusts the cache, so the cache must be bit-exact.
+    """
+    import numpy as np
+
+    def mix(x):
+        x = x.astype(np.uint32)
+        x ^= x >> 16
+        x = (x * np.uint32(0x85EBCA6B)) & np.uint32(0xFFFFFFFF)
+        x ^= x >> 13
+        x = (x * np.uint32(0xC2B2AE35)) & np.uint32(0xFFFFFFFF)
+        x ^= x >> 16
+        return x
+
+    def fold(h, w):
+        return mix(h ^ (w.astype(np.uint32) * np.uint32(0x9E3779B9)))
+
+    base_hash = np.asarray(node.base_hash).astype(np.uint32)  # [L,N]
+    log_term = np.asarray(node.log_term)  # [L,N,LOG]
+    log_cmd = np.asarray(node.log_cmd)
+    log_chain = np.asarray(node.log_chain).astype(np.uint32)
+    n_valid = np.asarray(node.log_len) - np.asarray(node.base)  # [L,N]
+    LOG = log_term.shape[-1]
+
+    h = base_hash
+    ok = True
+    for r in range(LOG):
+        h = fold(fold(h, log_term[:, :, r]), log_cmd[:, :, r])
+        valid = r < n_valid
+        ok = ok and bool(np.all(~valid | (h == log_chain[:, :, r])))
+    return ok
 
 
 def raft_workload(

@@ -246,3 +246,111 @@ def test_latest_offset_reset_skips_history():
         return await client_node.spawn(run())
 
     assert rt.block_on(main())
+
+
+def test_producer_transactions():
+    """Transactional produce (producer.rs:246-320): init/begin/commit ships
+    the buffer as one atomic batch; abort discards it; state errors match
+    the reference's InvalidTransactionalState cases."""
+    rt = ms.Runtime(seed=21)
+
+    async def main():
+        h = rt.handle
+        h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve("10.0.0.1:9092")
+        ).build()
+        await ms.time.sleep(1.0)
+
+        client_node = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def body():
+            cfg = ClientConfig(
+                {
+                    "bootstrap.servers": "10.0.0.1:9092",
+                    "transactional.id": "tx-1",
+                    "auto.offset.reset": "earliest",
+                    "group.id": "g",
+                }
+            )
+            await (await cfg.create_admin()).create_topics([NewTopic("t", 1)])
+
+            p = await cfg.create_producer()
+            # state machine errors (producer.rs:266-284)
+            with pytest.raises(kafka.KafkaError, match="not initialized"):
+                p.begin_transaction()
+            await p.init_transactions()
+            with pytest.raises(kafka.KafkaError, match="before any operations"):
+                await p.init_transactions()
+            with pytest.raises(kafka.KafkaError, match="transaction is active"):
+                p.send(BaseRecord.to("t").with_payload(b"outside"))
+
+            # aborted transaction: nothing reaches the broker
+            p.begin_transaction()
+            p.send(BaseRecord.to("t").with_payload(b"doomed-1"))
+            p.send(BaseRecord.to("t").with_payload(b"doomed-2"))
+            await p.flush()  # no-op for txn producers: nothing ships early
+            await p.abort_transaction()
+
+            # committed transaction: the whole batch lands atomically
+            p.begin_transaction()
+            for i in range(3):
+                p.send(BaseRecord.to("t").with_payload(b"keep-%d" % i))
+            await p.commit_transaction()
+
+            c = await cfg.create_consumer()
+            c.subscribe(["t"])
+            seen = []
+            for _ in range(3):
+                msg = await c.poll(timeout=5.0)
+                seen.append(msg.payload)
+            assert seen == [b"keep-0", b"keep-1", b"keep-2"]
+            assert await c.poll(timeout=0.5) is None  # no doomed-* leaked
+            with pytest.raises(kafka.KafkaError, match="no opened transaction"):
+                await p.commit_transaction()
+            return True
+
+        assert await client_node.spawn(body())
+
+        # a producer without transactional.id cannot init (producer.rs:249)
+        async def no_tid():
+            p = await ClientConfig(
+                {"bootstrap.servers": "10.0.0.1:9092"}
+            ).create_producer()
+            with pytest.raises(kafka.KafkaError, match="transactional ID"):
+                await p.init_transactions()
+            return True
+
+        assert await client_node.spawn(no_tid())
+
+    rt.block_on(main())
+
+
+def test_admin_create_partitions():
+    """NewPartitions grows a topic; shrinking is rejected (admin.rs:184-208)."""
+    rt = ms.Runtime(seed=22)
+
+    async def main():
+        h = rt.handle
+        h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve("10.0.0.1:9092")
+        ).build()
+        await ms.time.sleep(1.0)
+        node = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def body():
+            cfg = ClientConfig({"bootstrap.servers": "10.0.0.1:9092"})
+            admin = await cfg.create_admin()
+            await admin.create_topics([NewTopic("t", 2)])
+            await admin.create_partitions([kafka.NewPartitions("t", 5)])
+            consumer = await cfg.create_consumer()
+            meta = await consumer.fetch_metadata("t")
+            assert meta == {"t": [0, 1, 2, 3, 4]}
+            with pytest.raises(kafka.KafkaError, match="cannot shrink"):
+                await admin.create_partitions([kafka.NewPartitions("t", 3)])
+            with pytest.raises(kafka.KafkaError, match="unknown topic"):
+                await admin.create_partitions([kafka.NewPartitions("nope", 9)])
+            return True
+
+        assert await node.spawn(body())
+
+    rt.block_on(main())

@@ -12,13 +12,19 @@ step function over lane-major state tensors:
     node state   [L, N, ...]protocol pytree
     message pool [L, S]     in-flight messages with deliver times
 
-One step = (1) advance each lane's clock to its next event, (2) deliver the
-earliest due message per (lane, node) through the protocol's `on_message`,
-(3) fire due timers through `on_timer`, (4) run crash/restart chaos,
-(5) roll loss + latency for every emitted message (the `test_link` analog,
-net/network.rs:261-269) and pack survivors into free pool slots, (6) check
-invariants. Everything is vmapped over lanes and vectorized over nodes; a lane
-whose next event is simultaneous across nodes processes them all in one step.
+One step = (1) advance each lane to its next event WINDOW — the conservative
+parallel-DES lookahead [t_next, t_next + latency_lo): messages emitted inside
+the window arrive after it, so in-window events on different nodes are
+causally independent, (2) per node, pick its earliest in-window event —
+message delivery or timer fire, never both (per-node order is exact) — and
+run `on_message`/`on_timer` with the node's own event time, (3) run
+crash/restart + partition chaos (the window collapses to the exact chaos
+instant on those steps), (4) roll loss + latency for every emitted message
+(the `test_link` analog, net/network.rs:261-269), stamped from the emitting
+node's event time, and pack survivors into free pool slots, (5) check
+invariants. Everything is vmapped over lanes and vectorized over nodes; the
+step cost is N-wide regardless of how many nodes have due events, so the
+lookahead window turns idle handler lanes into processed events for free.
 
 Lanes are embarrassingly parallel, so the lane axis shards cleanly over a
 device mesh (`shard_state`); the node axis can additionally be sharded for
@@ -64,6 +70,7 @@ class TraceRecord(NamedTuple):
     """
 
     clock: Any  # i32 [L]
+    t_evt: Any  # i32 [L,N] virtual time of node n's event this step
     msg_fired: Any  # bool [L,N] message delivered to node n this step
     msg_src: Any  # i32 [L,N]
     msg_kind: Any  # i32 [L,N]
@@ -137,14 +144,16 @@ class BatchedSim:
                 _np.arange(N * spec.max_out) // spec.max_out,
             ]
         )
-        # scalar-style handlers -> [L,N] batched
+        # scalar-style handlers -> [L,N] batched. `now` is per-(lane,node):
+        # under the lookahead window, nodes in one step process events at
+        # different virtual times.
         self._v_init = jax.vmap(jax.vmap(spec.init, in_axes=(0, 0)), in_axes=(0, None))
         self._v_on_message = jax.vmap(
-            jax.vmap(spec.on_message, in_axes=(0, 0, 0, 0, 0, None, 0)),
+            jax.vmap(spec.on_message, in_axes=(0, 0, 0, 0, 0, 0, 0)),
             in_axes=(0, 0, 0, 0, 0, 0, 0),
         )
         self._v_on_timer = jax.vmap(
-            jax.vmap(spec.on_timer, in_axes=(0, 0, None, 0)),
+            jax.vmap(spec.on_timer, in_axes=(0, 0, 0, 0)),
             in_axes=(0, 0, 0, 0),
         )
         self._v_on_restart = jax.vmap(
@@ -221,7 +230,7 @@ class BatchedSim:
         L = state.clock.shape[0]
         msgs = state.msgs
 
-        # -- 1. advance each lane to its next event ------------------------
+        # -- 1. advance each lane to its next event window -----------------
         # (the advance_to_next_event analog, time/mod.rs:45-60, batched)
         # NOTE on style: this step avoids gather/scatter ops in favor of
         # one-hot multiply-reduce — XLA lowers small-domain gathers to slow
@@ -230,16 +239,35 @@ class BatchedSim:
         dst_oh = msgs.dst[:, :, None] == jnp.arange(N)[None, None, :]  # [L,S,N]
         alive_dst = (dst_oh & state.alive[:, None, :]).any(-1)  # [L,S]
         live_msg = msgs.valid & alive_dst
-        t_msg = jnp.where(live_msg, msgs.deliver, INF_US).min(axis=1)
-        t_timer = jnp.where(state.alive, state.timer, INF_US).min(axis=1)
+        # per-(lane,node) pending message times (alive is already folded in:
+        # live_msg requires the destination alive, and dst_oh pins n == dst)
+        pend_ln = live_msg[:, None, :] & dst_oh.transpose(0, 2, 1)  # [L,N,S]
+        t_ln = jnp.where(pend_ln, msgs.deliver[:, None, :], INF_US)
+        tmsg_n = t_ln.min(axis=2)  # [L,N] earliest pending message per node
+        ttmr_n = jnp.where(state.alive, state.timer, INF_US)  # [L,N]
         t_next = jnp.minimum(
-            jnp.minimum(jnp.minimum(t_msg, t_timer), state.chaos_at),
+            jnp.minimum(jnp.minimum(tmsg_n.min(axis=1), ttmr_n.min(axis=1)),
+                        state.chaos_at),
             state.part_at,
         )
 
         deadlocked = (~state.done) & (t_next >= INF_US)
         active = (~state.done) & (t_next < INF_US)
-        clock = jnp.where(active, jnp.maximum(state.clock, t_next), state.clock)
+
+        # conservative-DES lookahead window [t_next, t_next + latency_lo):
+        # any message EMITTED by an in-window event arrives at
+        # >= t_next + latency_lo, so in-window events on different nodes are
+        # causally independent and each node may process its earliest one
+        # this step (classic PDES lookahead; see SimConfig.lookahead).
+        # Whenever the next crash/partition instant falls anywhere inside
+        # the window, the window shrinks to the exact instant t_next (the
+        # chaos itself fires only once it IS t_next), so chaos state never
+        # applies to sends from earlier virtual times.
+        lo_w = max(0, cfg.latency_lo_us - 1) if cfg.lookahead else 0
+        w_end = jnp.minimum(t_next, INF_US - lo_w - 1) + lo_w
+        if lo_w and (cfg.chaos_enabled or cfg.partition_enabled):
+            chaos_in_w = jnp.minimum(state.chaos_at, state.part_at) <= w_end
+            w_end = jnp.where(chaos_in_w, t_next, w_end)
 
         # -- 2. advance per-lane keys (cheap hash chain, see prng.py) ------
         key = prng.fold(state.key, 1)
@@ -249,45 +277,50 @@ class BatchedSim:
         rkeys = prng.fold(node_key, 103)
         ckey = prng.fold(key, 104)  # [L]
 
-        # -- 3. deliver earliest due message per (lane, node) --------------
-        due = live_msg & (msgs.deliver <= clock[:, None])  # [L,S]
-        due_ln = (
-            due[:, None, :]
-            & dst_oh.transpose(0, 2, 1)
-            & state.alive[:, :, None]
-            & active[:, None, None]
+        # -- 3. pick each node's event: earliest in-window message or timer
+        # (one event per node per step keeps per-node order exact)
+        msg_due = active[:, None] & (tmsg_n <= w_end[:, None])  # [L,N]
+        tmr_due = active[:, None] & (ttmr_n <= w_end[:, None])  # [L,N]
+        if cfg.sched_randomize:
+            # message-vs-timer order: when both are due at the SAME instant,
+            # half the time the timer fires first (the message waits a step;
+            # its deliver time has passed so it stays due) — same-instant
+            # event reordering, the utils/mpsc.rs:71-84 analog
+            timer_first = prng.bernoulli(prng.fold(node_key, 108), 1, 0.5)
+        else:
+            timer_first = jnp.zeros((L, N), jnp.bool_)
+        tie = msg_due & tmr_due & (tmsg_n == ttmr_n)
+        has_msg = msg_due & (
+            ~tmr_due | (tmsg_n < ttmr_n) | (tie & ~timer_first)
         )
-        t_ln = jnp.where(due_ln, msgs.deliver[:, None, :], INF_US)
+        due_t = tmr_due & (
+            ~msg_due | (ttmr_n < tmsg_n) | (tie & timer_first)
+        )
+        # per-node event time; inactive nodes default to the window start
+        t_evt = jnp.where(has_msg, tmsg_n, jnp.where(due_t, ttmr_n, t_next[:, None]))
+
+        # slot choice: among this node's earliest-time pending slots
+        head_ln = pend_ln & (t_ln == tmsg_n[:, :, None])  # [L,N,S]
         if cfg.sched_randomize:
             # random tie-break among equal-timestamp due messages — the
             # scheduling-nondeterminism amplifier (utils/mpsc.rs:71-84):
             # seeds that share a chaos schedule still explore different
             # delivery orders, the reference's biggest bug-finding lever
-            t_min = t_ln.min(axis=2, keepdims=True)  # [L,N,1]
-            tied = due_ln & (t_ln == t_min)
             prio = prng.bits(
                 prng.fold(key, 107)[:, None], 1,
                 index=jnp.arange(S, dtype=jnp.uint32)[None, :],
             )  # u32 [L,S]
-            prio_ln = jnp.where(tied, prio[:, None, :], jnp.uint32(0xFFFFFFFF))
+            prio_ln = jnp.where(head_ln, prio[:, None, :], jnp.uint32(0xFFFFFFFF))
             slot = jnp.argmin(prio_ln, axis=2)  # [L,N]
-            slot_oh = tied & (jnp.arange(S)[None, None, :] == slot[:, :, None])
         else:
-            slot = jnp.argmin(t_ln, axis=2)  # [L,N]
-            slot_oh = due_ln & (jnp.arange(S)[None, None, :] == slot[:, :, None])
-        has_msg = slot_oh.any(-1)
-
-        if cfg.sched_randomize:
-            # message-vs-timer order: when a node has both a due message and
-            # a due timer, half the time the timer fires first — the message
-            # is deferred to the next step (its deliver time has passed, so
-            # the clock does not advance past it; net effect is exactly a
-            # reordering of same-instant events)
-            due_t_pre = state.alive & active[:, None] & (state.timer <= clock[:, None])
-            timer_first = prng.bernoulli(prng.fold(node_key, 108), 1, 0.5)  # [L,N]
-            defer_msg = has_msg & due_t_pre & timer_first
-            has_msg = has_msg & ~defer_msg
-            slot_oh = slot_oh & ~defer_msg[:, :, None]
+            slot = jnp.argmin(
+                jnp.where(head_ln, t_ln, INF_US), axis=2
+            )  # [L,N] first earliest slot
+        slot_oh = (
+            head_ln
+            & (jnp.arange(S)[None, None, :] == slot[:, :, None])
+            & has_msg[:, :, None]
+        )
 
         slot_ohi = slot_oh.astype(jnp.int32)
         m_src = (msgs.src[:, None, :] * slot_ohi).sum(-1)
@@ -295,20 +328,30 @@ class BatchedSim:
         m_pay = (msgs.payload[:, None, :, :] * slot_ohi[:, :, :, None]).sum(2)
         node_ids = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (L, N))
 
+        # -- 4. run handlers (at most one event per node => masks are
+        # disjoint, so both handlers read state.node and XLA may overlap them)
         ns_m, out_m, timer_m = self._v_on_message(
-            state.node, node_ids, m_src, m_kind, m_pay, clock, mkeys
+            state.node, node_ids, m_src, m_kind, m_pay, t_evt, mkeys
         )
+        ns_t, out_t, timer_t = self._v_on_timer(state.node, node_ids, t_evt, tkeys)
         node = _tree_where(has_msg, ns_m, state.node)
-        # handlers return a negative timer to mean "keep the current deadline"
+        node = _tree_where(due_t, ns_t, node)
+        # message handlers return a negative timer to keep the current
+        # deadline; timer handlers return a negative value to disarm
         timer = jnp.where(has_msg & (timer_m >= 0), timer_m, state.timer)
+        timer = jnp.where(
+            due_t, jnp.where(timer_t >= 0, timer_t, INF_US), timer
+        )
         consumed = slot_oh.any(1)  # [L,S]
         valid = msgs.valid & ~consumed
 
-        # -- 4. fire due timers (post-message timer values) ----------------
-        due_t = state.alive & active[:, None] & (timer <= clock[:, None])
-        ns_t, out_t, timer_t = self._v_on_timer(node, node_ids, clock, tkeys)
-        node = _tree_where(due_t, ns_t, node)
-        timer = jnp.where(due_t & (timer_t >= 0), timer_t, jnp.where(due_t, INF_US, timer))
+        # lane clock: the latest event time processed this step (chaos-only
+        # steps advance to the chaos instant t_next)
+        clock = jnp.where(
+            active,
+            jnp.maximum(state.clock, t_evt.max(axis=1)),
+            state.clock,
+        )
 
         # -- 5. crash/restart chaos (Handle::kill/restart analog) ----------
         alive = state.alive
@@ -316,7 +359,7 @@ class BatchedSim:
         tr_crash = jnp.full((L,), -1, jnp.int32)
         tr_restart = jnp.full((L,), -1, jnp.int32)
         if cfg.chaos_enabled:
-            chaos_due = active & (state.chaos_at <= clock)
+            chaos_due = active & (state.chaos_at <= t_next)
             is_restart = state.crashed >= 0
             do_crash = chaos_due & ~is_restart
             do_restart = chaos_due & is_restart
@@ -360,7 +403,7 @@ class BatchedSim:
         tr_heal = jnp.zeros((L,), jnp.bool_)
         tr_side = jnp.zeros((L,), jnp.int32)
         if cfg.partition_enabled:
-            part_due = active & (state.part_at <= clock)
+            part_due = active & (state.part_at <= t_next)
             do_split = part_due & ~state.partitioned
             do_heal = part_due & state.partitioned
             pkey = prng.fold(key, 106)
@@ -433,7 +476,10 @@ class BatchedSim:
             # is a constant-index gather, then matched against the dst one-hot
             src_rows = link_ok[:, self._src_of_c, :]  # [L,C,N]
             keep = keep & (cand_dst_oh & src_rows).any(-1)
-        deliver_at = clock[:, None] + lat.astype(jnp.int32)
+        # stamp each send from its EMITTING node's event time (candidate
+        # positions map statically to their source node), so latency is
+        # measured from the send instant, not the lane's window maximum
+        deliver_at = t_evt[:, self._src_of_c] + lat.astype(jnp.int32)
 
         # pack survivors into their origin's ring region: candidate c owns
         # slots [c*K, (c+1)*K); the message lands in the first free slot of
@@ -505,6 +551,7 @@ class BatchedSim:
         )
         record = TraceRecord(
             clock=clock,
+            t_evt=t_evt,
             msg_fired=has_msg,
             msg_src=m_src,
             msg_kind=m_kind,

@@ -121,6 +121,19 @@ class SimConfig:
     # both are due at the same instant. Off => deterministic argmin ties
     # (the round-2 behavior; useful for A/B-ing ordering sensitivity).
     sched_randomize: bool = True
+    # conservative-DES lookahead (classic PDES null-message bound): each
+    # step, every node may process its earliest pending event with time in
+    # [t_next, t_next + latency_lo), because any message EMITTED inside the
+    # window arrives at >= t_next + latency_lo — events inside the window
+    # are causally independent across nodes. Raises events per step (the
+    # step cost is N-wide regardless), preserving per-node event order
+    # exactly; cross-node orderings explored are all valid schedules.
+    # Whenever the next crash/partition instant falls inside the window,
+    # the window shrinks to the single instant t_next (chaos fires only
+    # once it IS t_next), so chaos never applies retroactively to earlier
+    # in-window sends.
+    # Off => one global-minimum instant per step (the round-2 behavior).
+    lookahead: bool = True
 
     @property
     def chaos_enabled(self) -> bool:

@@ -67,6 +67,7 @@ def extract_trace(
     the list self-truncates at the violation/horizon.
     """
     clock = np.asarray(recs.clock)[:, lane]
+    t_evt = np.asarray(recs.t_evt)[:, lane]  # [T,N] per-node event times
     msg_fired = np.asarray(recs.msg_fired)[:, lane]  # [T,N]
     msg_src = np.asarray(recs.msg_src)[:, lane]
     msg_kind = np.asarray(recs.msg_kind)[:, lane]
@@ -89,13 +90,21 @@ def extract_trace(
     )
     for t in np.nonzero(busy)[0]:
         t = int(t)
+        # chaos fires at the window start t_next == min(t_evt) (inactive
+        # nodes default to it); violation/deadlock are end-of-step facts and
+        # keep the lane clock (the latest event time processed)
+        t_chaos = int(t_evt[t].min())
         t_us = int(clock[t])
+        # node events carry their own virtual times (the lookahead window
+        # batches causally independent events into one step); render them
+        # in time order within the step
+        node_events: List[TraceEvent] = []
         for n in range(N):
             if msg_fired[t, n]:
                 mk = int(msg_kind[t, n])
-                events.append(
+                node_events.append(
                     TraceEvent(
-                        step=t, t_us=t_us, kind="deliver", node=n,
+                        step=t, t_us=int(t_evt[t, n]), kind="deliver", node=n,
                         src=int(msg_src[t, n]), msg_kind=mk,
                         msg_name=(
                             kind_names[mk]
@@ -105,26 +114,29 @@ def extract_trace(
                         payload=tuple(int(x) for x in msg_payload[t, n]),
                     )
                 )
-        for n in range(N):
             if timer_fired[t, n]:
-                events.append(TraceEvent(step=t, t_us=t_us, kind="timer", node=n))
+                node_events.append(
+                    TraceEvent(step=t, t_us=int(t_evt[t, n]), kind="timer", node=n)
+                )
+        node_events.sort(key=lambda e: e.t_us)
+        events.extend(node_events)
         if crash[t] >= 0:
             events.append(
-                TraceEvent(step=t, t_us=t_us, kind="crash", node=int(crash[t]))
+                TraceEvent(step=t, t_us=t_chaos, kind="crash", node=int(crash[t]))
             )
         if restart[t] >= 0:
             events.append(
-                TraceEvent(step=t, t_us=t_us, kind="restart", node=int(restart[t]))
+                TraceEvent(step=t, t_us=t_chaos, kind="restart", node=int(restart[t]))
             )
         if split[t]:
             sides = int(side_mask[t])
             a = [n for n in range(N) if sides >> n & 1]
             b = [n for n in range(N) if not sides >> n & 1]
             events.append(
-                TraceEvent(step=t, t_us=t_us, kind="split", detail=f"{a} | {b}")
+                TraceEvent(step=t, t_us=t_chaos, kind="split", detail=f"{a} | {b}")
             )
         if heal[t]:
-            events.append(TraceEvent(step=t, t_us=t_us, kind="heal"))
+            events.append(TraceEvent(step=t, t_us=t_chaos, kind="heal"))
         if violation[t]:
             events.append(
                 TraceEvent(
@@ -136,6 +148,10 @@ def extract_trace(
             events.append(
                 TraceEvent(step=t, t_us=t_us, kind="deadlock", detail="no runnable events")
             )
+    # a node's deferred event can be processed a step after another node's
+    # later-time in-window event; a stable time sort restores the
+    # chronological contract (per-node and same-instant orders preserved)
+    events.sort(key=lambda e: e.t_us)
     return events
 
 

@@ -1,7 +1,7 @@
-"""Real-network Endpoint: tag-matching over TCP with length-delimited frames.
+"""Real-network Endpoint: tag-matching over selectable stream transports.
 
 Analog of reference std/net/tcp.rs:22-325 (the production backend of the
-same Endpoint API): every peer pair communicates over TCP connections
+same Endpoint API): every peer pair communicates over stream connections
 carrying 4-byte-length-prefixed pickled frames (the LengthDelimitedCodec
 analog). Two connection kinds, declared by a hello frame:
 
@@ -13,11 +13,23 @@ analog). Two connection kinds, declared by a hello frame:
 
 The mailbox tag-matching, rpc layer, and the gRPC facade are byte-for-byte
 the same code as in simulation — only this transport differs.
+
+Transport selection (the std/net/mod.rs:33-38 analog, where the reference
+chooses TCP / UCX RDMA (ucx.rs) / eRPC (erpc.rs) by cargo feature): the
+`MADSIM_NET_BACKEND` env var picks the wire under the SAME logical
+(host, port) addressing and the same framing —
+
+    tcp   (default) asyncio TCP; works cross-host
+    uds   Unix domain sockets: each logical address maps to a socket path
+          under MADSIM_UDS_DIR (default /tmp/madsim-uds-<uid>); a lower-
+          latency same-host path, filling the role UCX fills intra-cluster
+          (a faster fabric behind an unchanged Endpoint API)
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 import pickle
 import struct
 from typing import Any, Dict, Optional, Tuple
@@ -27,6 +39,67 @@ from ..net.addr import SocketAddr, ToSocketAddrs, lookup_host
 from ..net.endpoint import Mailbox, _Message
 
 _LEN = struct.Struct(">I")
+
+
+def _backend() -> str:
+    be = os.environ.get("MADSIM_NET_BACKEND", "tcp")
+    if be not in ("tcp", "uds"):
+        raise ValueError(f"MADSIM_NET_BACKEND={be!r}: expected 'tcp' or 'uds'")
+    return be
+
+
+_checked_uds_dirs: set = set()
+
+
+def _uds_dir() -> str:
+    d = os.environ.get("MADSIM_UDS_DIR") or f"/tmp/madsim-uds-{os.getuid()}"
+    if d not in _checked_uds_dirs:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        # frames are pickled: a socket dir another user can touch is code
+        # execution, so refuse pre-existing dirs we don't exclusively own
+        # (makedirs(exist_ok=True) never checks that)
+        st = os.stat(d)
+        if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+            raise OSError(
+                f"unsafe MADSIM_UDS_DIR {d!r}: must be owned by uid "
+                f"{os.getuid()} with mode 0700"
+            )
+        _checked_uds_dirs.add(d)
+    return d
+
+
+def _uds_path(addr: SocketAddr) -> str:
+    return os.path.join(_uds_dir(), f"{addr[0]}_{addr[1]}.sock")
+
+
+async def _uds_claim(path: str) -> None:
+    """EADDRINUSE semantics for socket paths.
+
+    asyncio's start_unix_server UNLINKS a pre-existing file at the path
+    before binding — two binds to one address would silently hijack instead
+    of failing like TCP. If the path exists, probe it: a live listener =>
+    address in use; connection refused => stale socket from a dead process,
+    safe to remove (the standard UDS stale-socket dance).
+    """
+    if not os.path.exists(path):
+        return
+    try:
+        _r, w = await asyncio.open_unix_connection(path)
+    except (ConnectionRefusedError, FileNotFoundError):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return
+    w.close()
+    raise OSError(f"address already in use: {path}")
+
+
+async def _open_stream(dst: SocketAddr):
+    """(reader, writer) toward a logical address over the selected wire."""
+    if _backend() == "uds":
+        return await asyncio.open_unix_connection(_uds_path(dst))
+    return await asyncio.open_connection(dst[0], dst[1])
 
 
 def _write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
@@ -103,6 +176,7 @@ class RealEndpoint:
         self._server: Optional[asyncio.AbstractServer] = None
         self._addr: Optional[SocketAddr] = None
         self._peer: Optional[SocketAddr] = None
+        self._uds_path: Optional[str] = None  # owned socket file (uds backend)
         # dst -> (writer, pipe task) cache for datagram pipes
         self._pipes: Dict[SocketAddr, asyncio.StreamWriter] = {}
 
@@ -112,9 +186,38 @@ class RealEndpoint:
     async def bind(addr: ToSocketAddrs) -> "RealEndpoint":
         host, port = await lookup_host(addr)
         ep = RealEndpoint()
-        ep._server = await asyncio.start_server(ep._on_connection, host, port)
-        sock = ep._server.sockets[0]
-        ep._addr = (host, sock.getsockname()[1])
+        if _backend() == "uds":
+            if port == 0:
+                # no OS port allocator for paths: reserve a logical port
+                # with an O_EXCL lock file (atomic, so concurrent binds in
+                # any process can't pick the same candidate), then skip
+                # candidates whose socket path is (even stale-)occupied
+                for off in range(20000):
+                    cand = 20000 + (os.getpid() * 7919 + off) % 20000
+                    p = _uds_path((host, cand))
+                    try:
+                        fd = os.open(p + ".lock", os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    except FileExistsError:
+                        continue
+                    os.close(fd)
+                    if os.path.exists(p):
+                        os.unlink(p + ".lock")
+                        continue
+                    port = cand
+                    break
+                else:
+                    raise OSError("no free uds logical ports (20000-39999)")
+            else:
+                await _uds_claim(_uds_path((host, port)))
+            ep._uds_path = _uds_path((host, port))
+            ep._server = await asyncio.start_unix_server(
+                ep._on_connection, ep._uds_path
+            )
+            ep._addr = (host, port)
+        else:
+            ep._server = await asyncio.start_server(ep._on_connection, host, port)
+            sock = ep._server.sockets[0]
+            ep._addr = (host, sock.getsockname()[1])
         return ep
 
     @staticmethod
@@ -135,7 +238,9 @@ class RealEndpoint:
         actually picked toward that peer) with our server's listen port.
         """
         host, port = self.local_addr()
-        if host in ("0.0.0.0", "::"):
+        if host in ("0.0.0.0", "::") and _backend() != "uds":
+            # (uds: the logical tuple IS the address — it names a same-host
+            # socket path, so the wildcard host needs no rewriting)
             sockname = writer.get_extra_info("sockname")
             if sockname:
                 host = sockname[0]
@@ -156,6 +261,13 @@ class RealEndpoint:
     def close(self) -> None:
         if self._server is not None:
             self._server.close()
+        if self._uds_path is not None:
+            for p in (self._uds_path, self._uds_path + ".lock"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            self._uds_path = None
         for w in self._pipes.values():
             try:
                 w.close()
@@ -227,7 +339,7 @@ class RealEndpoint:
     async def send_to_raw(self, dst: SocketAddr, tag: int, data: Any) -> None:
         writer = self._pipes.get(dst)
         if writer is None or writer.is_closing():
-            reader, writer = await asyncio.open_connection(dst[0], dst[1])
+            reader, writer = await _open_stream(dst)
             _write_frame(writer, ("dgram", self._advertised(writer)))
             self._pipes[dst] = writer
         _write_frame(writer, (tag, data))
@@ -246,7 +358,7 @@ class RealEndpoint:
         self, dst: ToSocketAddrs
     ) -> Tuple[RealPayloadSender, RealPayloadReceiver, SocketAddr]:
         resolved = await lookup_host(dst)
-        reader, writer = await asyncio.open_connection(resolved[0], resolved[1])
+        reader, writer = await _open_stream(resolved)
         _write_frame(writer, ("conn1", self._advertised(writer)))
         return (
             RealPayloadSender(writer),

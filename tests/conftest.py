@@ -10,9 +10,26 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache: the TPU-engine tests compile dozens of
+# large programs (~18s each cold); caching cuts repeat suite runs by
+# several minutes. /tmp is machine-local, so a container migration can't
+# replay AOT code compiled for a different CPU. The cache loader logs
+# spurious ERROR lines about "prefer-no-scatter" pseudo-features differing
+# from the detected host (a cosmetic XLA:CPU logging bug on same-machine
+# reloads), so silence XLA's C++ log stream for test runs — test failures
+# surface as Python exceptions, never via that stream.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        # per-uid (like the uds socket dir): a shared path would leave
+        # second users unable to write AND trusting artifacts they don't own
+        jax.config.update(
+            "jax_compilation_cache_dir", f"/tmp/madsim_tpu_jaxcache-{os.getuid()}"
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 except ImportError:
     pass

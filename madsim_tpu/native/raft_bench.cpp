@@ -413,6 +413,20 @@ struct Sim {
         bool kb = m >= node[b].base - 1 && m < node[b].log_len;
         if (ka && kb && node[a].hash_at(m) != node[b].hash_at(m)) return false;
       }
+    // leader completeness (Raft 5.4, mirrors raft.py): a live leader must
+    // extend past and chain-agree with the committed prefix of every node
+    // whose term it has reached (deposed lower-term leaders are not bound)
+    for (int l = 0; l < N; l++) {
+      if (!alive[l] || node[l].role != LEADER) continue;
+      for (int a = 0; a < N; a++) {
+        if (node[a].term > node[l].term) continue;
+        int32_t ca = node[a].commit;
+        if (ca < 0) continue;
+        if (node[l].log_len - 1 < ca) return false;
+        bool kl = ca >= node[l].base - 1 && ca < node[l].log_len;
+        if (kl && node[l].hash_at(ca) != node[a].hash_at(ca)) return false;
+      }
+    }
     return true;
   }
 

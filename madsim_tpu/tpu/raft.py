@@ -27,6 +27,10 @@ Checked invariants (per lane, per step):
     means the entire prefixes agree w.h.p. — strictly stronger than the
     old per-index (term, cmd) comparison, and cheaper: [N] hashes instead
     of [N, N, LOG] compares.
+  * Leader Completeness (Raft §5.4): a live leader extends past — and
+    chain-agrees with — the committed prefix of every node whose term it
+    has reached (deposed lower-term leaders are legitimately behind and
+    not bound).
 
 Durable vs volatile state mirrors Raft's persistence rules: term / voted_for
 / log window / snapshot (base, base_hash, base_term) survive a crash
@@ -543,7 +547,42 @@ def make_raft_spec(
         comparable = known_a & known_b & (m >= 0)
         log_matching = ~(comparable & (h_a != h_b)).any()
 
-        return election_safety & log_matching
+        # Leader Completeness (Raft §5.4): an elected leader holds every
+        # committed entry. A pair (leader l, node a) is bound only when
+        # term[a] <= term[l]: node a's committed entries were committed at
+        # terms <= term[a] (appends are rejected from stale terms, and
+        # accepting one raises a's term to the sender's), so l is obliged
+        # to hold them — while a deposed lower-term leader that simply
+        # hasn't heard of the new term yet is legitimately behind and must
+        # NOT be flagged. l must extend past commit[a] and agree on the
+        # chain hash there when it still retains the index (if l compacted
+        # past it, l's snapshot already covers it).
+        ca = ns.commit[None, :]  # [N,N] col = node a, broadcast over rows l
+        bind = (
+            alive[:, None]
+            & is_leader[:, None]
+            & (ns.term[None, :] <= ns.term[:, None])
+            & (ca >= 0)
+        )
+        len_ok = (ns.log_len[:, None] - 1) >= ca
+        rel_l = ca - ns.base[:, None]  # leader-row window offset of commit[a]
+        lh_win = (
+            h_all[:, None, :]
+            * (ridx[None, None, :] == rel_l[:, :, None]).astype(jnp.uint32)
+        ).sum(-1, dtype=jnp.uint32)
+        h_l = jnp.where(
+            ca == ns.base[:, None] - 1,
+            ns.base_hash[:, None].astype(jnp.uint32),
+            lh_win,
+        )
+        known_l = (ca >= ns.base[:, None] - 1) & (ca < ns.log_len[:, None])
+        # a's own hash at its commit — always retained: compaction keeps
+        # base - 1 <= commit, and commit < log_len by construction
+        h_self = jax.vmap(hash_at)(ns, ns.commit)  # [N]
+        hash_ok = (h_l == h_self[None, :]) | ~known_l
+        leader_completeness = ~(bind & (~len_ok | ~hash_ok)).any()
+
+        return election_safety & log_matching & leader_completeness
 
     # ------------------------------------------------------------ diagnostics
 

@@ -278,6 +278,7 @@ async def _fuzz_body(
         nodes.append(node)
 
     first_committed: dict = {}  # index -> (term, cmd) first observed committed
+    dead: set = set()  # node ids currently killed (state frozen mid-crash)
 
     def check_invariants() -> None:
         # election safety (a killed node's state is frozen; still applies)
@@ -313,11 +314,29 @@ async def _fuzz_body(
                         f"committed entry rewritten at {i}: {seen} -> {r.log[i]} "
                         f"(node {r.node_id})"
                     )
+        # leader completeness (Raft §5.4), mirroring tpu/raft.py's device
+        # check: a live leader must hold every node's committed prefix once
+        # its term has reached that node's (a's commits happened at terms
+        # <= a.term; a deposed lower-term leader is legitimately behind)
+        for leader in rafts:
+            if leader.role != LEADER or leader.node_id in dead:
+                continue
+            for a in rafts:
+                if a.term > leader.term:
+                    continue
+                for i in range(a.commit + 1):
+                    if i >= len(leader.log) or leader.log[i] != a.log[i]:
+                        raise InvariantViolation(
+                            f"incomplete leader {leader.node_id} (term "
+                            f"{leader.term}): misses node {a.node_id}'s "
+                            f"committed entry {i}"
+                        )
 
     async def chaos_task() -> None:
         while True:
             await ms.time.sleep(0.5 + ms.rand() * 2.5)
             victim = ms.randrange(n_nodes)
+            dead.add(victim)
             handle.kill(nodes[victim].id)
             await ms.time.sleep(0.3 + ms.rand() * 1.7)
             # fresh RaftNode object: volatile state lost, durable state kept
@@ -329,6 +348,7 @@ async def _fuzz_body(
             fresh.log = list(old.log)
             fresh.next_cmd = old.next_cmd
             rafts[victim] = fresh
+            dead.discard(victim)
             handle.restart(nodes[victim].id)
             nodes[victim].spawn(fresh.run())
 

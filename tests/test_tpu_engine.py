@@ -498,3 +498,108 @@ def test_lookahead_window_batches_independent_events():
         for n in np.nonzero(fired[t])[0]:
             assert t_evt[t, n] >= last[n], (t, n)  # per-node order exact
             last[n] = t_evt[t, n]
+
+
+def test_leader_completeness_invariant_crafted_states():
+    """Unit cases for the Leader Completeness check (Raft §5.4): a bound
+    leader missing a committed entry violates; a deposed lower-term leader
+    and a compacted-past leader do not (the false-positive traps)."""
+    spec = make_raft_spec(3, log_capacity=8)
+    node, _timer = jax.vmap(spec.init, in_axes=(0, 0))(
+        jnp.zeros((3,), jnp.uint32), jnp.arange(3, dtype=jnp.int32)
+    )
+    alive = jnp.ones((3,), jnp.bool_)
+    now = jnp.int32(1_000_000)
+    e_hash = raft_mod._chain_fold(jnp.uint32(0), 1, 7)  # entry (term=1, cmd=7)
+
+    def with_entry(n, i):
+        """Give node i entry (1,7) at index 0, committed."""
+        return n._replace(
+            log_term=n.log_term.at[i, 0].set(1),
+            log_cmd=n.log_cmd.at[i, 0].set(7),
+            log_chain=n.log_chain.at[i, 0].set(e_hash),
+            log_len=n.log_len.at[i].set(1),
+            commit=n.commit.at[i].set(0),
+        )
+
+    ok = lambda n: bool(spec.check_invariants(n, alive, now))
+
+    # node 1 committed an entry; node 0 is a leader of term >= node 1's
+    # term but holds nothing => INCOMPLETE leader, must violate
+    bad = with_entry(node, 1)._replace(
+        role=node.role.at[0].set(raft_mod.LEADER),
+        term=node.term.at[0].set(5),
+    )
+    assert not ok(bad)
+
+    # same leader, but deposed: term 5 < node 1's term 7 — it simply has
+    # not heard of the new term yet; must NOT be flagged
+    deposed = bad._replace(term=bad.term.at[1].set(7))
+    assert ok(deposed)
+
+    # complete leader: same entry in its log — passes
+    good = with_entry(bad, 0)
+    assert ok(good)
+
+    # leader compacted PAST the committed index (snapshot covers it):
+    # base=2 > commit[1]+1, retains nothing at index 0 — passes on length
+    compacted = with_entry(node, 1)._replace(
+        role=node.role.at[0].set(raft_mod.LEADER),
+        term=node.term.at[0].set(5),
+        base=node.base.at[0].set(2),
+        base_hash=node.base_hash.at[0].set(12345),
+        log_len=node.log_len.at[0].set(2),
+        commit=node.commit.at[0].set(1),
+    )
+    assert ok(compacted)
+
+    # complete in length but chain-DIVERGENT at the committed index:
+    # leader holds a different entry at index 0 => must violate
+    divergent = with_entry(node, 1)._replace(
+        role=node.role.at[0].set(raft_mod.LEADER),
+        term=node.term.at[0].set(5),
+        log_term=node.log_term.at[0, 0].set(2),
+        log_cmd=node.log_cmd.at[0, 0].set(99),
+        log_chain=node.log_chain.at[0, 0].set(
+            raft_mod._chain_fold(jnp.uint32(0), 2, 99)
+        ),
+        log_len=node.log_len.at[0].set(1),
+    )
+    assert not ok(divergent)
+
+
+def test_unsafe_election_bug_caught_by_leader_completeness():
+    """Injected bug: voters grant votes WITHOUT the log up-to-date check
+    (Raft §5.4.1's election restriction removed). Candidates behind the
+    committed prefix then win elections; Leader Completeness catches the
+    incomplete leader directly — before it has to actively destroy
+    committed state to be noticed."""
+    spec = make_raft_spec(5, client_rate=0.8)
+
+    def unsafe_vote(s, nid, src, kind, payload, now, key):
+        state, out, timer = spec.on_message(s, nid, src, kind, payload, now, key)
+        is_rv = kind == raft_mod.REQUEST_VOTE
+        c_term = payload[0]
+        newer = c_term > s.term
+        term = jnp.where(newer, c_term, s.term)
+        voted_for = jnp.where(newer, -1, s.voted_for)
+        # the buggy grant: no comparison of candidate log freshness
+        grant = is_rv & (c_term == term) & ((voted_for == -1) | (voted_for == src))
+        # overwrite the VOTE_RESP's granted field and record the vote
+        pay = out.payload.at[0, 1].set(
+            jnp.where(is_rv, grant.astype(jnp.int32), out.payload[0, 1])
+        )
+        state = state._replace(
+            voted_for=jnp.where(is_rv & grant, src, state.voted_for)
+        )
+        return state, out._replace(payload=pay), timer
+
+    buggy = dataclasses.replace(spec, on_message=unsafe_vote)
+    sim = BatchedSim(buggy, partition_config(loss_rate=0.1))
+    state = sim.run(jnp.arange(256), max_steps=60_000)
+    assert summarize(state)["violations"] > 0
+
+    # control: the correct spec stays safe under the identical chaos
+    sim_ok = BatchedSim(spec, partition_config(loss_rate=0.1))
+    state_ok = sim_ok.run(jnp.arange(256), max_steps=60_000)
+    assert summarize(state_ok)["violations"] == 0

@@ -1,0 +1,111 @@
+"""Real-mode RPC microbenchmarks — the madsim/benches/rpc.rs analog.
+
+The reference measures (criterion, std mode): empty RPC round-trip latency
+(rpc.rs:11-26) and request throughput at payload sizes 16 B..1 MiB
+(rpc.rs:28-53) over its real TCP backend. Same harness here, over BOTH real
+transports (std/net/mod.rs:33-38 selection analog):
+
+    python benches/rpc_bench.py [--rounds 2000] [--backends tcp,uds]
+
+Prints one JSON line per (backend, measurement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PAYLOAD_SIZES = [16, 256, 4 << 10, 64 << 10, 1 << 20]  # rpc.rs:36
+
+from madsim_tpu.net import rpc  # noqa: E402
+
+
+@rpc.rpc_request
+class Echo:
+    """Module-level: request types must pickle in production mode."""
+
+
+async def _bench_backend(backend: str, rounds: int, uds_dir: str) -> list:
+    os.environ["MADSIM_NET_BACKEND"] = backend
+    if backend == "uds":
+        os.environ["MADSIM_UDS_DIR"] = uds_dir
+
+    from madsim_tpu.net import Endpoint
+
+    server = await Endpoint.bind("127.0.0.1:0")
+
+    async def handle(_req, data):
+        return None, data  # echo the payload back (rpc.rs echo service)
+
+    rpc.add_rpc_handler_with_data(server, Echo, handle)
+    client = await Endpoint.bind("127.0.0.1:0")
+    addr = server.local_addr()
+
+    results = []
+
+    # empty round-trip latency (rpc.rs:11-26)
+    await rpc.call_with_data(client, addr, Echo(), b"")  # warm
+    lat = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        await rpc.call_with_data(client, addr, Echo(), b"")
+        lat.append(time.perf_counter() - t0)
+    results.append(
+        {
+            "bench": "rpc_latency_empty",
+            "backend": backend,
+            "p50_us": round(statistics.median(lat) * 1e6, 1),
+            "p99_us": round(sorted(lat)[int(len(lat) * 0.99)] * 1e6, 1),
+            "rounds": rounds,
+        }
+    )
+
+    # payload throughput (rpc.rs:28-53): bytes echoed per second
+    for size in PAYLOAD_SIZES:
+        payload = os.urandom(size)
+        n = max(50, min(rounds, (16 << 20) // size))
+        await rpc.call_with_data(client, addr, Echo(), payload)  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            await rpc.call_with_data(client, addr, Echo(), payload)
+        wall = time.perf_counter() - t0
+        results.append(
+            {
+                "bench": f"rpc_throughput_{size}B",
+                "backend": backend,
+                "mb_per_sec": round(size * n * 2 / wall / 1e6, 2),  # both ways
+                "calls_per_sec": round(n / wall, 1),
+                "rounds": n,
+            }
+        )
+
+    server.close()
+    client.close()
+    return results
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rounds", type=int, default=2000)
+    parser.add_argument("--backends", default="tcp,uds")
+    args = parser.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="rpcbench-") as uds_dir:
+        for backend in args.backends.split(","):
+            # fresh loop per backend: the rpc serve tasks die with the loop
+            for row in asyncio.run(
+                _bench_backend(backend.strip(), args.rounds, uds_dir)
+            ):
+                print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -565,15 +565,11 @@ def make_raft_spec(
             & (ca >= 0)
         )
         len_ok = (ns.log_len[:, None] - 1) >= ca
-        rel_l = ca - ns.base[:, None]  # leader-row window offset of commit[a]
-        lh_win = (
-            h_all[:, None, :]
-            * (ridx[None, None, :] == rel_l[:, :, None]).astype(jnp.uint32)
-        ).sum(-1, dtype=jnp.uint32)
-        h_l = jnp.where(
-            ca == ns.base[:, None] - 1,
-            ns.base_hash[:, None].astype(jnp.uint32),
-            lh_win,
+        # row l's chain hash at column a's commit, via the shared helper:
+        # outer vmap walks leader rows, inner walks the commit columns
+        ca_mat = jnp.broadcast_to(ns.commit[None, :], (N, N))
+        h_l = jax.vmap(jax.vmap(hash_at, in_axes=(None, 0)), in_axes=(0, 0))(
+            ns, ca_mat
         )
         known_l = (ca >= ns.base[:, None] - 1) & (ca < ns.log_len[:, None])
         # a's own hash at its commit — always retained: compaction keeps

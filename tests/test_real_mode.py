@@ -306,3 +306,26 @@ def test_real_uds_backend_grpc(monkeypatch, tmp_path):
         return reply
 
     assert run(main())["message"] == "Hello uds!"
+
+
+def test_rpc_bench_harness_smoke():
+    """benches/rpc_bench.py (the madsim/benches/rpc.rs analog) runs end to
+    end on both transports and emits well-formed JSON rows."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    bench = pathlib.Path(__file__).resolve().parents[1] / "benches" / "rpc_bench.py"
+    proc = subprocess.run(
+        [sys.executable, str(bench), "--rounds", "5"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rows = [json.loads(line) for line in proc.stdout.strip().splitlines()]
+    benches = {(r["backend"], r["bench"]) for r in rows}
+    for be in ("tcp", "uds"):
+        assert (be, "rpc_latency_empty") in benches
+        assert (be, "rpc_throughput_1048576B") in benches

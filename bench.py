@@ -16,6 +16,28 @@ import json
 import time
 
 
+def _timed_median_of_3(sim, lanes: int, max_steps: int):
+    """Warm-compile, then time 3 fresh-seed reps and take the median wall.
+
+    The tunnel TPU is shared — external contention has been observed to
+    halve throughput for stretches, and one transient tunnel hiccup
+    produced a physically impossible 53 ms rep. The median ignores a
+    single outlier in EITHER direction."""
+    import jax.numpy as jnp
+
+    state = sim.run(jnp.arange(lanes), max_steps=max_steps)  # compile + warm
+    state.clock.block_until_ready()
+    walls = []
+    for rep in range(1, 4):
+        t0 = time.perf_counter()
+        state = sim.run(
+            jnp.arange(rep * lanes, (rep + 1) * lanes), max_steps=max_steps
+        )
+        state.clock.block_until_ready()
+        walls.append(time.perf_counter() - t0)
+    return sorted(walls)[1], state
+
+
 def bench_tpu(lanes: int, virtual_secs: float, client_rate: float) -> dict:
     import jax
     import jax.numpy as jnp
@@ -44,16 +66,7 @@ def bench_tpu(lanes: int, virtual_secs: float, client_rate: float) -> dict:
     )
     sim = BatchedSim(spec, cfg)
     max_steps = int(virtual_secs * 600) + 2000  # generous event budget
-
-    # compile + warm (first run pays tracing/compile)
-    state = sim.run(jnp.arange(lanes), max_steps=max_steps)
-    state.clock.block_until_ready()
-
-    t0 = time.perf_counter()
-    state = sim.run(jnp.arange(lanes, 2 * lanes), max_steps=max_steps)
-    state.clock.block_until_ready()
-    wall = time.perf_counter() - t0
-
+    wall, state = _timed_median_of_3(sim, lanes, max_steps)
     s = summarize(state, spec)
     return {
         "wall_s": wall,
@@ -78,17 +91,47 @@ def bench_kv(lanes: int, virtual_secs: float) -> dict:
     sim = BatchedSim(wl.spec, wl.config)
     max_steps = int(virtual_secs * 1200) + 2000
 
-    state = sim.run(jnp.arange(lanes), max_steps=max_steps)  # compile + warm
-    state.clock.block_until_ready()
-    t0 = time.perf_counter()
-    state = sim.run(jnp.arange(lanes, 2 * lanes), max_steps=max_steps)
-    state.clock.block_until_ready()
-    wall = time.perf_counter() - t0
+    wall, state = _timed_median_of_3(sim, lanes, max_steps)
     s = summarize(state, wl.spec)
     return {
         "wall_s": wall,
         "seeds_per_sec": lanes / wall,
         "summary": s,
+    }
+
+
+def bench_twopc(lanes: int, virtual_secs: float) -> dict:
+    """Third device protocol: Two-Phase Commit atomicity under the full
+    chaos battery (loss + coordinator crashes + partitions)."""
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu import BatchedSim, SimConfig, make_twopc_spec, summarize
+
+    sim = BatchedSim(
+        make_twopc_spec(5),
+        SimConfig(
+            horizon_us=int(virtual_secs * 1e6),
+            # 50 candidate positions (N * max_out + N * max_out_msg) x 2+
+            # slots: overflow must be 0 — nothing dropped outside loss_rate
+            msg_capacity=128,
+            loss_rate=0.1,
+            crash_interval_lo_us=400_000,
+            crash_interval_hi_us=2_000_000,
+            restart_delay_lo_us=200_000,
+            restart_delay_hi_us=1_000_000,
+            partition_interval_lo_us=400_000,
+            partition_interval_hi_us=1_500_000,
+            partition_heal_lo_us=300_000,
+            partition_heal_hi_us=1_200_000,
+        ),
+    )
+    max_steps = int(virtual_secs * 1600) + 2000
+
+    wall, state = _timed_median_of_3(sim, lanes, max_steps)
+    return {
+        "wall_s": wall,
+        "seeds_per_sec": lanes / wall,
+        "summary": summarize(state, sim.spec),
     }
 
 
@@ -116,18 +159,23 @@ def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) ->
         )
         if r.returncode != 0:
             return None
-    try:
-        r = subprocess.run(
-            [str(out), str(n_seeds), str(virtual_secs), str(client_rate), "0.1"],
-            capture_output=True, text=True, timeout=600,
-        )
-        if r.returncode != 0:
-            return None
-        return json.loads(r.stdout.strip().splitlines()[-1])
-    except (subprocess.TimeoutExpired, ValueError, IndexError):
-        # degrade to the python_host denominator, like the missing-toolchain
-        # and compile-failure paths — never kill the bench
+    rows = []
+    for _ in range(3):  # median of 3, same rep scheme as every other side
+        try:
+            r = subprocess.run(
+                [str(out), str(n_seeds), str(virtual_secs), str(client_rate), "0.1"],
+                capture_output=True, text=True, timeout=600,
+            )
+            if r.returncode != 0:
+                break
+            rows.append(json.loads(r.stdout.strip().splitlines()[-1]))
+        except (subprocess.TimeoutExpired, ValueError, IndexError):
+            # keep any completed reps; missing-toolchain/compile-failure paths
+            # degrade to the python_host denominator — never kill the bench
+            break
+    if not rows:
         return None
+    return sorted(rows, key=lambda x: x["seeds_per_sec"])[(len(rows) - 1) // 2]
 
 
 def bench_cpu_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
@@ -137,19 +185,23 @@ def bench_cpu_baseline(n_seeds: int, virtual_secs: float, client_rate: float) ->
     fuzz_one_seed(
         999_983, virtual_secs=virtual_secs, client_rate=client_rate, partitions=True
     )
-    t0 = time.perf_counter()
-    events = 0
-    for seed in range(n_seeds):
-        r = fuzz_one_seed(
-            seed, virtual_secs=virtual_secs, client_rate=client_rate, partitions=True
-        )
-        events += r["events"]
-    wall = time.perf_counter() - t0
-    return {
-        "wall_s": wall,
-        "seeds_per_sec": n_seeds / wall,
-        "events_per_sec": events / wall,
-    }
+    rows = []
+    for rep in range(3):  # median of 3, same rep scheme as every other side
+        t0 = time.perf_counter()
+        events = 0
+        for seed in range(rep * n_seeds, (rep + 1) * n_seeds):
+            r = fuzz_one_seed(
+                seed, virtual_secs=virtual_secs, client_rate=client_rate,
+                partitions=True,
+            )
+            events += r["events"]
+        wall = time.perf_counter() - t0
+        rows.append({
+            "wall_s": wall,
+            "seeds_per_sec": n_seeds / wall,
+            "events_per_sec": events / wall,
+        })
+    return sorted(rows, key=lambda x: x["seeds_per_sec"])[1]
 
 
 def main() -> None:
@@ -169,6 +221,7 @@ def main() -> None:
     )
     tpu = bench_tpu(args.lanes, args.virtual_secs, args.client_rate)
     kv = bench_kv(args.lanes // 4, args.virtual_secs)
+    twopc = bench_twopc(args.lanes // 4, args.virtual_secs)
 
     # vs_baseline is computed against the STRONGEST CPU execution available:
     # the compiled C++ thread-per-seed DES (the reference's execution model)
@@ -206,6 +259,14 @@ def main() -> None:
         "kv_violations": kv["summary"]["violations"],
         "kv_mean_acked_ops": round(kv["summary"].get("mean_acked_ops", 0.0), 2),
         "kv_history_wrapped_lanes": kv["summary"].get("history_wrapped_lanes", 0),
+        # third device protocol (2PC atomicity, full chaos battery)
+        "twopc_seeds_per_sec": round(twopc["seeds_per_sec"], 2),
+        "twopc_lanes": args.lanes // 4,
+        "twopc_violations": twopc["summary"]["violations"],
+        "twopc_overflow": twopc["summary"]["total_overflow"],
+        "twopc_mean_decided_txns": round(
+            twopc["summary"].get("mean_decided_txns", 0.0), 1
+        ),
         "backend": tpu["backend"],
     }
     print(json.dumps(result))

@@ -65,7 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec
+from .spec import Outbox, ProtocolSpec, tree_select
 
 REPLICA, CLAIMING, PRIMARY = 0, 1, 2
 HB, CLAIM, CLAIM_ACK, WREP, WACK, RPROBE, RACK, CREQ, CRSP = range(9)
@@ -162,15 +162,7 @@ def make_kv_spec(
             payload=jnp.broadcast_to(pay[None, :], (N, P)),
         )
 
-    def pick_out(cond, a: Outbox, b: Outbox) -> Outbox:
-        """Elementwise outbox select on a traced scalar condition."""
-        return jax.tree_util.tree_map(
-            lambda x, y: jnp.where(
-                jnp.broadcast_to(jnp.reshape(cond, (1,) * x.ndim), x.shape), x, y
-            ),
-            a,
-            b,
-        )
+    pick_out = tree_select  # elementwise outbox select (shared helper)
 
     def out_if(cond, out: Outbox) -> Outbox:
         return pick_out(cond, out, no_out())

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec
+from .spec import Outbox, ProtocolSpec, tree_select
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 REQUEST_VOTE, VOTE_RESP, APPEND, APPEND_RESP, SNAP = 0, 1, 2, 3, 4
@@ -290,18 +290,8 @@ def make_raft_spec(
             votes=(jnp.int32(1) << nid),
         )
 
-        state = jax.tree_util.tree_map(
-            lambda a, b: jnp.where(is_leader, a, b), leader_state, cand_state
-        )
-        out = jax.tree_util.tree_map(
-            lambda a, b: jnp.where(
-                jnp.broadcast_to(jnp.reshape(is_leader, (1,) * a.ndim), a.shape),
-                a,
-                b,
-            ),
-            leader_out,
-            cand_out,
-        )
+        state = tree_select(is_leader, leader_state, cand_state)
+        out = tree_select(is_leader, leader_out, cand_out)
         timer = jnp.where(is_leader, now + heartbeat_us, election_deadline(now, key, 22))
         return state, out, timer
 

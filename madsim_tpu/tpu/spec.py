@@ -43,10 +43,24 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 # sentinel for "no timer" / "no event" (int32 microseconds)
 INF_US = jnp.int32(2**31 - 1)
+
+
+def tree_select(cond, a, b):
+    """Elementwise pytree select on a traced scalar condition — the shared
+    helper behind every spec's pick_out/pick_state (works for Outbox, state
+    NamedTuples, or any pytree with broadcastable leaves)."""
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(
+            jnp.broadcast_to(jnp.reshape(cond, (1,) * x.ndim), x.shape), x, y
+        ),
+        a,
+        b,
+    )
 
 
 class Outbox(NamedTuple):

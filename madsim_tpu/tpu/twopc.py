@@ -1,0 +1,352 @@
+"""Two-Phase Commit — the third device fuzz protocol.
+
+A deliberately different *shape* from tpu/raft.py (symmetric replicated
+log) and tpu/kv.py (client/replica quorum rounds): asymmetric static roles
+— node 0 is the COORDINATOR, nodes 1..N-1 are PARTICIPANTS — running
+one-shot atomic-commit rounds, the textbook blocking protocol whose failure
+modes (coordinator crash between decision and broadcast, in-doubt
+participants, lost votes) are exactly what crash/partition/loss chaos
+exercises. Reference parity: the reference fuzzes protocols of this family
+as user code on its host runtime (madsim/src/sim/ executor + chaos API);
+this is the device-batched equivalent via `ProtocolSpec`.
+
+Protocol (presumed abort, cooperative termination):
+
+  * Coordinator timer (no open txn): start txn `tid` (monotonic),
+    broadcast PREPARE(tid), await votes until a prepare timeout.
+  * Participant on PREPARE: roll a vote (seeded, per (lane, node, tid)).
+    NO  -> record local ABORT durably, reply VOTE(no). A no-voter may
+           forget the txn: the coordinator cannot commit without it.
+    YES -> record the yes-vote durably (this IS the in-doubt state: a
+           yes-vote with no recorded outcome), reply VOTE(yes). A
+           yes-voter must NOT decide unilaterally — it blocks until it
+           learns the outcome (the blocking property that makes 2PC a
+           chaos magnet).
+  * Coordinator on VOTE: any NO => decide ABORT; all N-1 YES => decide
+    COMMIT. The decision is recorded durably IN THE SAME handler that
+    broadcasts OUTCOME — the atomic "commit point".
+  * Coordinator timer with an open undecided txn: the prepare deadline
+    passed (or restart recovery, below) => presumed abort: decide ABORT
+    and broadcast it.
+  * Coordinator crash: the collection phase and vote mask are volatile,
+    tid_cur is durable. Recovery: the first post-restart timer finds
+    tid_cur undecided and presumed-aborts it.
+  * In-doubt participant timer: cooperative termination — send DREQ for
+    the OLDEST unresolved yes-vote to the coordinator, which re-sends the
+    recorded OUTCOME (or stays silent while itself undecided; the
+    participant retries). In-doubt txns are DERIVED by joining the vote
+    ring against the outcome ring, so a participant can be in doubt on
+    several transactions at once and none is silently abandoned when a
+    newer PREPARE arrives.
+
+Durable vs volatile mirrors the paper's stable log: the outcome and vote
+rings and tid_cur survive crashes (`on_restart`); the coordinator's vote
+mask does not.
+
+Safety check (vectorized, per lane): outcomes and votes live in rings
+keyed by ABSOLUTE tid (slot = tid % TXN, tag = tid), so ring reuse cannot
+alias two transactions:
+  * Atomicity: no two nodes record different outcomes for the same tid.
+  * Vote respect: a node never records COMMIT for a txn it voted NO on
+    (joined through the tid tags of both rings).
+
+The classic injected bug (tests): an in-doubt participant times out and
+unilaterally aborts (the canonical wrong implementation). Harmless until
+chaos delays the coordinator's COMMIT past the participant's patience —
+then one node aborts a committed txn and the atomicity check fires.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .spec import Outbox, ProtocolSpec, empty_outbox, tree_select
+
+NONE, COMMIT, ABORT = 0, 1, 2
+PREPARE, VOTE, OUTCOME, DREQ = 0, 1, 2, 3
+PAYLOAD_WIDTH = 3  # (tid, flag, spare)
+
+
+class TpcState(NamedTuple):
+    # coordinator (meaningful on node 0 only)
+    tid_cur: jnp.ndarray  # i32 last txn started           (durable)
+    vote_mask: jnp.ndarray  # i32 yes-voter bitmask        (volatile)
+    # outcome ring, slot = tid % TXN, keyed by absolute tid
+    o_tid: jnp.ndarray  # i32 [TXN] absolute tid, -1 empty (durable)
+    o_val: jnp.ndarray  # i32 [TXN] COMMIT/ABORT           (durable)
+    # own-vote ring, same slotting, independent tid tags
+    v_tid: jnp.ndarray  # i32 [TXN] absolute tid, -1 empty (durable)
+    v_val: jnp.ndarray  # i32 [TXN] COMMIT(yes)/ABORT(no)  (durable)
+    decided: jnp.ndarray  # i32 outcomes recorded          (diagnostics)
+
+
+def make_twopc_spec(
+    n_nodes: int = 5,
+    txn_ring: int = 16,
+    txn_gap_us: int = 40_000,
+    prepare_timeout_us: int = 120_000,
+    doubt_retry_us: int = 80_000,
+    vote_yes_p: float = 0.85,
+) -> ProtocolSpec:
+    N, TXN = n_nodes, txn_ring
+    assert N >= 3
+    peers = jnp.arange(N, dtype=jnp.int32)
+    tidx = jnp.arange(TXN, dtype=jnp.int32)
+    ALL_YES = (1 << N) - 2  # bits 1..N-1
+    IDLE_FAR = 2**28  # "unarmed" participant timer offset (ns-safe int32)
+
+    def no_out():
+        return empty_outbox(N, PAYLOAD_WIDTH)
+
+    def reply(dst, kind, tid, flag):
+        """One message in outbox ROW dst (not row 0): each destination gets
+        its own pool region, so the coordinator answering several DREQs
+        within one latency window never overflows a shared region."""
+        pay = jnp.zeros((N, PAYLOAD_WIDTH), jnp.int32)
+        pay = pay.at[dst, 0].set(tid).at[dst, 1].set(flag)
+        return Outbox(
+            valid=(peers == dst),
+            dst=jnp.full((N,), dst, jnp.int32),
+            kind=jnp.full((N,), kind, jnp.int32),
+            payload=pay,
+        )
+
+    def broadcast(kind, tid, flag):
+        """Coordinator -> all participants."""
+        pay = jnp.zeros((PAYLOAD_WIDTH,), jnp.int32).at[0].set(tid).at[1].set(flag)
+        return Outbox(
+            valid=(peers != 0),
+            dst=peers,
+            kind=jnp.full((N,), kind, jnp.int32),
+            payload=jnp.broadcast_to(pay[None, :], (N, PAYLOAD_WIDTH)),
+        )
+
+    pick_out = pick_state = tree_select
+
+    def record_outcome(s: TpcState, do, tid, outcome):
+        """Claim slot tid%TXN for (tid, outcome) when `do`; first write for
+        a given tid wins (a recorded outcome is immutable — re-delivered
+        OUTCOMEs and late DREQ responses must not flip it). A tid at least
+        TXN behind the newest recorded one is dropped rather than allowed
+        to evict a newer transaction's slot (in-flight delay is bounded by
+        latency_hi << TXN * txn_gap at any sane config; this guard keeps
+        ring reuse sound at insane ones too)."""
+        at = tidx == (tid % TXN)
+        not_stale = tid > s.o_tid.max() - TXN
+        fresh = do & not_stale & ~(at & (s.o_tid == tid)).any()
+        w = at & fresh
+        return s._replace(
+            o_tid=jnp.where(w, tid, s.o_tid),
+            o_val=jnp.where(w, outcome, s.o_val),
+            decided=s.decided + fresh.astype(jnp.int32),
+        )
+
+    def record_vote(s: TpcState, do, tid, vote):
+        at = tidx == (tid % TXN)
+        return s._replace(
+            v_tid=jnp.where(do & at, tid, s.v_tid),
+            v_val=jnp.where(do & at, vote, s.v_val),
+        )
+
+    def outcome_of(s: TpcState, tid):
+        """Recorded outcome for absolute tid, NONE if absent."""
+        hit = (tidx == (tid % TXN)) & (s.o_tid == tid)
+        return jnp.where(hit, s.o_val, 0).sum()
+
+    def unresolved_yes(s: TpcState):
+        """[TXN] mask: yes-votes with no recorded outcome for their tid —
+        the in-doubt set, derived (nothing to abandon or forget). Both
+        rings slot a tid identically, so the join is slot-aligned."""
+        voted_yes = (s.v_tid >= 0) & (s.v_val == COMMIT)
+        resolved = (s.v_tid == s.o_tid) & (s.o_tid >= 0)
+        return voted_yes & ~resolved
+
+    # ------------------------------------------------------------------ init
+
+    def init(key, nid):
+        z = jnp.int32(0)
+        state = TpcState(
+            tid_cur=jnp.int32(-1),
+            vote_mask=z,
+            o_tid=jnp.full((TXN,), -1, jnp.int32),
+            o_val=jnp.zeros((TXN,), jnp.int32),
+            v_tid=jnp.full((TXN,), -1, jnp.int32),
+            v_val=jnp.zeros((TXN,), jnp.int32),
+            decided=z,
+        )
+        first = jnp.where(
+            nid == 0,
+            prng.randint(key, 31, 1_000, txn_gap_us),
+            jnp.int32(IDLE_FAR),
+        )
+        return state, first
+
+    # ----------------------------------------------------------------- timer
+
+    def on_timer(s: TpcState, nid, now, key):
+        is_coord = nid == 0
+
+        # -- coordinator: a timer fire with an open undecided txn means the
+        # prepare deadline passed OR this is post-restart recovery — both
+        # are the presumed-abort case. Otherwise start the next txn.
+        open_undecided = (s.tid_cur >= 0) & (outcome_of(s, s.tid_cur) == NONE)
+        do_abort = is_coord & open_undecided
+        do_start = is_coord & ~open_undecided
+        new_tid = s.tid_cur + 1
+
+        s_c = s._replace(
+            tid_cur=jnp.where(do_start, new_tid, s.tid_cur),
+            vote_mask=jnp.where(do_start | do_abort, 0, s.vote_mask),
+        )
+        s_c = record_outcome(s_c, do_abort, s.tid_cur, ABORT)
+        out_c = pick_out(
+            do_abort,
+            broadcast(OUTCOME, s.tid_cur, ABORT),
+            pick_out(do_start, broadcast(PREPARE, new_tid, 0), no_out()),
+        )
+        timer_c = jnp.where(
+            do_start,
+            now + prepare_timeout_us,
+            now + prng.randint(key, 32, txn_gap_us // 2, txn_gap_us),
+        )
+
+        # -- participant: cooperative termination for the OLDEST in-doubt
+        # yes-vote (retries walk the set oldest-first as outcomes land)
+        doubt = unresolved_yes(s)
+        in_doubt = (~is_coord) & doubt.any()
+        dreq_tid = jnp.where(doubt, s.v_tid, jnp.int32(2**30)).min()
+        out_p = pick_out(in_doubt, reply(0, DREQ, dreq_tid, 0), no_out())
+        timer_p = now + jnp.where(in_doubt, doubt_retry_us, IDLE_FAR)
+
+        state = pick_state(is_coord, s_c, s)
+        out = pick_out(is_coord, out_c, out_p)
+        timer = jnp.where(is_coord, timer_c, timer_p)
+        return state, out, timer
+
+    # -------------------------------------------------------------- messages
+
+    def h_prepare(s: TpcState, nid, src, f, now, key):
+        tid = f[0]
+        # defensive dedupe (the network never duplicates, but a re-PREPARE
+        # of a decided or already-voted txn must not re-roll the vote)
+        voted = ((tidx == (tid % TXN)) & (s.v_tid == tid)).any()
+        known = (outcome_of(s, tid) != NONE) | voted
+        do = (nid != 0) & ~known
+        yes = prng.uniform(prng.fold(key.astype(jnp.uint32), tid), 33) < vote_yes_p
+        # NO: record the local abort (presumed abort lets a no-voter forget)
+        s_no = record_outcome(record_vote(s, do & ~yes, tid, ABORT),
+                              do & ~yes, tid, ABORT)
+        # YES: durable yes-vote — in-doubt until an outcome lands
+        s_yes = record_vote(s, do & yes, tid, COMMIT)
+        state = pick_state(do & yes, s_yes, s_no)
+        vote_flag = jnp.where(yes, COMMIT, ABORT)
+        out = pick_out(do, reply(src, VOTE, tid, vote_flag), no_out())
+        # a yes-voter arms its in-doubt retry timer
+        timer = jnp.where(do & yes, now + doubt_retry_us, jnp.int32(-1))
+        return state, out, timer
+
+    def h_vote(s: TpcState, nid, src, f, now, key):
+        tid, flag = f[0], f[1]
+        live = (nid == 0) & (tid == s.tid_cur) & (outcome_of(s, tid) == NONE)
+        no = live & (flag == ABORT)
+        mask = jnp.where(
+            live & (flag == COMMIT), s.vote_mask | (1 << src), s.vote_mask
+        )
+        all_yes = live & (mask == ALL_YES)
+        decide = no | all_yes
+        outcome = jnp.where(no, ABORT, COMMIT)
+        s2 = s._replace(vote_mask=jnp.where(decide, 0, mask))
+        s2 = record_outcome(s2, decide, tid, outcome)
+        out = pick_out(decide, broadcast(OUTCOME, tid, outcome), no_out())
+        # on decide, schedule the next round; else keep the prepare deadline
+        timer = jnp.where(
+            decide,
+            now + prng.randint(key, 34, txn_gap_us // 2, txn_gap_us),
+            jnp.int32(-1),
+        )
+        return s2, out, timer
+
+    def h_outcome(s: TpcState, nid, src, f, now, key):
+        tid, outcome = f[0], f[1]
+        return record_outcome(s, True, tid, outcome), no_out(), jnp.int32(-1)
+
+    def h_dreq(s: TpcState, nid, src, f, now, key):
+        tid = f[0]
+        known = outcome_of(s, tid)
+        have = (nid == 0) & (known != NONE)
+        out = pick_out(have, reply(src, OUTCOME, tid, known), no_out())
+        return s, out, jnp.int32(-1)
+
+    def on_message(s: TpcState, nid, src, kind, payload, now, key):
+        return jax.lax.switch(
+            jnp.clip(kind, 0, 3),
+            [h_prepare, h_vote, h_outcome, h_dreq],
+            s, nid, src, payload, now, key,
+        )
+
+    # --------------------------------------------------------------- restart
+
+    def on_restart(s: TpcState, nid, now, key):
+        state = s._replace(vote_mask=jnp.int32(0))
+        first = jnp.where(
+            nid == 0,
+            # fire soon: an open undecided tid_cur gets presumed-aborted
+            now + prng.randint(key, 35, 1_000, txn_gap_us),
+            now + jnp.where(unresolved_yes(s).any(), doubt_retry_us, IDLE_FAR),
+        )
+        return state, first
+
+    # ------------------------------------------------------------ invariants
+
+    def check_invariants(ns: TpcState, alive, now):
+        # ns leaves are [N, ...] for one lane. Every write lands in slot
+        # tid % TXN, so equal tids can only ever share a SLOT — the joins
+        # need only compare slot-aligned entries ([N,N,TXN] / [N,TXN]), not
+        # all TXN x TXN slot pairs. This runs in the jitted per-step loop.
+        ot, ov = ns.o_tid, ns.o_val  # [N, TXN]
+        # atomicity: same absolute tid recorded on two nodes => same outcome
+        same_tid = (ot[:, None, :] == ot[None, :, :]) & (ot[:, None, :] >= 0)
+        diff_out = ov[:, None, :] != ov[None, :, :]
+        atomicity = ~(same_tid & diff_out).any()
+        # vote respect: a node recording COMMIT for a tid it voted NO on
+        # (both rings slot the same tid identically)
+        joined = (
+            (ns.o_tid == ns.v_tid)
+            & (ns.o_tid >= 0)
+            & (ns.o_val == COMMIT)
+            & (ns.v_val == ABORT)
+        )
+        vote_respect = ~joined.any()
+        return atomicity & vote_respect
+
+    # ------------------------------------------------------------ diagnostics
+
+    def lane_metrics(node):
+        voted_yes = (node.v_tid >= 0) & (node.v_val == COMMIT)  # [L,N,TXN]
+        resolved = (
+            (node.v_tid[..., :, None] == node.o_tid[..., None, :])
+            & (node.o_tid[..., None, :] >= 0)
+        ).any(-1)
+        return {
+            "mean_decided_txns": node.decided[:, 0].astype(jnp.float32),
+            "in_doubt_lanes": (voted_yes[:, 1:] & ~resolved[:, 1:]).any((-2, -1)),
+        }
+
+    return ProtocolSpec(
+        name=f"twopc{N}",
+        n_nodes=N,
+        payload_width=PAYLOAD_WIDTH,
+        max_out=N,
+        max_out_msg=N,  # a VOTE receipt can broadcast the OUTCOME
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+        lane_metrics=lane_metrics,
+        msg_kind_names=("PREPARE", "VOTE", "OUTCOME", "DREQ"),
+    )

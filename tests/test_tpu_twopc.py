@@ -1,0 +1,106 @@
+"""Two-Phase Commit device fuzz (third ProtocolSpec; see tpu/twopc.py).
+
+Mirrors the reference test strategy (SURVEY.md §4): protocol safety as
+invariants over fuzzed executions, determinism as a tested property, and
+bug-detection validated by injecting the canonical wrong implementation.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu.tpu import BatchedSim, SimConfig, summarize
+from madsim_tpu.tpu import twopc as tpc
+from madsim_tpu.tpu.twopc import make_twopc_spec
+
+
+def full_chaos(**kw):
+    cfg = dict(
+        horizon_us=8_000_000,
+        msg_capacity=128,  # 2+ slots per origin region: zero overflow
+        loss_rate=0.1,
+        crash_interval_lo_us=400_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=1_000_000,
+        partition_interval_lo_us=400_000,
+        partition_interval_hi_us=1_500_000,
+        partition_heal_lo_us=300_000,
+        partition_heal_hi_us=1_200_000,
+    )
+    cfg.update(kw)
+    return SimConfig(**cfg)
+
+
+def test_twopc_safe_under_full_chaos():
+    """Atomicity + vote respect hold across loss, crashes (coordinator
+    included — the blocking case) and partitions, while real work happens
+    (transactions keep deciding)."""
+    sim = BatchedSim(make_twopc_spec(5), full_chaos())
+    state = sim.run(jnp.arange(512), max_steps=60_000)
+    s = summarize(state, sim.spec)
+    assert s["violations"] == 0
+    assert s["deadlocked"] == 0
+    assert s["total_overflow"] == 0  # nothing dropped outside loss_rate
+    assert s["mean_decided_txns"] > 20  # the fuzz isn't frozen
+
+
+def test_twopc_commits_and_aborts_both_happen():
+    """Both outcomes occur across the sweep (vote_yes_p < 1 plus chaos):
+    a fuzz that only ever aborts — or only ever commits — tests nothing."""
+    sim = BatchedSim(make_twopc_spec(5), full_chaos())
+    state = sim.run(jnp.arange(128), max_steps=40_000)
+    o_tid = np.asarray(state.node.o_tid)  # [L,N,TXN]
+    o_val = np.asarray(state.node.o_val)
+    commits = ((o_tid >= 0) & (o_val == tpc.COMMIT)).sum()
+    aborts = ((o_tid >= 0) & (o_val == tpc.ABORT)).sum()
+    assert commits > 100, int(commits)
+    assert aborts > 100, int(aborts)
+
+
+def test_twopc_determinism():
+    sim = BatchedSim(make_twopc_spec(5), full_chaos())
+    a = sim.run(jnp.arange(32), max_steps=30_000)
+    b = sim.run(jnp.arange(32), max_steps=30_000)
+    for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        assert (np.asarray(la) == np.asarray(lb)).all()
+
+
+def test_twopc_unilateral_abort_bug_caught():
+    """The canonical wrong 2PC implementation: an in-doubt participant
+    gets impatient and unilaterally aborts instead of running cooperative
+    termination. Under chaos the coordinator's COMMIT is delayed past the
+    participant's patience — one node aborts a committed transaction and
+    the atomicity invariant fires. The correct spec survives the same
+    configs (test_twopc_safe_under_full_chaos)."""
+    spec = make_twopc_spec(5)
+
+    def impatient_timer(s, nid, now, key):
+        from madsim_tpu.tpu import prng
+
+        state, out, timer = spec.on_timer(s, nid, now, key)
+        # the oldest unresolved yes-vote, straight from the vote ring
+        voted_yes = (s.v_tid >= 0) & (s.v_val == tpc.COMMIT)
+        resolved = (
+            (s.v_tid[:, None] == s.o_tid[None, :]) & (s.o_tid[None, :] >= 0)
+        ).any(-1)
+        doubt = voted_yes & ~resolved
+        tid = jnp.where(doubt, s.v_tid, jnp.int32(2**30)).min()
+        # participants: on a retry tick, flip a coin and give up — record
+        # a unilateral local ABORT for the in-doubt txn
+        give_up = (nid != 0) & doubt.any() & (prng.uniform(key, 77) < 0.5)
+        at = jnp.arange(s.o_tid.shape[0], dtype=jnp.int32) == (
+            tid % s.o_tid.shape[0]
+        )
+        state = state._replace(
+            o_tid=jnp.where(give_up & at, tid, state.o_tid),
+            o_val=jnp.where(give_up & at, tpc.ABORT, state.o_val),
+        )
+        return state, out, timer
+
+    buggy = dataclasses.replace(spec, on_timer=impatient_timer)
+    sim = BatchedSim(buggy, full_chaos())
+    state = sim.run(jnp.arange(256), max_steps=60_000)
+    assert summarize(state)["violations"] > 0

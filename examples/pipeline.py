@@ -1,0 +1,184 @@
+"""The ecosystem capstone: an exactly-once pipeline with leader failover.
+
+One simulated app wiring all four facades together — the kind of system
+the reference's users build on madsim (tonic-example writ large):
+
+    producer ──> kafka topic "events" ──> elected worker ──> s3 checkpoint
+                                       ▲
+                 etcd election decides WHICH worker consumes
+
+Two workers campaign for leadership through the etcd election client
+(lease-backed: a dead leader's lease expires and the standby takes over).
+The leader resumes from the last s3 checkpoint `(next_offset, running
+sum)`, consumes from kafka at that offset, and checkpoints atomically
+after every event (one `put_object`). Mid-run, chaos kills the current
+leader; the standby is elected, resumes from the checkpoint, and the
+final checkpoint must hold EXACTLY the sum of all produced events — no
+loss, no double-count — on every seed.
+
+Run one seed:  python examples/pipeline.py [seed]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import madsim_tpu as ms
+from madsim_tpu.sims import s3 as s3_mod
+from madsim_tpu.sims.s3 import NoSuchKey
+from madsim_tpu.sims.etcd import Client as EtcdClient, SimServer
+from madsim_tpu.sims.kafka import (
+    BaseRecord,
+    ClientConfig,
+    NewTopic,
+    SimBroker,
+    TopicPartitionList,
+)
+
+N_EVENTS = 40
+TOPIC, BUCKET, CKPT = "events", "pipeline", "ckpt/state"
+
+
+async def producer():
+    cfg = ClientConfig({"bootstrap.servers": "10.0.0.2:9092"})
+    await (await cfg.create_admin()).create_topics([NewTopic(TOPIC, 1)])
+    p = await cfg.create_producer()
+    for i in range(1, N_EVENTS + 1):
+        p.send(BaseRecord.to(TOPIC).with_payload(str(i).encode()))
+        await p.flush()
+        await ms.time.sleep(0.05 + ms.rand() * 0.1)
+
+
+async def worker(name: str, log: list):
+    """Campaign -> resume from checkpoint -> consume+checkpoint forever."""
+    etcd = await EtcdClient.connect("10.0.0.1:2379")
+    lease = await etcd.lease.grant(2)
+    keeper, _stream = await etcd.lease.keep_alive(lease.id)
+
+    async def keep():
+        while True:
+            await keeper.keep_alive()
+            await ms.time.sleep(0.5)
+
+    ms.spawn(keep())
+    await etcd.election.campaign("pipeline-leader", name, lease.id)
+    log.append(("leader", name))
+
+    s3 = await s3_mod.Client.connect("10.0.0.3:9000")
+    # ONLY a genuinely absent checkpoint starts from zero; a transient s3
+    # error must propagate (the node's init fn re-enters this worker), or a
+    # resumed leader would silently rewind to offset 0 and double-count —
+    # the exact bug class the atomic checkpoint exists to rule out
+    try:
+        offset, total = json.loads(await s3.get_object(BUCKET, CKPT))
+    except NoSuchKey:
+        offset, total = 0, 0
+
+    cfg = ClientConfig({"bootstrap.servers": "10.0.0.2:9092"})
+    consumer = await cfg.create_consumer()
+    tpl = TopicPartitionList()
+    tpl.add_partition_offset(TOPIC, 0, offset)
+    consumer.assign(tpl)
+
+    while True:
+        msg = await consumer.poll(timeout=1.0)
+        if msg is None:
+            continue
+        total += int(msg.payload)
+        offset = msg.offset + 1
+        # the atomic exactly-once step: one put carries both cursor and sum
+        await s3.put_object(BUCKET, CKPT, json.dumps([offset, total]).encode())
+        log.append(("processed", name, offset, total))
+
+
+async def run_pipeline(rt: ms.Runtime) -> dict:
+    h = rt.handle
+    h.create_node().name("etcd").ip("10.0.0.1").init(
+        lambda: SimServer.builder().serve("10.0.0.1:2379")
+    ).build()
+    h.create_node().name("kafka").ip("10.0.0.2").init(
+        lambda: SimBroker().serve("10.0.0.2:9092")
+    ).build()
+    h.create_node().name("s3").ip("10.0.0.3").init(
+        lambda: s3_mod.S3Server().serve("10.0.0.3:9000")
+    ).build()
+    await ms.time.sleep(1.0)
+
+    setup = h.create_node().name("setup").ip("10.0.0.9").build()
+
+    async def mkbucket():
+        s3c = await s3_mod.Client.connect("10.0.0.3:9000")
+        await s3c.create_bucket(BUCKET)
+
+    await setup.spawn(mkbucket())
+
+    log: list = []
+    prod = h.create_node().name("producer").ip("10.0.0.4").build()
+    prod.spawn(producer())
+
+    workers = {}
+    for i, name in enumerate(("worker-a", "worker-b")):
+        workers[name] = (
+            h.create_node().name(name).ip(f"10.0.0.1{i + 1}")
+            .init(lambda name=name: worker(name, log))
+            .build()
+        )
+
+    # chaos: ask the election itself who leads, kill that worker; its lease
+    # expires and the standby takes over from the s3 checkpoint. Restart
+    # the victim later (init fn re-enters worker()) so it becomes standby.
+    async def chaos():
+        etcd = await EtcdClient.connect("10.0.0.1:2379")
+        for _ in range(2):
+            await ms.time.sleep(1.0 + ms.rand() * 1.5)
+            resp = await etcd.election.leader("pipeline-leader")
+            if resp.kv is None:
+                continue  # mid-election; try again next round
+            victim = resp.kv.value.decode()
+            log.append(("kill", victim))
+            h.kill(workers[victim].id)
+            await ms.time.sleep(1.0 + ms.rand() * 1.0)
+            h.restart(workers[victim].id)
+
+    ms.spawn(chaos())
+
+    # wait until the checkpoint reaches the last event (bounded)
+    async def wait_done():
+        s3c = await s3_mod.Client.connect("10.0.0.3:9000")
+        while True:
+            await ms.time.sleep(0.5)
+            try:
+                offset, total = json.loads(await s3c.get_object(BUCKET, CKPT))
+            except Exception:
+                continue
+            if offset >= N_EVENTS:
+                return offset, total
+
+    offset, total = await ms.time.timeout(120.0, setup.spawn(wait_done()))
+    expected = N_EVENTS * (N_EVENTS + 1) // 2
+    leaders = [e[1] for e in log if e[0] == "leader"]
+    kills = [e[1] for e in log if e[0] == "kill"]
+    return {
+        "offset": offset,
+        "total": total,
+        "expected": expected,
+        "exactly_once": total == expected and offset == N_EVENTS,
+        "leaders": leaders,
+        "kills": kills,
+        "failovers": max(0, len(leaders) - 1),
+    }
+
+
+def main(seed: int) -> dict:
+    rt = ms.Runtime(seed=seed)
+    result = rt.block_on(run_pipeline(rt))
+    print(json.dumps(result))
+    assert result["exactly_once"], result
+    return result
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1)

@@ -1,4 +1,9 @@
 import os
+import sys
+
+# the package is used from a checkout, not an install: make the suite
+# runnable from any cwd by putting the repo root on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # TPU/sharding tests run on a virtual 8-device CPU mesh. Must be configured
 # before any jax import; the environment may pre-set JAX_PLATFORMS to a real

@@ -22,9 +22,10 @@ Run one seed:  python examples/pipeline.py [seed]
 from __future__ import annotations
 
 import json
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import madsim_tpu as ms
 from madsim_tpu.sims import s3 as s3_mod

@@ -11,5 +11,5 @@ from .engine import BatchedSim, MsgPool, SimState, TraceRecord, summarize  # noq
 from .kv import KvState, kv_workload, make_kv_spec  # noqa: F401
 from .raft import RaftState, make_raft_spec, raft_workload  # noqa: F401
 from .spec import INF_US, Outbox, ProtocolSpec, SimConfig, empty_outbox  # noqa: F401
-from .twopc import TpcState, make_twopc_spec  # noqa: F401
+from .twopc import TpcState, make_twopc_spec, twopc_workload  # noqa: F401
 from .trace import TraceEvent, extract_trace, format_trace, trace_seed  # noqa: F401

@@ -350,3 +350,37 @@ def make_twopc_spec(
         lane_metrics=lane_metrics,
         msg_kind_names=("PREPARE", "VOTE", "OUTCOME", "DREQ"),
     )
+
+
+def twopc_workload(
+    n_nodes: int = 5,
+    virtual_secs: float = 10.0,
+    loss_rate: float = 0.1,
+    spec: "ProtocolSpec | None" = None,
+):
+    """The 2PC atomicity fuzz as a BatchWorkload: full chaos battery —
+    loss, coordinator crashes (the blocking case) and partitions. No host
+    twin exists for this protocol, so violating seeds re-run on device
+    via the trace microscope (run_batch's max_traces path)."""
+    from .batch import BatchWorkload
+    from .spec import SimConfig
+
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        # engine regions: 128 // 50 candidate positions = 2 slots per
+        # origin region — measured zero overflow at this traffic shape
+        msg_capacity=128,
+        loss_rate=loss_rate,
+        crash_interval_lo_us=400_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=1_000_000,
+        partition_interval_lo_us=400_000,
+        partition_interval_hi_us=1_500_000,
+        partition_heal_lo_us=300_000,
+        partition_heal_hi_us=1_200_000,
+    )
+    return BatchWorkload(
+        spec=spec if spec is not None else make_twopc_spec(n_nodes),
+        config=cfg,
+    )

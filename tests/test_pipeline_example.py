@@ -2,16 +2,13 @@
 four facades in one app, exactly-once through leader failover, per-seed
 deterministic."""
 
-import os
-import sys
-
 import pytest
 
 import madsim_tpu as ms
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from examples.pipeline import run_pipeline  # noqa: E402
+# repo root is on sys.path via tests/conftest.py, which also resolves
+# the examples package
+from examples.pipeline import run_pipeline
 
 
 @pytest.mark.parametrize("seed", [1, 5])

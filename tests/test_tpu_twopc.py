@@ -104,3 +104,13 @@ def test_twopc_unilateral_abort_bug_caught():
     sim = BatchedSim(buggy, full_chaos())
     state = sim.run(jnp.arange(256), max_steps=60_000)
     assert summarize(state)["violations"] > 0
+
+
+def test_twopc_workload_run_batch_smoke():
+    """twopc_workload stays wired into run_batch (the kv_workload pattern):
+    a small sweep completes clean with nothing dropped outside loss_rate."""
+    from madsim_tpu.tpu import run_batch, twopc_workload
+
+    result = run_batch(range(32), twopc_workload(virtual_secs=3.0), max_traces=0)
+    assert result.violations == 0
+    assert result.summary["total_overflow"] == 0

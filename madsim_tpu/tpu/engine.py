@@ -582,9 +582,30 @@ class BatchedSim:
         final, _ = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
         return final
 
-    def run(self, seeds, max_steps: int = 100_000) -> SimState:
-        """Run lanes until every lane is done (or max_steps)."""
-        return self._run(self.init(seeds), max_steps)
+    def run(
+        self, seeds, max_steps: int = 100_000, dispatch_steps: int = 10_000
+    ) -> SimState:
+        """Run lanes until every lane is done (or max_steps).
+
+        The while_loop is dispatched in chunks of `dispatch_steps`: a long
+        horizon at high lane counts would otherwise be ONE device kernel
+        running for minutes, which remote-tunnel TPU runtimes have been
+        observed to kill (worker crash at ~70s on a 32k-lane, 24k-step
+        dispatch). Chunking bounds each kernel's runtime and lets the host
+        stop as soon as every lane is done, at the cost of one host sync
+        per chunk. At most two programs compile (chunk size + final tail).
+        """
+        if dispatch_steps <= 0:
+            raise ValueError(f"dispatch_steps must be positive, got {dispatch_steps}")
+        state = self.init(seeds)
+        remaining = max_steps
+        while remaining > 0:
+            n = min(dispatch_steps, remaining)
+            state = self._run(state, n)
+            remaining -= n
+            if remaining > 0 and bool(state.done.all()):
+                break
+        return state
 
     @functools.partial(jax.jit, static_argnums=(0, 2))
     def run_steps(self, state: SimState, n_steps: int) -> SimState:

@@ -1,0 +1,84 @@
+"""Micro-bench: per-node field extraction — one-hot contraction vs
+take_along_axis gather, at the dest-major pool shape [L, N, R(, P)].
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+L, N, R, P = 32768, 5, 64, 6
+
+
+def timeit(fn, *args, reps=50):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best.append((time.perf_counter() - t0) / reps)
+    return sorted(best)[1]
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+    pay = jax.random.randint(k, (L, N, R, P), 0, 1 << 20, dtype=jnp.int32)
+    kind = jax.random.randint(k, (L, N, R), 0, 5, dtype=jnp.int32)
+    slot = jax.random.randint(k, (L, N), 0, R, dtype=jnp.int32)
+
+    @jax.jit
+    def onehot(pay, kind, slot):
+        oh = (jnp.arange(R)[None, None, :] == slot[:, :, None]).astype(jnp.int32)
+        m_kind = (kind * oh).sum(-1)
+        m_pay = (pay * oh[:, :, :, None]).sum(2)
+        return m_kind, m_pay
+
+    @jax.jit
+    def gather(pay, kind, slot):
+        m_kind = jnp.take_along_axis(kind, slot[:, :, None], axis=2)[:, :, 0]
+        m_pay = jnp.take_along_axis(
+            pay, slot[:, :, None, None], axis=2
+        )[:, :, 0, :]
+        return m_kind, m_pay
+
+    t1 = timeit(onehot, pay, kind, slot)
+    t2 = timeit(gather, pay, kind, slot)
+    print(json.dumps({"onehot_ms": round(t1 * 1e3, 3),
+                      "gather_ms": round(t2 * 1e3, 3)}))
+
+    # min-reduce over R per (l, n): the pick phase at dest-major layout
+    deliver = jax.random.randint(k, (L, N, R), 0, 1 << 30, dtype=jnp.int32)
+    valid = jax.random.bernoulli(k, 0.3, (L, N, R))
+
+    @jax.jit
+    def pick(deliver, valid):
+        t = jnp.where(valid, deliver, jnp.int32(2**31 - 1))
+        tmin = t.min(-1)
+        slot = jnp.argmin(t, -1)
+        return tmin, slot
+
+    t3 = timeit(pick, deliver, valid)
+    print(json.dumps({"pick_ms": round(t3 * 1e3, 3)}))
+
+    # int64 variant of the same pick (the cost of widening deliver to i64)
+    deliver64 = deliver.astype(jnp.int64)
+
+    @jax.jit
+    def pick64(deliver, valid):
+        t = jnp.where(valid, deliver, jnp.int64(2**62))
+        tmin = t.min(-1)
+        slot = jnp.argmin(t, -1)
+        return tmin, slot
+
+    t4 = timeit(pick64, deliver64, valid)
+    print(json.dumps({"pick64_ms": round(t4 * 1e3, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Phase-level profile of the BatchedSim step on the current backend.
+
+Times the full jitted step at the bench config, then ablated variants
+(invariant check off, handlers off, network pack off) to attribute cost.
+Ablations are rough — XLA fuses across phases, so an "ablated" phase's
+cost includes whatever fusion it enabled — but they rank the suspects.
+
+Usage: python benches/profile_step.py [--lanes 32768] [--reps 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def timeit(fn, state, reps):
+    out = fn(state)
+    import jax
+
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(out) if isinstance(out, type(state)) else fn(state)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lanes", type=int, default=32768)
+    parser.add_argument("--reps", type=int, default=30)
+    parser.add_argument("--protocol", default="raft")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu import BatchedSim, SimConfig, make_raft_spec
+
+    if args.protocol == "raft":
+        spec = make_raft_spec(n_nodes=5, client_rate=0.1)
+    else:
+        from madsim_tpu.tpu.kv import make_kv_spec
+
+        spec = make_kv_spec(n_nodes=5)
+    cfg = SimConfig(
+        horizon_us=10_000_000,
+        msg_capacity=128,
+        loss_rate=0.10,
+        crash_interval_lo_us=500_000,
+        crash_interval_hi_us=3_000_000,
+        restart_delay_lo_us=300_000,
+        restart_delay_hi_us=2_000_000,
+        partition_interval_lo_us=300_000,
+        partition_interval_hi_us=1_500_000,
+        partition_heal_lo_us=500_000,
+        partition_heal_hi_us=2_000_000,
+    )
+    sim = BatchedSim(spec, cfg)
+    print(
+        f"C={sim._C} Km={sim._Km} Kt={sim._Kt} CK={sim._CK} B={sim._B} N={spec.n_nodes} "
+        f"P={spec.payload_width} lanes={args.lanes}",
+        flush=True,
+    )
+    state = sim.init(jnp.arange(args.lanes))
+    # warm the state into a realistic regime (pool part-full, roles mixed)
+    state = sim.run_steps(state, 200)
+    jax.block_until_ready(state)
+
+    step = jax.jit(sim._step)
+    full = timeit(step, state, args.reps)
+    print(json.dumps({"phase": "full_step", "ms": round(full * 1e3, 3)}), flush=True)
+
+    # cost analysis from XLA
+    lowered = jax.jit(sim._step).lower(state)
+    compiled = lowered.compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        print(
+            json.dumps(
+                {
+                    "flops": ca.get("flops"),
+                    "bytes_accessed": ca.get("bytes accessed"),
+                    "transcendentals": ca.get("transcendentals"),
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"cost_analysis unavailable: {e}", flush=True)
+
+    print(f"events/step estimate: run 1 step on warmed state", flush=True)
+    s2 = step(state)
+    ev = int(jax.device_get(s2.events.sum() - state.events.sum()))
+    print(
+        json.dumps(
+            {
+                "events_per_step_total": ev,
+                "events_per_step_per_lane": ev / args.lanes,
+                "us_per_step": round(full * 1e6, 1),
+                "events_per_sec": round(ev / full, 1),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

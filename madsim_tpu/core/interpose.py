@@ -18,15 +18,19 @@ dispatchers that consult the TLS simulation context:
 Installed lazily at first Runtime construction (install() is idempotent);
 uninstall() restores everything (used by tests).
 
-Known limitation vs the reference: `datetime.datetime.now()` reads the system
-clock in C without going through `time.time`, so it is NOT virtualized —
-use `time.time()` or madsim_tpu.time. (The reference covers this case only
-because libc interposition sits below everything.)
+`datetime.datetime.now/utcnow/today` and `datetime.date.today` read the
+system clock in C without going through `time.time`; they are virtualized
+by installing dispatching SUBCLASSES as the `datetime` module attributes
+(the reference covers this case because libc interposition sits below
+everything, time/system_time.rs:4-110). Residual hole, documented: a module
+that captured `from datetime import datetime` BEFORE install() keeps the
+unpatched class — install early (Runtime construction does).
 """
 
 from __future__ import annotations
 
 import asyncio
+import datetime as datetime_mod
 import os
 import random as random_mod
 import threading
@@ -71,6 +75,64 @@ def _patched_sleep(seconds):
         "time.sleep() blocks the real clock inside a simulation; "
         "use `await madsim_tpu.time.sleep(...)` instead"
     )
+
+
+# ----------------------------------------------------------------- datetime
+
+
+def _now_seconds() -> float:
+    """Virtual seconds inside a sim, real seconds outside."""
+    h = _handle()
+    if h is not None:
+        return h.time.now_time()
+    orig = _originals.get("time.time")
+    return orig() if orig is not None else time_mod.time()
+
+
+class _DateMeta(type(datetime_mod.date)):
+    """isinstance/issubclass see through the subclass install: a plain
+    datetime.date (e.g. parsed or constructed before install) must still
+    satisfy `isinstance(x, datetime.date)` when `datetime.date` is the
+    patched class — mirroring how the reference's interposition changes
+    behavior, never types."""
+
+    _base = datetime_mod.date
+
+    def __instancecheck__(cls, obj):
+        return isinstance(obj, cls._base)
+
+    def __subclasscheck__(cls, sub):
+        return issubclass(sub, cls._base)
+
+
+class _DatetimeMeta(_DateMeta):
+    _base = datetime_mod.datetime
+
+
+class _SimDate(datetime_mod.date, metaclass=_DateMeta):
+    """datetime.date with a virtual-clock `today()` (TLS dispatch)."""
+
+    @classmethod
+    def today(cls):
+        return cls.fromtimestamp(_now_seconds())
+
+
+class _SimDatetime(datetime_mod.datetime, metaclass=_DatetimeMeta):
+    """datetime.datetime with virtual-clock now/utcnow/today."""
+
+    @classmethod
+    def now(cls, tz=None):
+        return cls.fromtimestamp(_now_seconds(), tz)
+
+    @classmethod
+    def utcnow(cls):
+        return cls.fromtimestamp(
+            _now_seconds(), datetime_mod.timezone.utc
+        ).replace(tzinfo=None)
+
+    @classmethod
+    def today(cls):
+        return cls.fromtimestamp(_now_seconds())
 
 
 # ------------------------------------------------------------------- random
@@ -241,6 +303,13 @@ def install() -> None:
     _originals["asyncio.run"] = asyncio.run
     asyncio.run = _patched_asyncio_run
 
+    # datetime.now/utcnow/today + date.today read the clock in C below
+    # time.time; install dispatching subclasses as the module attributes
+    _originals["datetime.datetime"] = datetime_mod.datetime
+    datetime_mod.datetime = _SimDatetime
+    _originals["datetime.date"] = datetime_mod.date
+    datetime_mod.date = _SimDate
+
 
 def uninstall() -> None:
     """Restore every patched entry point."""
@@ -260,4 +329,6 @@ def uninstall() -> None:
             setattr(os, attr, orig)
         elif mod_name == "asyncio":
             setattr(asyncio, attr, orig)
+        elif mod_name == "datetime":
+            setattr(datetime_mod, attr, orig)
     _originals.clear()

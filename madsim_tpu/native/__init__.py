@@ -1,20 +1,79 @@
 """Optional C++ fast path for the host executor core.
 
-Build with `python setup_native.py build_ext --inplace`. The pure-Python
-implementations in core/ are the semantics reference; the native Rng, Timer
-and Queue are bit-compatible drop-ins (same xoshiro256++ stream, same
-Lemire bounded draw, same timer ordering) — verified by tests/test_native.py.
+The pure-Python implementations in core/ are the semantics reference; the
+native Rng, Timer and Queue are bit-compatible drop-ins (same xoshiro256++
+stream, same Lemire bounded draw, same timer ordering) — verified by
+tests/test_native.py.
+
+The extension BUILDS ITSELF on first import when a C++ toolchain exists
+(a few seconds, once — the .so lands next to this file), so a plain
+checkout gets the fast path without an install step; `pip install -e .`
+builds it via setup.py. Set MADSIM_NO_NATIVE_BUILD=1 to skip the attempt;
+any build failure falls back silently to pure Python (AVAILABLE == False).
 """
 
 from __future__ import annotations
 
+import os
+import pathlib
+import subprocess
+import sys
+
+
+def _try_build() -> None:
+    """Best-effort in-place build of _core (never raises)."""
+    if os.environ.get("MADSIM_NO_NATIVE_BUILD"):
+        return
+    pkg_dir = pathlib.Path(__file__).resolve().parent
+    repo = pkg_dir.parent.parent
+    setup_py = repo / "setup_native.py"
+    if not setup_py.exists():
+        return
+    lock = pkg_dir / ".build_lock"
+    try:
+        # a lock older than the build timeout is debris from a killed
+        # build; reclaim it rather than silently disabling the fast path
+        # forever
+        import time as _time
+
+        if lock.exists() and _time.time() - lock.stat().st_mtime > 300:
+            lock.unlink()
+    except OSError:
+        pass
+    try:
+        # crude cross-process guard: one builder, others fall back this run
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+    except OSError:
+        return
+    try:
+        subprocess.run(
+            [sys.executable, str(setup_py), "build_ext", "--inplace"],
+            cwd=repo, capture_output=True, timeout=300, check=False,
+        )
+    except Exception:  # noqa: BLE001 - fallback path must never raise
+        pass
+    finally:
+        try:
+            lock.unlink()
+        except OSError:
+            pass
+
+
 try:
     from . import _core  # type: ignore[attr-defined]
+except ImportError:
+    _try_build()
+    try:
+        from . import _core  # type: ignore[attr-defined]
+    except ImportError:  # no toolchain / build failed: pure-Python fallback
+        _core = None  # type: ignore[assignment]
 
+if _core is not None:
     Rng = _core.Rng
     Timer = _core.Timer
     Queue = _core.Queue
     AVAILABLE = True
-except ImportError:  # extension not built: pure-Python fallback is used
+else:
     Rng = Timer = Queue = None  # type: ignore[assignment]
     AVAILABLE = False

@@ -7,9 +7,25 @@ from .batch import (  # noqa: F401
     batch_test,
     run_batch,
 )
-from .engine import BatchedSim, MsgPool, SimState, TraceRecord, summarize  # noqa: F401
+from .engine import (  # noqa: F401
+    BatchedSim,
+    MsgPool,
+    SimState,
+    StragPool,
+    TraceRecord,
+    abs_time_us,
+    summarize,
+)
 from .kv import KvState, kv_workload, make_kv_spec  # noqa: F401
 from .raft import RaftState, make_raft_spec, raft_workload  # noqa: F401
-from .spec import INF_US, Outbox, ProtocolSpec, SimConfig, empty_outbox  # noqa: F401
+from .spec import (  # noqa: F401
+    INF_GUARD,
+    INF_US,
+    Outbox,
+    ProtocolSpec,
+    REBASE_US,
+    SimConfig,
+    empty_outbox,
+)
 from .twopc import TpcState, make_twopc_spec, twopc_workload  # noqa: F401
 from .trace import TraceEvent, extract_trace, format_trace, trace_seed  # noqa: F401

@@ -35,6 +35,7 @@ import inspect
 import os
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 from .engine import BatchedSim, SimState, summarize
@@ -58,6 +59,14 @@ class BatchWorkload:
     config: Optional[SimConfig] = None
     host_repro: Optional[Callable[[int], Any]] = None
     max_steps: int = 100_000
+    # optional deep oracle over recorded per-lane histories, run host-side
+    # by run_batch on every violating lane PLUS a sampled clean subset
+    # (cheap device invariants are the wide net; this is the exact check —
+    # e.g. kv_workload wires per-key Wing-Gong linearizability here).
+    # Signature: lane_check(final_chunk_state, lane_indices) -> dict with
+    # integer counters (merged across chunks) incl. a "violations" count.
+    lane_check: Optional[Callable[[Any, Sequence[int]], dict]] = None
+    lane_check_sample: int = 8
 
 
 class BatchViolation(AssertionError):
@@ -104,6 +113,28 @@ class BatchResult:
             )
 
 
+def resolve_mesh(mesh) -> Optional[Any]:
+    """Resolve run_batch's mesh argument.
+
+    "auto" (the default) builds a 1-D lane mesh over EVERY visible device —
+    the reference's execution model uses all available parallel hardware
+    for a seed sweep (one OS thread per seed, `jobs` concurrent,
+    runtime/builder.rs:118-136); a user with a v5e-8 gets all 8 chips
+    without hand-sharding. None (or a single device) runs unsharded; a
+    jax.sharding.Mesh is used as-is (first axis = lanes).
+    """
+    if mesh is None:
+        return None
+    if mesh == "auto":
+        import jax
+
+        devices = jax.devices()
+        if len(devices) <= 1:
+            return None
+        return jax.sharding.Mesh(np.array(devices), ("seeds",))
+    return mesh
+
+
 def run_batch(
     seeds: Sequence[int],
     workload: BatchWorkload,
@@ -111,17 +142,24 @@ def run_batch(
     max_host_repros: int = 4,
     chunk: int = DEFAULT_CHUNK,
     max_traces: int = 2,
+    mesh: Any = "auto",
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
 
-    The TPU pass is the seed sweep (runtime/builder.rs:110-148 made wide);
-    the host pass is the repro DX (builder.rs prints the failing seed — here
-    the failing seed is actually *re-executed* on the debuggable runtime).
+    The TPU pass is the seed sweep (runtime/builder.rs:110-148 made wide)
+    over ALL visible devices by default (see `resolve_mesh`); the host pass
+    is the repro DX (builder.rs prints the failing seed — here the failing
+    seed is actually *re-executed* on the debuggable runtime). Per-seed
+    results are bit-identical whatever the mesh: no engine draw folds the
+    lane index, so a trajectory never depends on which device (or batch
+    position) its lane landed on.
     """
     seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
     if seeds_arr.ndim != 1 or seeds_arr.size == 0:
         raise ValueError("seeds must be a non-empty 1-D sequence")
     sim = BatchedSim(workload.spec, workload.config)
+    mesh = resolve_mesh(mesh)
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
 
     violated_parts: List[np.ndarray] = []
     deadlocked_parts: List[np.ndarray] = []
@@ -130,10 +168,28 @@ def run_batch(
     weights: Dict[str, int] = {}
     for off in range(0, seeds_arr.size, chunk):
         part = seeds_arr[off : off + chunk]
-        state = sim.run(part, max_steps=workload.max_steps)
+        pad = (-part.size) % n_dev
+        if pad:
+            # pad to a device multiple with repeats of the first seed; the
+            # padded lanes run normally and are stripped before reporting
+            part_in = np.concatenate([part, np.repeat(part[:1], pad)])
+        else:
+            part_in = part
+        state = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+        if pad:
+            state = jax.tree_util.tree_map(lambda x: x[: part.size], state)
         violated_parts.append(np.asarray(state.violated))
         deadlocked_parts.append(np.asarray(state.deadlocked))
         s = summarize(state, workload.spec)
+        if workload.lane_check is not None:
+            # deep host-side oracle: every violating lane + a clean sample
+            v = np.nonzero(violated_parts[-1])[0]
+            clean = np.nonzero(~violated_parts[-1])[0][: workload.lane_check_sample]
+            picked = np.concatenate([v, clean])
+            if picked.size:
+                for k2, v2 in workload.lane_check(state, picked).items():
+                    if isinstance(v2, (int, np.integer)):
+                        s["lane_check_" + k2] = int(v2)
         for k, v in s.items():
             if not isinstance(v, (int, float)):
                 continue
@@ -151,6 +207,7 @@ def run_batch(
     # GLOBAL violation lane indices (summarize's are chunk-local; correlating
     # those against the global seeds array mislabels lanes on chunked runs)
     totals["violation_lanes"] = np.nonzero(violated)[0].tolist()[:32]
+    totals["n_devices"] = n_dev
     result = BatchResult(
         seeds=seeds_arr,
         violated=violated,

@@ -5,12 +5,13 @@ network (SURVEY.md §3.1-3.2, §7): instead of one OS thread per seed
 (runtime/builder.rs:118-136), the whole discrete-event loop is a single jitted
 step function over lane-major state tensors:
 
-    clock        [L]        virtual time per lane (int32 microseconds)
+    clock        [L]        virtual time per lane (int32 us OFFSET)
+    epoch        [L]        rebase count: abs time = epoch * REBASE_US + off
     key          [L]        per-lane hash-chain PRNG word (see prng.py)
     alive        [L, N]     node liveness (crash/restart chaos)
     timer        [L, N]     per-node timer deadline
     node state   [L, N, ...]protocol pytree
-    message pool [L, S]     in-flight messages with deliver times
+    message pool [L, N, CK] validity bits + [L, CK] per-candidate ring
 
 One step = (1) advance each lane to its next event WINDOW — the conservative
 parallel-DES lookahead [t_next, t_next + latency_lo): messages emitted inside
@@ -19,26 +20,50 @@ causally independent, (2) per node, pick its earliest in-window event —
 message delivery or timer fire, never both (per-node order is exact) — and
 run `on_message`/`on_timer` with the node's own event time, (3) run
 crash/restart + partition chaos (the window collapses to the exact chaos
-instant on those steps), (4) roll loss + latency for every emitted message
-(the `test_link` analog, net/network.rs:261-269), stamped from the emitting
-node's event time, and pack survivors into free pool slots, (5) check
-invariants. Everything is vmapped over lanes and vectorized over nodes; the
-step cost is N-wide regardless of how many nodes have due events, so the
-lookahead window turns idle handler lanes into processed events for free.
+instant on those steps), (4) roll loss + latency (+ the heavy-tail buggify
+coin) for every emitted message (the `test_link` analog,
+net/network.rs:261-269), stamped from the emitting node's event time, and
+pack survivors into free pool slots, (5) check invariants, (6) rebase lanes
+whose clock offset crossed REBASE_US (unbounded virtual time with int32
+hot-path arithmetic; see spec.REBASE_US).
+
+Pool layout (the round-4 redesign, iterated under measurement): a message's
+(deliver time, kind, payload) lives ONCE in a per-candidate ring slot
+(`[L, CK]`, CK = send positions x depth; see MsgPool), and only a validity
+bit is kept per destination (`[L, N, CK]`). Consequences:
+  * the DELIVERY side needs no destination matching at all — node n's
+    pending set is the static slice `valid[:, n, :]` over the shared ring,
+    and its earliest event is a plain min-reduce (the r3 layout's `[L,S,N]`
+    one-hot expansions and `[L,N,S,P]` payload contraction, measured as the
+    dominant step cost, are gone);
+  * the PACK side is pure elementwise writes: ring slot k = seq_c mod K
+    (rotation aligned across destinations), dst routing via a tiny
+    `[L,C,N]` one-hot; a send whose ring slot is still pending anywhere is
+    dropped and counted (`overflow`) rather than corrupted;
+  * the message's source is a compile-time constant per slot
+    (`src_of_slot`), and pool bandwidth — the pool is rewritten every step,
+    so its bytes are a top step cost — is ~N x smaller than materializing
+    per-destination copies.
+
+Heavy-tail (buggify) delays ride a small side pool with one region per
+candidate position (`[L, C, K4]`): tail messages are rare, so the side
+pool's dst-matching one-hots stay tiny while the main pool keeps its
+latency bound (which is also the lookahead bound).
 
 Lanes are embarrassingly parallel, so the lane axis shards cleanly over a
-device mesh (`shard_state`); the node axis can additionally be sharded for
-large clusters, with XLA inserting collectives for the pool<->node gathers.
+device mesh (`shard_state`); the node axis (dim 1 of every per-node tensor,
+including the pool) can additionally be sharded for large clusters.
 
 Determinism: jitted XLA programs are deterministic, and all randomness comes
-from the per-lane threefry keys derived from the seed — one seed => one
+from the per-lane hash-chain keys derived from the seed — one seed => one
 bit-exact trajectory per backend (the per-backend determinism contract of
-SURVEY.md §7 step 1).
+SURVEY.md §7 step 1). Lane-position independence: no draw ever folds the
+lane INDEX, only the lane SEED, so a seed's trajectory is identical in any
+batch, any chunk, any mesh sharding.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from typing import Any, NamedTuple, Optional, Tuple
 
@@ -46,16 +71,41 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import INF_US, Outbox, ProtocolSpec, SimConfig
+from .spec import INF_GUARD, INF_US, Outbox, ProtocolSpec, REBASE_US, SimConfig
 
 
 class MsgPool(NamedTuple):
-    valid: Any  # bool [L,S]
-    deliver: Any  # i32 [L,S]
-    src: Any  # i32 [L,S]
-    dst: Any  # i32 [L,S]
-    kind: Any  # i32 [L,S]
-    payload: Any  # i32 [L,S,P]
+    """In-flight messages: per-destination validity + per-candidate ring.
+
+    A send event from candidate position c (static source node) broadcasts
+    ONE (deliver time, kind, payload) to up to N destinations — the network
+    rolls loss per destination but latency per candidate — so those fields
+    live once in a per-candidate ring slot (c, k), k = seq_c mod K, and only
+    the validity bit is per destination. The destination slot (n, c, k)
+    references ring slot (c, k) BY POSITION: rotation is aligned across
+    destinations, and a candidate whose next ring slot is still pending at
+    any destination drops the new send (counted in `overflow`) rather than
+    corrupt it. This keeps pool bandwidth ~N x smaller than materializing
+    per-destination copies — the pool is rewritten every step, so its bytes
+    are a top step cost.
+    """
+
+    valid: Any  # bool [L,N,CK]  (CK = C * K ring slots)
+    deliver: Any  # i32 [L,CK] (offset us)
+    kind: Any  # i32 [L,CK]
+    payload: Any  # i32 [L,CK,P]
+    seq: Any  # i32 [L,C] per-candidate send counter (ring rotation)
+
+
+class StragPool(NamedTuple):
+    """Heavy-tail straggler side pool: one region of K4 slots per candidate
+    position ([L, C, K4] flattened to [L, B]); dst is dynamic (stored)."""
+
+    valid: Any  # bool [L,B]
+    deliver: Any  # i32 [L,B]
+    dst: Any  # i32 [L,B]
+    kind: Any  # i32 [L,B]
+    payload: Any  # i32 [L,B,P]
 
 
 class TraceRecord(NamedTuple):
@@ -66,10 +116,12 @@ class TraceRecord(NamedTuple):
     record stream: re-running one violating seed through the SAME jitted
     step function yields every delivery, timer fire, crash/restart and
     partition event with virtual timestamps — debuggable without the host
-    twin. All leaves are [L, ...]; tracing runs use L=1.
+    twin. All leaves are [L, ...]; tracing runs use L=1. Times are offsets;
+    absolute = epoch * REBASE_US + offset (trace.extract_trace combines).
     """
 
     clock: Any  # i32 [L]
+    epoch: Any  # i32 [L]
     t_evt: Any  # i32 [L,N] virtual time of node n's event this step
     msg_fired: Any  # bool [L,N] message delivered to node n this step
     msg_src: Any  # i32 [L,N]
@@ -86,11 +138,13 @@ class TraceRecord(NamedTuple):
 
 
 class SimState(NamedTuple):
-    clock: Any  # i32 [L]
+    clock: Any  # i32 [L] (offset us; see epoch)
+    epoch: Any  # i32 [L] rebase count (abs = epoch * REBASE_US + clock)
     key: Any  # u32 [L] (hash-chain, prng.py)
     done: Any  # bool [L]
     violated: Any  # bool [L]
-    violation_at: Any  # i32 [L]
+    violation_at: Any  # i32 [L] (offset; INF_US = none)
+    violation_epoch: Any  # i32 [L]
     deadlocked: Any  # bool [L]
     steps: Any  # i32 [L]
     events: Any  # i32 [L]
@@ -104,6 +158,23 @@ class SimState(NamedTuple):
     timer: Any  # i32 [L,N]
     node: Any  # protocol pytree, leaves [L,N,...]
     msgs: MsgPool
+    strag: Any  # StragPool | None (None unless buggify_delay_rate > 0)
+
+
+def _first_free(free: jnp.ndarray, K: int) -> jnp.ndarray:
+    """First-free-slot mask along the last axis (length K, static).
+
+    Unrolled prefix: K is tiny, and cumsum is a scan op that breaks XLA's
+    elementwise fusion.
+    """
+    if K == 1:
+        return free
+    prev = jnp.zeros_like(free[..., 0])
+    cols = []
+    for k in range(K):
+        cols.append(free[..., k] & ~prev)
+        prev = prev | free[..., k]
+    return jnp.stack(cols, axis=-1)
 
 
 def _tree_where(mask: jnp.ndarray, a: Any, b: Any) -> Any:
@@ -122,28 +193,61 @@ class BatchedSim:
     def __init__(self, spec: ProtocolSpec, config: Optional[SimConfig] = None) -> None:
         self.spec = spec
         self.config = config or SimConfig()
+        cfg = self.config
         N = spec.n_nodes
-        # Message-pool layout: per-origin ring regions. Each of the
-        # C = N*max_out_msg + N*max_out candidate positions owns K consecutive
-        # slots, so packing a new message is a pure elementwise write into the
-        # first free slot of its region — no rank-matching one-hot products
-        # (the old pack built a [L,C,S] one-hot and a [L,C,S,P] contraction;
-        # at L=16k that was ~220M MACs/step and dominated the step cost).
-        # K is derived from msg_capacity: the budget is spread over regions.
-        self._C = N * spec.max_out_msg + N * spec.max_out
-        self._K = max(1, self.config.msg_capacity // self._C)
-        self._S = self._C * self._K
-        # source node of each candidate position (static: flat() reshapes
-        # [L,N,e] row-major, so position c within each block maps to node
-        # c // e) — used for send-time link tests
         import numpy as _np
 
+        # Candidate positions: the fixed send sites of one step — each node's
+        # max_out_msg on_message slots then its max_out on_timer slots, in
+        # flat() order. Position c's source node is a compile-time constant.
+        self._C = N * spec.max_out_msg + N * spec.max_out
         self._src_of_c = _np.concatenate(
             [
                 _np.arange(N * spec.max_out_msg) // spec.max_out_msg,
                 _np.arange(N * spec.max_out) // spec.max_out,
             ]
         )
+        # Main pool: candidate position c owns K consecutive ring slots;
+        # msg_capacity is the TOTAL ring-slot budget per lane (C * K ~
+        # msg_capacity, the r3 semantics — per-destination state is just
+        # validity bits over the shared ring, so it doesn't divide the
+        # budget). Handler-reply and timer-broadcast positions can get
+        # separate depths — see SimConfig.
+        uniform = max(1, cfg.msg_capacity // self._C)
+        self._Km = cfg.msg_depth_msg or uniform
+        self._Kt = cfg.msg_depth_timer or uniform
+        self._Cm = N * spec.max_out_msg
+        self._Ct = N * spec.max_out
+        self._Sm = self._Cm * self._Km  # slots of the message-position segment
+        self._CK = self._Sm + self._Ct * self._Kt
+        self._src_of_slot = jnp.asarray(
+            _np.concatenate([
+                _np.repeat(self._src_of_c[: self._Cm], self._Km),
+                _np.repeat(self._src_of_c[self._Cm :], self._Kt),
+            ]),
+            jnp.int32,
+        )  # [CK]
+        # pack segments: (cand lo, cand hi, depth, slot lo, slot hi). Equal
+        # depths collapse to ONE segment: the per-segment path concatenates
+        # full pool-sized parts (extra HBM copies), so the uniform case must
+        # not pay for the split.
+        if self._Km == self._Kt:
+            self._segs = ((0, self._C, self._Km, 0, self._CK),)
+        else:
+            self._segs = (
+                (0, self._Cm, self._Km, 0, self._Sm),
+                (self._Cm, self._C, self._Kt, self._Sm, self._CK),
+            )
+        # Straggler side pool (only when the heavy tail is on)
+        if cfg.buggify_delay_rate > 0:
+            self._K4 = max(1, cfg.buggify_depth)
+            self._B = self._C * self._K4
+            self._src_of_b = jnp.asarray(
+                _np.repeat(self._src_of_c, self._K4), jnp.int32
+            )  # [B]
+        else:
+            self._K4 = 0
+            self._B = 0
         # scalar-style handlers -> [L,N] batched. `now` is per-(lane,node):
         # under the lookahead window, nodes in one step process events at
         # different virtual times.
@@ -168,7 +272,7 @@ class BatchedSim:
         """Build lane state for a batch of seeds (int array [L])."""
         spec, cfg = self.spec, self.config
         seeds = jnp.asarray(seeds, jnp.uint32)
-        L, N, S = seeds.shape[0], spec.n_nodes, self._S
+        L, N, CK = seeds.shape[0], spec.n_nodes, self._CK
 
         key = prng.key_from(seeds)  # u32 [L]
         node_keys = prng.fold(key[:, None], jnp.arange(N, dtype=jnp.uint32))
@@ -187,12 +291,25 @@ class BatchedSim:
         else:
             part_at = jnp.full((L,), INF_US, jnp.int32)
 
+        if self._B:
+            strag = StragPool(
+                valid=jnp.zeros((L, self._B), jnp.bool_),
+                deliver=jnp.full((L, self._B), INF_US, jnp.int32),
+                dst=jnp.zeros((L, self._B), jnp.int32),
+                kind=jnp.zeros((L, self._B), jnp.int32),
+                payload=jnp.zeros((L, self._B, spec.payload_width), jnp.int32),
+            )
+        else:
+            strag = None
+
         return SimState(
             clock=jnp.zeros((L,), jnp.int32),
+            epoch=jnp.zeros((L,), jnp.int32),
             key=key,
             done=jnp.zeros((L,), jnp.bool_),
             violated=jnp.zeros((L,), jnp.bool_),
             violation_at=jnp.full((L,), INF_US, jnp.int32),
+            violation_epoch=jnp.zeros((L,), jnp.int32),
             deadlocked=jnp.zeros((L,), jnp.bool_),
             steps=jnp.zeros((L,), jnp.int32),
             events=jnp.zeros((L,), jnp.int32),
@@ -206,13 +323,13 @@ class BatchedSim:
             timer=jnp.asarray(timer, jnp.int32),
             node=node_state,
             msgs=MsgPool(
-                valid=jnp.zeros((L, S), jnp.bool_),
-                deliver=jnp.full((L, S), INF_US, jnp.int32),
-                src=jnp.zeros((L, S), jnp.int32),
-                dst=jnp.zeros((L, S), jnp.int32),
-                kind=jnp.zeros((L, S), jnp.int32),
-                payload=jnp.zeros((L, S, spec.payload_width), jnp.int32),
+                valid=jnp.zeros((L, N, CK), jnp.bool_),
+                deliver=jnp.full((L, CK), INF_US, jnp.int32),
+                kind=jnp.zeros((L, CK), jnp.int32),
+                payload=jnp.zeros((L, CK, spec.payload_width), jnp.int32),
+                seq=jnp.zeros((L, self._C), jnp.int32),
             ),
+            strag=strag,
         )
 
     # ------------------------------------------------------------------ step
@@ -226,24 +343,25 @@ class BatchedSim:
         Untraced callers discard the record; XLA dead-code-eliminates its
         construction, so the trace costs nothing unless collected."""
         spec, cfg = self.spec, self.config
-        N, S, E, P = spec.n_nodes, self._S, spec.max_out, spec.payload_width
+        N, CK, P = spec.n_nodes, self._CK, spec.payload_width
         L = state.clock.shape[0]
         msgs = state.msgs
+        strag: Optional[StragPool] = state.strag
+        narange = jnp.arange(N, dtype=jnp.int32)
 
         # -- 1. advance each lane to its next event window -----------------
-        # (the advance_to_next_event analog, time/mod.rs:45-60, batched)
-        # NOTE on style: this step avoids gather/scatter ops in favor of
-        # one-hot multiply-reduce — XLA lowers small-domain gathers to slow
-        # serial kernels on TPU, while one-hot forms fuse into fast VPU loops
-        # (measured ~20x difference on this step).
-        dst_oh = msgs.dst[:, :, None] == jnp.arange(N)[None, None, :]  # [L,S,N]
-        alive_dst = (dst_oh & state.alive[:, None, :]).any(-1)  # [L,S]
-        live_msg = msgs.valid & alive_dst
-        # per-(lane,node) pending message times (alive is already folded in:
-        # live_msg requires the destination alive, and dst_oh pins n == dst)
-        pend_ln = live_msg[:, None, :] & dst_oh.transpose(0, 2, 1)  # [L,N,S]
-        t_ln = jnp.where(pend_ln, msgs.deliver[:, None, :], INF_US)
-        tmsg_n = t_ln.min(axis=2)  # [L,N] earliest pending message per node
+        # (the advance_to_next_event analog, time/mod.rs:45-60, batched).
+        # Node n's pending messages are the static slice msgs.valid[:, n, :]
+        # over the shared ring — no destination matching (see MsgPool).
+        t_pend = jnp.where(msgs.valid, msgs.deliver[:, None, :], INF_US)  # [L,N,CK]
+        tmsg_n = t_pend.min(axis=2)  # [L,N]
+        if self._B:
+            sd_oh = strag.dst[:, :, None] == narange[None, None, :]  # [L,B,N]
+            ts_b = jnp.where(strag.valid, strag.deliver, INF_US)  # [L,B]
+            t_sn = jnp.where(sd_oh, ts_b[:, :, None], INF_US)  # [L,B,N]
+            tmsg_strag = t_sn.min(axis=1)  # [L,N]
+            tmsg_n = jnp.minimum(tmsg_n, tmsg_strag)
+        tmsg_n = jnp.where(state.alive, tmsg_n, INF_US)
         ttmr_n = jnp.where(state.alive, state.timer, INF_US)  # [L,N]
         t_next = jnp.minimum(
             jnp.minimum(jnp.minimum(tmsg_n.min(axis=1), ttmr_n.min(axis=1)),
@@ -262,7 +380,8 @@ class BatchedSim:
         # Whenever the next crash/partition instant falls anywhere inside
         # the window, the window shrinks to the exact instant t_next (the
         # chaos itself fires only once it IS t_next), so chaos state never
-        # applies to sends from earlier virtual times.
+        # applies to sends from earlier virtual times. The buggify tail only
+        # LENGTHENS latencies, so latency_lo remains the lookahead bound.
         lo_w = max(0, cfg.latency_lo_us - 1) if cfg.lookahead else 0
         w_end = jnp.minimum(t_next, INF_US - lo_w - 1) + lo_w
         if lo_w and (cfg.chaos_enabled or cfg.partition_enabled):
@@ -299,52 +418,118 @@ class BatchedSim:
         # per-node event time; inactive nodes default to the window start
         t_evt = jnp.where(has_msg, tmsg_n, jnp.where(due_t, ttmr_n, t_next[:, None]))
 
-        # slot choice: among this node's earliest-time pending slots
-        head_ln = pend_ln & (t_ln == tmsg_n[:, :, None])  # [L,N,S]
+        # main-pool slot choice: among this node's earliest-time slots
+        head = msgs.valid & (t_pend == tmsg_n[:, :, None])  # [L,N,CK]
         if cfg.sched_randomize:
             # random tie-break among equal-timestamp due messages — the
             # scheduling-nondeterminism amplifier (utils/mpsc.rs:71-84):
             # seeds that share a chaos schedule still explore different
             # delivery orders, the reference's biggest bug-finding lever
+            slot_idx = jnp.arange(N * CK, dtype=jnp.uint32).reshape(N, CK)
             prio = prng.bits(
-                prng.fold(key, 107)[:, None], 1,
-                index=jnp.arange(S, dtype=jnp.uint32)[None, :],
-            )  # u32 [L,S]
-            prio_ln = jnp.where(head_ln, prio[:, None, :], jnp.uint32(0xFFFFFFFF))
-            slot = jnp.argmin(prio_ln, axis=2)  # [L,N]
+                prng.fold(key, 107)[:, None, None], 1, index=slot_idx[None]
+            )  # u32 [L,N,CK]
+            prio_m = jnp.where(head, prio, jnp.uint32(0xFFFFFFFF))
+            slot = jnp.argmin(prio_m, axis=2)  # [L,N]
         else:
-            slot = jnp.argmin(
-                jnp.where(head_ln, t_ln, INF_US), axis=2
-            )  # [L,N] first earliest slot
-        slot_oh = (
-            head_ln
-            & (jnp.arange(S)[None, None, :] == slot[:, :, None])
-            & has_msg[:, :, None]
-        )
+            slot = jnp.argmin(jnp.where(head, t_pend, INF_US), axis=2)  # [L,N]
 
-        slot_ohi = slot_oh.astype(jnp.int32)
-        m_src = (msgs.src[:, None, :] * slot_ohi).sum(-1)
-        m_kind = (msgs.kind[:, None, :] * slot_ohi).sum(-1)
-        m_pay = (msgs.payload[:, None, :, :] * slot_ohi[:, :, :, None]).sum(2)
-        node_ids = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (L, N))
+        # straggler beats the main pool only with a strictly earlier time
+        # (same-instant cross-pool ties go to the main pool; tail events are
+        # rare enough that the ordering bias is negligible)
+        if self._B:
+            strag_win = has_msg & (tmsg_strag < t_pend.min(axis=2))
+            s_head = jnp.where(
+                t_sn == tmsg_strag[:, None, :], ts_b[:, :, None], INF_US
+            )  # [L,B,N]
+            s_slot = jnp.argmin(
+                jnp.where(t_sn == tmsg_strag[:, None, :], t_sn, INF_US), axis=1
+            )  # [L,N]
+            del s_head
+        else:
+            strag_win = jnp.zeros((L, N), jnp.bool_)
 
-        # -- 4. run handlers (at most one event per node => masks are
-        # disjoint, so both handlers read state.node and XLA may overlap them)
+        # field extraction via one-hot multiply-reduce over the node's OWN
+        # slot region [L,N,CK] — small because the pool is dest-major.
+        # (NOT gathers: take_along_axis here measured ~8x slower end-to-end
+        # on TPU v5e — XLA lowers batched small-domain gathers poorly, while
+        # the one-hot form fuses into the surrounding elementwise work.)
+        pick_oh = jnp.arange(CK)[None, None, :] == slot[:, :, None]  # [L,N,CK]
+        pick_ohi = pick_oh.astype(jnp.int32)
+        m_src = (self._src_of_slot[None, None, :] * pick_ohi).sum(2)
+        m_kind = (msgs.kind[:, None, :] * pick_ohi).sum(2)
+        m_pay = (msgs.payload[:, None, :, :] * pick_ohi[:, :, :, None]).sum(2)
+        if self._B:
+            s_pick = (
+                jnp.arange(self._B)[None, None, :] == s_slot[:, :, None]
+            ).astype(jnp.int32)  # [L,N,B]
+            sm_src = (self._src_of_b[None, None, :] * s_pick).sum(2)
+            sm_kind = (strag.kind[:, None, :] * s_pick).sum(2)
+            sm_pay = (strag.payload[:, None, :, :] * s_pick[:, :, :, None]).sum(2)
+            m_src = jnp.where(strag_win, sm_src, m_src)
+            m_kind = jnp.where(strag_win, sm_kind, m_kind)
+            m_pay = jnp.where(strag_win[:, :, None], sm_pay, m_pay)
+        node_ids = jnp.broadcast_to(narange, (L, N))
+
+        # -- 4. run handlers + fused state select. The three masks are
+        # pairwise DISJOINT: at most one event per node per step (msg vs
+        # timer), and a restarting node was dead all step (dead nodes'
+        # queues and timers are masked out of the event pick), so its event
+        # masks are false. One tree pass merges all three outcomes instead
+        # of three full-state passes.
+        if cfg.chaos_enabled:
+            chaos_due = active & (state.chaos_at <= t_next)
+            is_restart_evt = state.crashed >= 0
+            do_crash = chaos_due & ~is_restart_evt
+            do_restart = chaos_due & is_restart_evt
+            victim = prng.randint(ckey, 1, 0, N)
+            crash_mask = do_crash[:, None] & (node_ids == victim[:, None])
+            restart_node = jnp.clip(state.crashed, 0, N - 1)
+            restart_mask = do_restart[:, None] & (node_ids == restart_node[:, None])
+        else:
+            restart_mask = None
+
         ns_m, out_m, timer_m = self._v_on_message(
             state.node, node_ids, m_src, m_kind, m_pay, t_evt, mkeys
         )
         ns_t, out_t, timer_t = self._v_on_timer(state.node, node_ids, t_evt, tkeys)
-        node = _tree_where(has_msg, ns_m, state.node)
-        node = _tree_where(due_t, ns_t, node)
+        if cfg.chaos_enabled:
+            # `now` for a restarting node is the chaos instant t_next (the
+            # window collapses to it on chaos steps), never an earlier
+            # clock — a restart timer must not be armed in the past
+            ns_r, timer_r = self._v_on_restart(
+                state.node, node_ids, t_next, rkeys
+            )
+
+        def merge(old, m, t, r):
+            mk = has_msg.reshape(has_msg.shape + (1,) * (old.ndim - 2))
+            tk = due_t.reshape(mk.shape)
+            out = jnp.where(tk, t, jnp.where(mk, m, old))
+            if r is not None:
+                rk = restart_mask.reshape(mk.shape)
+                out = jnp.where(rk, r, out)
+            return out
+
+        if cfg.chaos_enabled:
+            node = jax.tree_util.tree_map(merge, state.node, ns_m, ns_t, ns_r)
+        else:
+            node = jax.tree_util.tree_map(
+                lambda old, m, t: merge(old, m, t, None), state.node, ns_m, ns_t
+            )
         # message handlers return a negative timer to keep the current
         # deadline; timer handlers return a negative value to disarm
         timer = jnp.where(has_msg & (timer_m >= 0), timer_m, state.timer)
         timer = jnp.where(
             due_t, jnp.where(timer_t >= 0, timer_t, INF_US), timer
         )
-        consumed = slot_oh.any(1)  # [L,S]
-        valid = msgs.valid & ~consumed
-
+        if cfg.chaos_enabled:
+            timer = jnp.where(restart_mask, timer_r, timer)
+        # consume the delivered slot (reusing the extraction one-hots)
+        consumed_main = has_msg & ~strag_win  # [L,N]
+        valid = msgs.valid & ~(pick_oh & consumed_main[:, :, None])
+        if self._B:
+            s_oh = (s_pick > 0) & strag_win[:, :, None]  # [L,N,B]
+            svalid = strag.valid & ~s_oh.any(axis=1)
         # lane clock: the latest event time processed this step (chaos-only
         # steps advance to the chaos instant t_next)
         clock = jnp.where(
@@ -359,21 +544,7 @@ class BatchedSim:
         tr_crash = jnp.full((L,), -1, jnp.int32)
         tr_restart = jnp.full((L,), -1, jnp.int32)
         if cfg.chaos_enabled:
-            chaos_due = active & (state.chaos_at <= t_next)
-            is_restart = state.crashed >= 0
-            do_crash = chaos_due & ~is_restart
-            do_restart = chaos_due & is_restart
-
-            victim = prng.randint(ckey, 1, 0, N)
-            crash_mask = do_crash[:, None] & (node_ids == victim[:, None])
-            restart_node = jnp.clip(state.crashed, 0, N - 1)
-            restart_mask = do_restart[:, None] & (node_ids == restart_node[:, None])
-
             alive = (alive & ~crash_mask) | restart_mask
-            ns_r, timer_r = self._v_on_restart(node, node_ids, clock, rkeys)
-            node = _tree_where(restart_mask, ns_r, node)
-            timer = jnp.where(restart_mask, timer_r, timer)
-
             restart_delay = prng.randint(
                 ckey, 2, cfg.restart_delay_lo_us, cfg.restart_delay_hi_us
             )
@@ -391,9 +562,12 @@ class BatchedSim:
                 jnp.where(do_restart, clock + next_crash, state.chaos_at),
             )
             # in-flight messages to a crashed node are lost (reset_node closes
-            # sockets, network.rs:142-147)
-            dst_alive_now = (dst_oh & alive[:, None, :]).any(-1)
-            valid = valid & dst_alive_now
+            # sockets, network.rs:142-147): its pool slice simply empties
+            valid = valid & ~crash_mask[:, :, None]
+            if self._B:
+                svalid = svalid & ~(
+                    do_crash[:, None] & (strag.dst == victim[:, None])
+                )
 
         # -- 5b. partition chaos: random bipartition splits, later heals ----
         # (the clog_link masks of network.rs:261-269, lane-batched)
@@ -445,20 +619,18 @@ class BatchedSim:
                 out.dst.reshape(L, N * e),
                 out.kind.reshape(L, N * e),
                 out.payload.reshape(L, N * e, P),
-                jnp.broadcast_to(node_ids[:, :, None], (L, N, e)).reshape(L, N * e),
             )
 
-        E_m = self.spec.max_out_msg
-        mv, md, mk, mp, ms_ = flat(out_m, has_msg, E_m)
-        tv, td, tk, tp, ts_ = flat(out_t, due_t, E)
-        C, K = self._C, self._K
+        E_m, E_t = spec.max_out_msg, spec.max_out
+        mv, md, mk, mp = flat(out_m, has_msg, E_m)
+        tv, td, tk, tp = flat(out_t, due_t, E_t)
+        C = self._C
         cand_valid = jnp.concatenate([mv, tv], axis=1)  # [L,C]
         cand_dst = jnp.clip(jnp.concatenate([md, td], axis=1), 0, N - 1)
         cand_kind = jnp.concatenate([mk, tk], axis=1)
         cand_pay = jnp.concatenate([mp, tp], axis=1)
-        cand_src = jnp.concatenate([ms_, ts_], axis=1)
 
-        # network rolls: loss + latency (test_link analog)
+        # network rolls: loss + latency (+ buggify heavy-tail coin)
         cidx = jnp.arange(C, dtype=jnp.uint32)[None, :]
         net_key = prng.fold(key, 105)[:, None]
         u = prng.uniform(net_key, 1, index=cidx)
@@ -466,7 +638,7 @@ class BatchedSim:
             net_key, 2, cfg.latency_lo_us,
             max(cfg.latency_hi_us, cfg.latency_lo_us + 1), index=cidx,
         )
-        cand_dst_oh = cand_dst[:, :, None] == jnp.arange(N)[None, None, :]  # [L,C,N]
+        cand_dst_oh = cand_dst[:, :, None] == narange[None, None, :]  # [L,C,N]
         keep = cand_valid & (u >= cfg.loss_rate)
         # sends to currently-dead nodes are dropped (clogged-node semantics)
         keep = keep & (cand_dst_oh & alive[:, None, :]).any(-1)
@@ -476,56 +648,177 @@ class BatchedSim:
             # is a constant-index gather, then matched against the dst one-hot
             src_rows = link_ok[:, self._src_of_c, :]  # [L,C,N]
             keep = keep & (cand_dst_oh & src_rows).any(-1)
+        if self._B:
+            # the rand_delay buggify tail (net/mod.rs:287-295): a surviving
+            # message occasionally takes seconds instead of milliseconds
+            bug = keep & prng.bernoulli(net_key, 3, cfg.buggify_delay_rate,
+                                        index=cidx)
+            tail = prng.randint(
+                net_key, 4, cfg.buggify_delay_lo_us,
+                max(cfg.buggify_delay_hi_us, cfg.buggify_delay_lo_us + 1),
+                index=cidx,
+            )
+            lat = jnp.where(bug, tail, lat)
+        else:
+            bug = jnp.zeros((L, C), jnp.bool_)
         # stamp each send from its EMITTING node's event time (candidate
         # positions map statically to their source node), so latency is
         # measured from the send instant, not the lane's window maximum
-        deliver_at = t_evt[:, self._src_of_c] + lat.astype(jnp.int32)
+        deliver_at = t_evt[:, self._src_of_c] + lat.astype(jnp.int32)  # [L,C]
 
-        # pack survivors into their origin's ring region: candidate c owns
-        # slots [c*K, (c+1)*K); the message lands in the first free slot of
-        # the region, else it overflows (counted). Pure elementwise writes —
-        # no [L,C,S] one-hot products.
-        region_free = ~valid.reshape(L, C, K)  # [L,C,K]
-        first_free = region_free & (
-            jnp.cumsum(region_free.astype(jnp.int8), axis=2) == 1
-        )
-        place = keep[:, :, None] & first_free  # [L,C,K]
-        placed = place.any(2)  # [L,C]
-        written = place.reshape(L, S)
+        # main-pool pack: candidate c's message rotates into ring slot
+        # k = seq_c mod K; the send is DROPPED (counted) when that slot is
+        # still pending at any destination — overwriting it would corrupt a
+        # message in flight. Everything is elementwise on [L,c,K] / [L,N,c,K]
+        # masks, per depth segment (see SimConfig).
+        send = keep & ~bug  # [L,C] candidate sends this step
+        dst_major = cand_dst_oh.transpose(0, 2, 1)  # [L,N,C]
+        ring_w_parts = []  # [L, nc*K] ring-slot write masks
+        place_parts = []  # [L, N, nc*K] validity-bit writes
+        ovf = jnp.zeros((L,), jnp.int32)
+        seq_inc = []
+        for c0, c1, K, s0, s1 in self._segs:
+            nc = c1 - c0
+            send_seg = send[:, c0:c1]  # [L,nc]
+            k_oh = (
+                (msgs.seq[:, c0:c1] % K)[:, :, None]
+                == jnp.arange(K)[None, None, :]
+            )  # [L,nc,K]
+            occupied = valid[:, :, s0:s1].reshape(L, N, nc, K).any(1)  # [L,nc,K]
+            blocked = (occupied & k_oh).any(2)  # [L,nc]
+            ok = send_seg & ~blocked
+            ovf = ovf + (send_seg & blocked).sum(axis=1, dtype=jnp.int32)
+            ring_w = ok[:, :, None] & k_oh  # [L,nc,K]
+            ring_w_parts.append(ring_w.reshape(L, nc * K))
+            place_parts.append(
+                (dst_major[:, :, c0:c1, None] & ring_w[:, None]).reshape(
+                    L, N, nc * K
+                )
+            )
+            seq_inc.append(ok)
+        ring_w = (
+            ring_w_parts[0] if len(ring_w_parts) == 1
+            else jnp.concatenate(ring_w_parts, axis=1)
+        )  # [L,CK]
+        written = (
+            place_parts[0] if len(place_parts) == 1
+            else jnp.concatenate(place_parts, axis=2)
+        )  # [L,N,CK]
+        ok_all = (
+            seq_inc[0] if len(seq_inc) == 1 else jnp.concatenate(seq_inc, axis=1)
+        )  # [L,C]
+        overflow = state.overflow + ovf
 
-        def put(pool_vals, cand_vals):
-            if cand_vals.ndim == 2:  # [L,C] -> [L,S]
-                incoming = jnp.broadcast_to(
-                    cand_vals[:, :, None], (L, C, K)
-                ).reshape(L, S)
-                return jnp.where(written, incoming, pool_vals)
-            incoming = jnp.broadcast_to(  # [L,C,P] -> [L,S,P]
-                cand_vals[:, :, None, :], (L, C, K, P)
-            ).reshape(L, S, P)
-            return jnp.where(written[:, :, None], incoming, pool_vals)
+        def ring_expand(cand_vals):  # [L,C(,P)] -> [L,CK(,P)] per segment
+            outs = []
+            for c0, c1, K, s0, s1 in self._segs:
+                nc = c1 - c0
+                seg = cand_vals[:, c0:c1]
+                if cand_vals.ndim == 2:
+                    outs.append(
+                        jnp.broadcast_to(
+                            seg[:, :, None], (L, nc, K)
+                        ).reshape(L, nc * K)
+                    )
+                else:
+                    outs.append(
+                        jnp.broadcast_to(
+                            seg[:, :, None, :], (L, nc, K, P)
+                        ).reshape(L, nc * K, P)
+                    )
+            return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+        def put(ring_vals, cand_vals):
+            inc = ring_expand(cand_vals)
+            if cand_vals.ndim == 2:
+                return jnp.where(ring_w, inc, ring_vals)
+            return jnp.where(ring_w[:, :, None], inc, ring_vals)
 
         new_valid = valid | written
-        new_deliver = put(jnp.where(valid, msgs.deliver, INF_US), deliver_at)
-        new_src = put(msgs.src, cand_src)
-        new_dst = put(msgs.dst, cand_dst)
+        new_deliver = put(msgs.deliver, deliver_at)
         new_kind = put(msgs.kind, cand_kind)
         new_payload = put(msgs.payload, cand_pay)
-        overflow = state.overflow + (keep & ~placed).sum(axis=1)
+        new_seq = msgs.seq + ok_all.astype(jnp.int32)
+
+        # straggler pack: region c owns K4 slots of the side pool
+        if self._B:
+            K4, B = self._K4, self._B
+            sb = keep & bug  # [L,C]
+            sfree = ~svalid.reshape(L, C, K4)
+            splace = sb[:, :, None] & _first_free(sfree, K4)  # [L,C,K4]
+            swritten = splace.reshape(L, B)
+            overflow = overflow + (sb & ~splace.any(2)).sum(axis=1, dtype=jnp.int32)
+
+            def sput(pool_vals, cand_vals):
+                if cand_vals.ndim == 2:
+                    inc = jnp.broadcast_to(
+                        cand_vals[:, :, None], (L, C, K4)
+                    ).reshape(L, B)
+                    return jnp.where(swritten, inc, pool_vals)
+                inc = jnp.broadcast_to(
+                    cand_vals[:, :, None, :], (L, C, K4, P)
+                ).reshape(L, B, P)
+                return jnp.where(swritten[:, :, None], inc, pool_vals)
+
+            new_strag = StragPool(
+                valid=svalid | swritten,
+                deliver=sput(jnp.where(svalid, strag.deliver, INF_US), deliver_at),
+                dst=sput(strag.dst, cand_dst),
+                kind=sput(strag.kind, cand_kind),
+                payload=sput(strag.payload, cand_pay),
+            )
+        else:
+            new_strag = None
 
         # -- 7. invariants + lane lifecycle --------------------------------
         ok = self._v_check(node, alive, clock)
         new_violation = active & ~ok & ~state.violated
         violated = state.violated | new_violation
         violation_at = jnp.where(new_violation, clock, state.violation_at)
-        reached_horizon = clock >= cfg.horizon_us
+        violation_epoch = jnp.where(new_violation, state.epoch,
+                                    state.violation_epoch)
+        # horizon in (epoch, offset) space: horizon_us may exceed int32
+        eh, oh = divmod(int(cfg.horizon_us), REBASE_US)
+        reached_horizon = (state.epoch > eh) | (
+            (state.epoch == eh) & (clock >= oh)
+        )
         done = state.done | deadlocked | reached_horizon | violated
+
+        # -- 8. epoch rebase: unbounded virtual time, int32 arithmetic -----
+        # (see spec.REBASE_US). Done lanes freeze as-is; sentinel values
+        # (INF_US timers / disabled chaos) are never rebased.
+        do_shift = (~done) & (clock >= REBASE_US)
+        shift = jnp.where(do_shift, jnp.int32(REBASE_US), 0)  # [L]
+
+        def rb(x, s):  # rebase a live-offset tensor, guarding sentinels
+            s = s.reshape(s.shape + (1,) * (x.ndim - 1))
+            return jnp.where(x < INF_GUARD, x - s, x)
+
+        clock = clock - shift
+        epoch = state.epoch + do_shift.astype(jnp.int32)
+        timer = rb(timer, shift)
+        chaos_at = rb(chaos_at, shift)
+        part_at = rb(part_at, shift)
+        new_deliver = rb(new_deliver, shift)
+        if self._B:
+            new_strag = new_strag._replace(
+                deliver=rb(new_strag.deliver, shift)
+            )
+        if spec.time_fields:
+            node = node._replace(**{
+                f: getattr(node, f)
+                - shift.reshape((L,) + (1,) * (getattr(node, f).ndim - 1))
+                for f in spec.time_fields
+            })
 
         new_state = SimState(
             clock=clock,
+            epoch=epoch,
             key=key,
             done=done,
             violated=violated,
             violation_at=violation_at,
+            violation_epoch=violation_epoch,
             deadlocked=state.deadlocked | deadlocked,
             steps=state.steps + active.astype(jnp.int32),
             events=state.events
@@ -543,15 +836,18 @@ class BatchedSim:
             msgs=MsgPool(
                 valid=new_valid,
                 deliver=new_deliver,
-                src=new_src,
-                dst=new_dst,
                 kind=new_kind,
                 payload=new_payload,
+                seq=new_seq,
             ),
+            strag=new_strag,
         )
         record = TraceRecord(
             clock=clock,
-            t_evt=t_evt,
+            epoch=epoch,
+            # report event times in the post-rebase basis, consistent with
+            # the record's epoch (extract_trace adds epoch * REBASE_US)
+            t_evt=t_evt - shift[:, None],
             msg_fired=has_msg,
             msg_src=m_src,
             msg_kind=m_kind,
@@ -583,9 +879,18 @@ class BatchedSim:
         return final
 
     def run(
-        self, seeds, max_steps: int = 100_000, dispatch_steps: int = 10_000
+        self, seeds, max_steps: int = 100_000, dispatch_steps: int = 10_000,
+        mesh: Optional[jax.sharding.Mesh] = None,
     ) -> SimState:
         """Run lanes until every lane is done (or max_steps).
+
+        With `mesh`, the lane axis is sharded over the mesh's first axis —
+        the production multi-device sweep path (the reference uses ALL
+        available parallel hardware for a seed sweep, one thread per seed,
+        runtime/builder.rs:118-136; here it is one lane shard per chip,
+        zero cross-device traffic). Results are bit-identical to the
+        unsharded run: no draw folds the lane index, so a seed's trajectory
+        does not depend on which device its lane landed on.
 
         The while_loop is dispatched in chunks of `dispatch_steps`: a long
         horizon at high lane counts would otherwise be ONE device kernel
@@ -598,6 +903,15 @@ class BatchedSim:
         if dispatch_steps <= 0:
             raise ValueError(f"dispatch_steps must be positive, got {dispatch_steps}")
         state = self.init(seeds)
+        if mesh is not None:
+            L = state.clock.shape[0]
+            n_dev = int(mesh.devices.size)
+            if L % n_dev:
+                raise ValueError(
+                    f"lane count {L} not divisible by mesh size {n_dev}; "
+                    "pad the seed batch (run_batch does this automatically)"
+                )
+            state = self.shard_state(state, mesh, lane_axis=mesh.axis_names[0])
         remaining = max_steps
         while remaining > 0:
             n = min(dispatch_steps, remaining)
@@ -647,21 +961,43 @@ class BatchedSim:
 
         Lanes are independent, so lane-sharding needs no collectives at all —
         the scaling-book data-parallel recipe. Node-sharding additionally
-        splits per-node state; XLA inserts gathers for pool<->node routing.
+        splits per-node state (dim 1 of every [L, N, ...] leaf, which in the
+        dest-major layout includes the message pool); XLA inserts gathers
+        for the cross-node routing. The straggler side pool's dim 1 is the
+        candidate axis, not the node axis — it stays lane-sharded only.
         """
         P = jax.sharding.PartitionSpec
+        N = self.spec.n_nodes
 
-        def shard(x):
+        def shard(x, node_ok=True):
             if x.ndim == 0:
                 return x
             axes: list = [lane_axis] + [None] * (x.ndim - 1)
-            if node_axis is not None and x.ndim >= 2:
+            if (
+                node_axis is not None and node_ok and x.ndim >= 2
+                and x.shape[1] == N
+            ):
                 axes[1] = node_axis
             return jax.device_put(
                 x, jax.sharding.NamedSharding(mesh, P(*axes))
             )
 
-        return jax.tree_util.tree_map(shard, state)
+        strag = state.strag
+        if strag is not None:
+            strag = jax.tree_util.tree_map(
+                functools.partial(shard, node_ok=False), strag
+            )
+        rest = jax.tree_util.tree_map(shard, state._replace(strag=None))
+        return rest._replace(strag=strag)
+
+
+def abs_time_us(state: SimState):
+    """Absolute virtual time per lane as int64 numpy (epoch * REBASE + off)."""
+    import numpy as np
+
+    return np.asarray(state.epoch, np.int64) * REBASE_US + np.asarray(
+        state.clock, np.int64
+    )
 
 
 def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
@@ -683,7 +1019,7 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
         "total_events": int(np.asarray(state.events).sum()),
         "total_overflow": int(np.asarray(state.overflow).sum()),
         "mean_steps": float(np.asarray(state.steps).mean()),
-        "mean_virtual_secs": float(np.asarray(state.clock).mean()) / 1e6,
+        "mean_virtual_secs": float(abs_time_us(state).mean()) / 1e6,
     }
     if spec is not None and spec.lane_metrics is not None:
         for name, arr in spec.lane_metrics(state.node).items():

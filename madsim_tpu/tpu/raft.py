@@ -100,11 +100,13 @@ def make_raft_spec(
         return now + prng.randint(key, site, election_lo_us, election_hi_us)
 
     def at_abs(s: RaftState, log_arr, i):
-        """log_arr value at ABSOLUTE index i via one-hot reduce; 0 when i is
-        outside the retained window (i may be [k] or scalar)."""
+        """log_arr value at ABSOLUTE index i via one-hot contraction; 0 when
+        i is outside the retained window (i may be [k] or scalar). einsum
+        (not mul+sum) so XLA lowers a dot_general instead of materializing
+        the broadcast product under the engine's lane x node vmap."""
         rel = jnp.asarray(i) - s.base
-        oh = ridx == rel[..., None]  # [..., LOG]
-        return (log_arr * oh.astype(jnp.int32)).sum(-1)
+        oh = (ridx == rel[..., None]).astype(log_arr.dtype)  # [..., LOG]
+        return jnp.einsum("...r,r->...", oh, log_arr)
 
     def term_at(s: RaftState, i):
         """Term of entry at absolute index i: window lookup, snapshot
@@ -117,28 +119,10 @@ def make_raft_spec(
         """Chain hash of prefix [0, i] at absolute i, from the cache;
         validity checked by caller (known iff base-1 <= i < log_len)."""
         i_arr = jnp.asarray(i)
-        win = (s.log_chain * (ridx == (i_arr - s.base)).astype(jnp.uint32)).sum(
-            -1, dtype=jnp.uint32
-        )
+        oh = (ridx == (i_arr - s.base)[..., None]).astype(jnp.uint32)
+        win = jnp.einsum("...r,r->...", oh, s.log_chain)
         return jnp.where(
             i_arr == s.base - 1, s.base_hash.astype(jnp.uint32), win
-        )
-
-    def no_out():
-        # on_message side: single-slot outbox (max_out_msg = 1)
-        return Outbox(
-            valid=jnp.zeros((1,), jnp.bool_),
-            dst=jnp.zeros((1,), jnp.int32),
-            kind=jnp.zeros((1,), jnp.int32),
-            payload=jnp.zeros((1, PAYLOAD_WIDTH), jnp.int32),
-        )
-
-    def reply(dst, kind, payload):
-        return Outbox(
-            valid=jnp.ones((1,), jnp.bool_),
-            dst=jnp.reshape(dst, (1,)).astype(jnp.int32),
-            kind=jnp.full((1,), kind, jnp.int32),
-            payload=jnp.reshape(payload, (1, PAYLOAD_WIDTH)).astype(jnp.int32),
         )
 
     def pack(*fields):
@@ -168,49 +152,50 @@ def make_raft_spec(
 
     # ------------------------------------------------------------ compaction
 
+    # static compaction distance: folding a FIXED number of entries turns
+    # the window shift into a compile-time slice + zero-pad instead of a
+    # dynamic-distance one-hot matmul — the [lane, node, LOG, LOG]
+    # contractions of the dynamic version measured as the single largest
+    # block of the whole engine step (HLO showed 18 such tensors; ~0.5 ms
+    # of a 2.9 ms step at 32k lanes). Semantics are unchanged where it
+    # matters: compaction still only folds committed entries and only under
+    # window pressure; a lane merely compacts in D-sized increments.
+    D_COMPACT = max(LOG // 4, 2)
+
     def compact(s: RaftState) -> RaftState:
-        """Fold committed entries into the snapshot when window pressure is
-        high, freeing slots for new appends (real Raft's log compaction).
-
-        Advances base to min(commit + 1, log_len - KEEP) when the window is
-        over half full — committed entries are immutable, so folding them
+        """Fold exactly D_COMPACT committed entries into the snapshot when
+        the window is pressured, freeing slots for new appends (real Raft's
+        log compaction). Committed entries are immutable, so folding them
         into base_hash loses nothing the invariant check needs beyond window
-        reach (the chain hash still witnesses the whole prefix).
-        """
-        KEEP = max(LOG // 4, 2)  # always retain a tail for prev-term checks
+        reach (the chain hash still witnesses the whole prefix)."""
+        D = D_COMPACT
         pressure = (s.log_len - s.base) > (LOG // 2)
-        new_base = jnp.clip(
-            jnp.minimum(s.commit + 1, s.log_len - KEEP), s.base, s.log_len
-        )
-        do = pressure & (new_base > s.base)
-        d = jnp.where(do, new_base - s.base, 0)  # shift amount
+        do = pressure & (s.commit + 1 - s.base >= D)
 
-        # chain hash / boundary term at new_base - 1
-        nb_hash = hash_at(s, new_base - 1)
-        nb_term = term_at(s, new_base - 1)
+        # boundary values at new_base - 1 = base + D - 1: static slot D - 1
+        nb_hash = s.log_chain[D - 1]
+        nb_term = s.log_term[D - 1]
 
-        # shift window left by d: shifted[r] = window[r + d] (one-hot matmul;
-        # LOG is small so this stays a tiny VPU contraction). The chain cache
-        # shifts identically: its values are absolute-prefix hashes.
-        shift_oh = (ridx[None, :] == (ridx[:, None] + d)).astype(jnp.int32)
-        log_term = (shift_oh * s.log_term[None, :]).sum(-1)
-        log_cmd = (shift_oh * s.log_cmd[None, :]).sum(-1)
-        log_chain = (shift_oh.astype(jnp.uint32) * s.log_chain[None, :]).sum(
-            -1, dtype=jnp.uint32
-        )
+        def shift(arr):  # arr[r] = old arr[r + D], zero-padded tail
+            return jnp.concatenate([arr[D:], jnp.zeros((D,), arr.dtype)])
 
         return s._replace(
-            base=jnp.where(do, new_base, s.base),
+            base=jnp.where(do, s.base + D, s.base),
             base_hash=jnp.where(do, nb_hash.astype(jnp.int32), s.base_hash),
             base_term=jnp.where(do, nb_term, s.base_term),
-            log_term=jnp.where(do, log_term, s.log_term),
-            log_cmd=jnp.where(do, log_cmd, s.log_cmd),
-            log_chain=jnp.where(do, log_chain, s.log_chain),
+            log_term=jnp.where(do, shift(s.log_term), s.log_term),
+            log_cmd=jnp.where(do, shift(s.log_cmd), s.log_cmd),
+            log_chain=jnp.where(do, shift(s.log_chain), s.log_chain),
         )
 
     # ----------------------------------------------------------------- timer
 
     def on_timer(s: RaftState, nid, now, key):
+        # Field-level masked merge of the leader (heartbeat/replicate) and
+        # non-leader (start election) paths: building two full RaftStates
+        # and tree_select-ing them costs three full state passes per leaf;
+        # this writes each field once. (The engine runs this body for every
+        # (lane, node) every step, so its cost is the step's biggest term.)
         s = compact(s)
         is_leader = s.role == LEADER
 
@@ -219,18 +204,19 @@ def make_raft_spec(
         do_append = is_leader & can_append & (prng.uniform(key, 26) < client_rate)
         at_end = ridx == (s.log_len - s.base)
         new_cmd = nid * 100_000 + s.next_cmd
-        log_cmd = jnp.where(do_append & at_end, new_cmd, s.log_cmd)
-        log_term = jnp.where(do_append & at_end, s.term, s.log_term)
+        wr = do_append & at_end
+        log_cmd = jnp.where(wr, new_cmd, s.log_cmd)
+        log_term = jnp.where(wr, s.term, s.log_term)
         # chain cache: fold the new entry onto the hash of the prefix below
         append_h = _chain_fold(hash_at(s, s.log_len - 1), s.term, new_cmd)
-        log_chain = jnp.where(do_append & at_end, append_h, s.log_chain)
+        log_chain = jnp.where(wr, append_h, s.log_chain)
         log_len = s.log_len + do_append.astype(jnp.int32)
-        s_app = s._replace(
-            log_term=log_term, log_cmd=log_cmd, log_chain=log_chain,
-            log_len=log_len,
-        )
 
         prev_idx = s.next_idx - 1  # [N] absolute
+        # post-append window lookups for the AE payloads
+        s_app = s._replace(
+            log_term=log_term, log_cmd=log_cmd, log_len=log_len
+        )
         prev_term = term_at(s_app, prev_idx)
         has_entry = s.next_idx < log_len
         e_term = jnp.where(has_entry, at_abs(s_app, log_term, s.next_idx), 0)
@@ -238,6 +224,23 @@ def make_raft_spec(
         # a follower lagging behind the window gets an InstallSnapshot
         # instead of an entry it can no longer be served
         needs_snap = s.next_idx < s.base
+
+        # -- non-leader: election timeout => become candidate
+        start_el = ~is_leader
+        new_term = jnp.where(start_el, s.term + 1, s.term)
+        last_idx = s.log_len - 1
+
+        state = s._replace(
+            term=new_term,
+            voted_for=jnp.where(start_el, nid, s.voted_for),
+            role=jnp.where(start_el, CANDIDATE, s.role),
+            votes=jnp.where(start_el, jnp.int32(1) << nid, s.votes),
+            log_term=log_term, log_cmd=log_cmd, log_chain=log_chain,
+            log_len=log_len,
+            next_cmd=s.next_cmd + do_append.astype(jnp.int32),
+        )
+
+        # -- outbox: one broadcast either way (AE/SNAP per peer, or RV)
         ae_payload = jnp.stack(
             [
                 jnp.full((N,), s.term, jnp.int32),
@@ -260,236 +263,205 @@ def make_raft_spec(
             ],
             axis=1,
         )
-        leader_out = Outbox(
-            valid=(peers != nid),
-            dst=peers,
-            kind=jnp.where(needs_snap, SNAP, APPEND).astype(jnp.int32),
-            payload=jnp.where(needs_snap[:, None], snap_payload, ae_payload),
-        )
-        leader_state = s_app._replace(
-            next_cmd=s.next_cmd + do_append.astype(jnp.int32),
-        )
-
-        # -- follower/candidate: election timeout => start election
-        new_term = s.term + 1
-        last_idx = s.log_len - 1
         rv_payload = jnp.broadcast_to(
             pack(new_term, last_idx, term_at(s, last_idx), 0, 0, 0),
             (N, PAYLOAD_WIDTH),
         )
-        cand_out = Outbox(
+        ldr = jnp.broadcast_to(jnp.reshape(is_leader, (1,)), (N,))
+        out = Outbox(
             valid=(peers != nid),
             dst=peers,
-            kind=jnp.full((N,), REQUEST_VOTE, jnp.int32),
-            payload=rv_payload,
+            kind=jnp.where(
+                ldr,
+                jnp.where(needs_snap, SNAP, APPEND),
+                REQUEST_VOTE,
+            ).astype(jnp.int32),
+            payload=jnp.where(
+                ldr[:, None],
+                jnp.where(needs_snap[:, None], snap_payload, ae_payload),
+                rv_payload,
+            ),
         )
-        cand_state = s._replace(
-            term=new_term,
-            voted_for=nid,
-            role=jnp.int32(CANDIDATE),
-            votes=(jnp.int32(1) << nid),
+        timer = jnp.where(
+            is_leader, now + heartbeat_us, election_deadline(now, key, 22)
         )
-
-        state = tree_select(is_leader, leader_state, cand_state)
-        out = tree_select(is_leader, leader_out, cand_out)
-        timer = jnp.where(is_leader, now + heartbeat_us, election_deadline(now, key, 22))
         return state, out, timer
 
     # --------------------------------------------------------------- message
 
-    def h_request_vote(s: RaftState, nid, src, f, now, key):
-        c_term, c_last_idx, c_last_term = f[0], f[1], f[2]
-        # newer term: step down
-        newer = c_term > s.term
-        term = jnp.where(newer, c_term, s.term)
-        role = jnp.where(newer, FOLLOWER, s.role)
-        voted_for = jnp.where(newer, -1, s.voted_for)
+    def on_message(s: RaftState, nid, src, kind, payload, now, key):
+        """All five message kinds as ONE masked handler.
 
+        Under vmap, a lax.switch on a traced kind executes EVERY branch and
+        selects — five full RaftState materializations per step. The merged
+        form computes each state field exactly once under kind masks (the
+        masks are mutually exclusive), which measured ~2x cheaper. Each
+        kind's logic is the direct transcription of the r3 per-kind
+        handlers (h_request_vote/h_vote_resp/h_append/h_append_resp/h_snap);
+        see git history for the originals side by side.
+        """
+        # Compaction here covers the follower side: a healthy leader resets
+        # the election timer with every AppendEntries, so the timer (the
+        # only other compaction site) would starve follower compaction
+        # forever — the window fills, writes stall at capacity, and the
+        # leader's majority commit wedges (the round-2 "silently saturated
+        # lane" bug). Running it for every kind is sound: it only folds
+        # already-committed entries under window pressure.
+        s = compact(s)
+        f = payload
+        is_rv = kind == REQUEST_VOTE
+        is_vr = kind == VOTE_RESP
+        is_ae = kind == APPEND
+        is_ar = kind == APPEND_RESP
+        is_sn = kind == SNAP
+        msg_term = f[0]  # every kind carries the sender's term first
+
+        # -- shared term adoption: newer term => step down, clear vote
+        newer = msg_term > s.term
+        term = jnp.where(newer, msg_term, s.term)
+        voted_for = jnp.where(newer, -1, s.voted_for)
+        role = jnp.where(newer, FOLLOWER, s.role)
+        # current-term AE/SNAP is valid leader contact: candidate steps down
+        stale_ldr = msg_term < s.term  # sender behind (AE/SNAP staleness)
+        ldr_contact = (is_ae | is_sn) & ~stale_ldr
+        role = jnp.where(ldr_contact, FOLLOWER, role)
+
+        # -- REQUEST_VOTE: grant iff candidate's log is up to date (§5.4.1)
         my_last_idx = s.log_len - 1
         my_last_term = term_at(s, my_last_idx)
-        log_ok = (c_last_term > my_last_term) | (
-            (c_last_term == my_last_term) & (c_last_idx >= my_last_idx)
+        log_ok = (f[2] > my_last_term) | (
+            (f[2] == my_last_term) & (f[1] >= my_last_idx)
         )
-        grant = (c_term == term) & ((voted_for == -1) | (voted_for == src)) & log_ok
+        grant = (
+            is_rv & (msg_term == term)
+            & ((voted_for == -1) | (voted_for == src)) & log_ok
+        )
         voted_for = jnp.where(grant, src, voted_for)
-        state = s._replace(term=term, role=role, voted_for=voted_for)
-        out = reply(src, VOTE_RESP, pack(term, grant, 0, 0, 0, 0))
-        # granting a vote resets the election timer (standard Raft)
-        timer = jnp.where(grant, election_deadline(now, key, 23), jnp.int32(-1))
-        return state, out, timer  # timer -1 = keep current (resolved below)
 
-    def h_vote_resp(s: RaftState, nid, src, f, now, key):
-        r_term, granted = f[0], f[1]
-        newer = r_term > s.term
-        term = jnp.where(newer, r_term, s.term)
-        role = jnp.where(newer, FOLLOWER, s.role)
-        voted_for = jnp.where(newer, -1, s.voted_for)
-
-        votes = jnp.where(
-            (role == CANDIDATE) & (r_term == term) & (granted > 0),
-            s.votes | (jnp.int32(1) << src),
-            s.votes,
-        )
-        won = (role == CANDIDATE) & (
+        # -- VOTE_RESP: tally; majority => leader, reset replication state
+        tally = is_vr & (role == CANDIDATE) & (msg_term == term) & (f[1] > 0)
+        votes = jnp.where(tally, s.votes | (jnp.int32(1) << src), s.votes)
+        won = is_vr & (role == CANDIDATE) & (
             jax.lax.population_count(votes.astype(jnp.uint32)).astype(jnp.int32)
             > N // 2
         )
         role = jnp.where(won, LEADER, role)
-        next_idx = jnp.where(won, jnp.full((N,), 1, jnp.int32) * s.log_len, s.next_idx)
-        match_idx = jnp.where(won, jnp.full((N,), -1, jnp.int32), s.match_idx)
-        match_idx = jnp.where(won & (peers == nid), s.log_len - 1, match_idx)
-        state = s._replace(
-            term=term, role=role, voted_for=voted_for, votes=votes,
-            next_idx=next_idx, match_idx=match_idx,
-        )
-        # on win, fire the heartbeat timer immediately
-        timer = jnp.where(won, now, jnp.int32(-1))
-        return state, no_out(), timer
 
-    def h_append(s: RaftState, nid, src, f, now, key):
-        # Followers must compact here: their election timer (the only other
-        # compaction site) is reset by every valid AppendEntries, so a healthy
-        # leader would otherwise starve follower compaction forever — the
-        # window fills, writes stall at capacity, and the leader's majority
-        # commit wedges with it (the round-2 "silently saturated lane" bug).
-        s = compact(s)
-        l_term, prev_idx, prev_term, e_term, e_cmd, l_commit = (
-            f[0], f[1], f[2], f[3], f[4], f[5],
+        # -- APPEND: consistency check, window write, commit advance
+        prev_idx, prev_term_in, e_term, e_cmd, l_commit = (
+            f[1], f[2], f[3], f[4], f[5],
         )
-        stale = l_term < s.term
-        # valid leader contact: adopt term, become follower
-        term = jnp.where(stale, s.term, l_term)
-        role = jnp.where(stale, s.role, FOLLOWER)
-        voted_for = jnp.where(l_term > s.term, -1, s.voted_for)
-
         prev_ok = (prev_idx < 0) | (
             (prev_idx < s.log_len)
             & (prev_idx >= s.base - 1)
-            & (term_at(s, prev_idx) == prev_term)
+            & (term_at(s, prev_idx) == prev_term_in)
         )
-        ok = (~stale) & prev_ok
+        ae_ok = is_ae & ~stale_ldr & prev_ok
         has_entry = e_term > 0
         write_at = prev_idx + 1  # absolute
         rel_w = write_at - s.base
         in_window = (rel_w >= 0) & (rel_w < LOG)
-        do_write = ok & has_entry & in_window
+        do_write = ae_ok & has_entry & in_window
         at_w = ridx == rel_w
-        # conflict: entry at write_at with different term => truncate + replace
+        # conflict: entry at write_at with different term => truncate+replace
         existing_term = at_abs(s, s.log_term, write_at)
         same = (write_at < s.log_len) & (existing_term == e_term)
-        log_term_new = jnp.where(do_write & at_w, e_term, s.log_term)
-        log_cmd_new = jnp.where(do_write & at_w, e_cmd, s.log_cmd)
         # chain cache: fold onto the predecessor's hash (same index + same
         # term => same entry in Raft, so the `same` overwrite is a no-op)
         write_h = _chain_fold(hash_at(s, write_at - 1), e_term, e_cmd)
-        log_chain_new = jnp.where(do_write & at_w, write_h, s.log_chain)
-        log_len_new = jnp.where(
-            do_write, jnp.where(same, s.log_len, write_at + 1), s.log_len
+        match_ae = jnp.where(
+            ae_ok, jnp.where(has_entry & in_window, write_at, prev_idx), -1
         )
-        match = jnp.where(ok, jnp.where(has_entry & in_window, write_at, prev_idx), -1)
-        commit = jnp.where(
-            ok, jnp.maximum(s.commit, jnp.minimum(l_commit, match)), s.commit
-        )
-        state = s._replace(
-            term=term, role=role, voted_for=voted_for,
-            log_term=log_term_new, log_cmd=log_cmd_new,
-            log_chain=log_chain_new, log_len=log_len_new,
-            commit=commit,
-        )
-        out = reply(src, APPEND_RESP, pack(term, ok, match, 0, 0, 0))
-        # any valid AppendEntries resets the election timer
-        timer = jnp.where(~stale, election_deadline(now, key, 24), jnp.int32(-1))
-        return state, out, timer
 
-    def h_append_resp(s: RaftState, nid, src, f, now, key):
-        r_term, success, match = f[0], f[1], f[2]
-        newer = r_term > s.term
-        term = jnp.where(newer, r_term, s.term)
-        role = jnp.where(newer, FOLLOWER, s.role)
-        voted_for = jnp.where(newer, -1, s.voted_for)
+        # -- SNAP: adopt the leader's compacted prefix wholesale (Raft §7
+        # "discard the entire log"; everything beyond s.commit is
+        # uncommitted locally, so dropping it is safe — it re-fetches).
+        # An adopt requires the snapshot to advance our commit; the ack may
+        # only claim VERIFIED agreement (adopt => snap_idx; else the
+        # committed intersection), never the unverified local tail — the
+        # round-3 fuzz-found split-brain (see git history for the full
+        # narrative; regression net: test_snapshot_ack_regression...)
+        snap_idx, snap_term, snap_hash = f[1], f[2], f[3]
+        adopt = is_sn & ~stale_ldr & (snap_idx > s.commit)
+        match_sn = jnp.where(
+            adopt, snap_idx,
+            jnp.where(stale_ldr, -1, jnp.minimum(snap_idx, s.commit)),
+        )
 
-        is_leader = (role == LEADER) & (r_term == term)
-        upd = is_leader & (success > 0)
+        # -- APPEND_RESP: leader replication bookkeeping + majority commit
+        ar_success, ar_match = f[1], f[2]
+        ar_live = is_ar & (role == LEADER) & (msg_term == term)
+        upd = ar_live & (ar_success > 0) & (peers == src)
+        back = ar_live & (ar_success == 0) & (peers == src)
+        match_idx = jnp.where(upd, jnp.maximum(s.match_idx, ar_match), s.match_idx)
+        next_idx = jnp.where(upd, jnp.maximum(s.next_idx, ar_match + 1), s.next_idx)
+        next_idx = jnp.where(back, jnp.maximum(s.next_idx - 1, 0), next_idx)
+        # vote win resets replication state (disjoint kind: is_vr)
         match_idx = jnp.where(
-            upd & (peers == src), jnp.maximum(s.match_idx, match), s.match_idx
+            won, jnp.where(peers == nid, s.log_len - 1, -1), match_idx
         )
-        next_idx = jnp.where(
-            upd & (peers == src), jnp.maximum(s.next_idx, match + 1), s.next_idx
-        )
-        # backoff on rejection
-        back = is_leader & (success == 0)
-        next_idx = jnp.where(
-            back & (peers == src), jnp.maximum(s.next_idx - 1, 0), next_idx
-        )
-        # advance commit: highest index replicated on a majority, current term
+        next_idx = jnp.where(won, s.log_len, next_idx)
         my_match = jnp.where(peers == nid, s.log_len - 1, match_idx)
-        sorted_match = jnp.sort(my_match)
-        majority_idx = sorted_match[N - (N // 2 + 1)]
-        can_commit = (majority_idx > s.commit) & (
+        majority_idx = jnp.sort(my_match)[N - (N // 2 + 1)]
+        can_commit = ar_live & (majority_idx > s.commit) & (
             term_at(s, majority_idx) == term
         )
-        commit = jnp.where(is_leader & can_commit, majority_idx, s.commit)
-        state = s._replace(
-            term=term, role=role, voted_for=voted_for,
-            next_idx=next_idx, match_idx=match_idx, commit=commit,
-        )
-        return state, no_out(), jnp.int32(-1)
 
-    def h_snap(s: RaftState, nid, src, f, now, key):
-        """InstallSnapshot: adopt the leader's compacted prefix wholesale.
-
-        Only useful for a follower whose log is entirely behind the
-        snapshot; the committed prefix it replaces is bitwise-identified by
-        the chain hash, so the invariant check keeps working across it."""
-        l_term, snap_idx, snap_term, snap_hash, _, l_commit = (
-            f[0], f[1], f[2], f[3], f[4], f[5],
+        # -- merged field writes (kind masks are mutually exclusive)
+        log_term_new = jnp.where(
+            do_write & at_w, e_term, jnp.where(adopt, 0, s.log_term)
         )
-        stale = l_term < s.term
-        term = jnp.where(stale, s.term, l_term)
-        role = jnp.where(stale, s.role, FOLLOWER)
-        voted_for = jnp.where(l_term > s.term, -1, s.voted_for)
-        # Adopt whenever the snapshot advances our commit, DISCARDING the
-        # whole local log (Raft §7: "discard the entire log" on
-        # InstallSnapshot). Everything beyond s.commit is uncommitted
-        # locally, so dropping it is safe — it re-fetches via AppendEntries.
-        # The earlier extra condition (snap_idx >= log_len - 1) refused the
-        # snapshot when a divergent uncommitted tail outgrew it, which could
-        # wedge the follower in a SNAP loop forever: it couldn't adopt, its
-        # ack couldn't move the leader's next_idx past the leader's base,
-        # and each SNAP reset its election timer.
-        adopt = (~stale) & (snap_idx > s.commit)
+        log_cmd_new = jnp.where(
+            do_write & at_w, e_cmd, jnp.where(adopt, 0, s.log_cmd)
+        )
+        log_chain_new = jnp.where(
+            do_write & at_w, write_h,
+            jnp.where(adopt, jnp.uint32(0), s.log_chain),
+        )
+        log_len_new = jnp.where(
+            do_write, jnp.where(same, s.log_len, write_at + 1),
+            jnp.where(adopt, snap_idx + 1, s.log_len),
+        )
+        commit = jnp.where(
+            ae_ok, jnp.maximum(s.commit, jnp.minimum(l_commit, match_ae)),
+            jnp.where(
+                can_commit, majority_idx,
+                jnp.where(adopt, snap_idx, s.commit),
+            ),
+        )
         state = s._replace(
-            term=term, role=role, voted_for=voted_for,
+            term=term, role=role, voted_for=voted_for, votes=votes,
             base=jnp.where(adopt, snap_idx + 1, s.base),
             base_hash=jnp.where(adopt, snap_hash, s.base_hash),
             base_term=jnp.where(adopt, snap_term, s.base_term),
-            log_term=jnp.where(adopt, 0, s.log_term),
-            log_cmd=jnp.where(adopt, 0, s.log_cmd),
-            log_chain=jnp.where(adopt, jnp.uint32(0), s.log_chain),
-            log_len=jnp.where(adopt, snap_idx + 1, s.log_len),
-            commit=jnp.where(adopt, snap_idx, s.commit),
+            log_term=log_term_new, log_cmd=log_cmd_new,
+            log_chain=log_chain_new, log_len=log_len_new,
+            commit=commit, next_idx=next_idx, match_idx=match_idx,
         )
-        # match may only claim VERIFIED agreement. On adopt the follower now
-        # holds the leader's exact prefix [0, snap_idx]. On non-adopt, only
-        # the committed intersection is known to agree (Leader Completeness);
-        # the old ack of log_len - 1 claimed the follower's unverified,
-        # possibly-divergent tail as matched, letting the leader advance
-        # commit over entries the follower never had — a split-brain commit
-        # found by this framework's own fuzz (device + C++ baseline, 8/512
-        # lanes under compaction + partition chaos).
-        match = jnp.where(
-            adopt, snap_idx,
-            jnp.where(stale, -1, jnp.minimum(snap_idx, s.commit)),
-        )
-        out = reply(src, APPEND_RESP, pack(term, ~stale, match, 0, 0, 0))
-        timer = jnp.where(~stale, election_deadline(now, key, 27), jnp.int32(-1))
-        return state, out, timer
 
-    def on_message(s: RaftState, nid, src, kind, payload, now, key):
-        state, out, timer = jax.lax.switch(
-            jnp.clip(kind, 0, 4),
-            [h_request_vote, h_vote_resp, h_append, h_append_resp, h_snap],
-            s, nid, src, payload, now, key,
+        # -- reply: RV => VOTE_RESP; AE/SNAP => APPEND_RESP; else nothing
+        replies = is_rv | is_ae | is_sn
+        r_kind = jnp.where(is_rv, VOTE_RESP, APPEND_RESP)
+        r_f1 = jnp.where(
+            is_rv, grant.astype(jnp.int32),
+            jnp.where(is_ae, ae_ok, ~stale_ldr).astype(jnp.int32),
+        )
+        r_f2 = jnp.where(is_ae, match_ae, match_sn)
+        out = Outbox(
+            valid=jnp.reshape(replies, (1,)),
+            dst=jnp.reshape(src, (1,)).astype(jnp.int32),
+            kind=jnp.reshape(r_kind, (1,)).astype(jnp.int32),
+            payload=jnp.reshape(
+                pack(term, r_f1, r_f2, 0, 0, 0), (1, PAYLOAD_WIDTH)
+            ),
+        )
+
+        # -- timer: vote grant / valid leader contact reset the election
+        # deadline; a fresh winner fires its heartbeat immediately
+        reset = grant | ((is_ae | is_sn) & ~stale_ldr)
+        timer = jnp.where(
+            won, now,
+            jnp.where(reset, election_deadline(now, key, 24), jnp.int32(-1)),
         )
         return state, out, timer
 
@@ -522,10 +494,8 @@ def make_raft_spec(
         m = jnp.minimum(ns.commit[:, None], ns.commit[None, :])  # [N,N]
         # hash of node a's prefix at m (one-hot over window + boundary case)
         rel = m[:, :, None] - ns.base[:, None, None]  # a's window offset
-        win_oh = ridx[None, None, :] == rel  # [N,N,LOG]
-        h_win = (h_all[:, None, :] * win_oh.astype(jnp.uint32)).sum(
-            -1, dtype=jnp.uint32
-        )
+        win_oh = (ridx[None, None, :] == rel).astype(jnp.uint32)  # [N,N,LOG]
+        h_win = jnp.einsum("abr,ar->ab", win_oh, h_all)
         at_boundary = m == (ns.base[:, None] - 1)
         h_a = jnp.where(
             at_boundary, ns.base_hash[:, None].astype(jnp.uint32), h_win
@@ -580,10 +550,8 @@ def make_raft_spec(
         # pressure that the next compaction will clear is not saturation.
         # With follower-side compaction + InstallSnapshot this should be 0 at
         # the bench config; regressions must be visible (engine.summarize).
-        KEEP = max(LOG // 4, 2)
         window_full = (node.log_len - node.base) >= LOG
-        freeable = jnp.minimum(node.commit + 1, node.log_len - KEEP)
-        cannot_compact = freeable <= node.base
+        cannot_compact = (node.commit + 1 - node.base) < D_COMPACT
         return {
             "log_saturated_lanes": (window_full & cannot_compact).any(axis=-1),
             "mean_log_len": node.log_len.astype(jnp.float32).mean(axis=-1),

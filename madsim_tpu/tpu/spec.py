@@ -49,6 +49,22 @@ import jax.numpy as jnp
 # sentinel for "no timer" / "no event" (int32 microseconds)
 INF_US = jnp.int32(2**31 - 1)
 
+# --- unbounded virtual time: per-lane epoch + int32 offsets -----------------
+# The engine keeps every time tensor as an int32 OFFSET from a per-lane
+# epoch base; when a lane's clock offset crosses REBASE_US, every live
+# offset in the lane (clock, timers, deliver times, chaos schedule, and the
+# spec's declared `time_fields`) shifts down by REBASE_US and the lane's
+# epoch increments. Absolute virtual time = epoch * REBASE_US + offset,
+# giving ~2^59 us (~18k years) of headroom — the reference's effectively
+# unbounded clock (time/mod.rs:21-225) — while every hot-path comparison
+# stays int32: int64 min/argmin measured 93x slower than int32 on TPU v5e
+# (see BENCH notes), so literally widening the tensors was not an option.
+# Values >= INF_GUARD are sentinels (disarmed timers, disabled chaos) and
+# are never rebased; real offsets stay far below it by construction
+# (offset < REBASE_US + horizon-window slack << INF_GUARD).
+REBASE_US = 1 << 28  # ~268 virtual seconds per epoch
+INF_GUARD = jnp.int32(1 << 30)
+
 
 def tree_select(cond, a, b):
     """Elementwise pytree select on a traced scalar condition — the shared
@@ -100,6 +116,12 @@ class ProtocolSpec:
     # optional: human names for message kinds, indexed by kind int —
     # used by trace.extract_trace to render violation traces readably
     msg_kind_names: Any = None
+    # names of node-state fields holding ABSOLUTE virtual times (e.g. a
+    # last-heartbeat stamp or recorded op timestamps). The engine shifts
+    # these with the lane's epoch rebase (see REBASE_US) so `now - field`
+    # arithmetic stays valid across unbounded virtual time. Fields never
+    # compared against `now` (counters, revisions, ids) must NOT be listed.
+    time_fields: tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,10 +133,36 @@ class SimConfig:
     (task/mod.rs:282-298 uses 1-10 s restart delays).
     """
 
-    msg_capacity: int = 64  # message-pool slots per lane
+    msg_capacity: int = 64  # message-pool budget per lane (sizes region depth)
+    # region depth overrides by candidate class (None => derived uniformly
+    # from msg_capacity). Handler-reply positions (`max_out_msg` rows) aim
+    # at dynamic destinations and burst within one latency window — e.g.
+    # a raft follower draining a post-partition backlog acks the leader
+    # several times in a few ms — so they usually need depth >= 2, while
+    # timer-broadcast positions are periodic (heartbeat interval >> latency)
+    # and depth 1 suffices. Splitting the depths keeps the pool small:
+    # pool bandwidth is ~linear in total slots and is a top step cost.
+    msg_depth_msg: "int | None" = None
+    msg_depth_timer: "int | None" = None
     latency_lo_us: int = 1_000
     latency_hi_us: int = 10_000
     loss_rate: float = 0.0
+    # heavy-tail delay buggify (the rand_delay buggify tail of
+    # net/mod.rs:287-295): each surviving message flips a coin at this rate
+    # and, on heads, its latency is drawn from [buggify_delay_lo,
+    # buggify_delay_hi] instead of the normal range — the extreme-straggler
+    # bug class (a delayed ack arriving after the world moved on) that
+    # uniform latency never produces. 0 disables (no straggler pool built).
+    buggify_delay_rate: float = 0.0
+    buggify_delay_lo_us: int = 1_000_000
+    buggify_delay_hi_us: int = 5_000_000
+    # straggler slots per candidate position (side-pool depth): bounds how
+    # many tail-delayed messages from one send site may be in flight at
+    # once; extras are dropped and counted in `overflow`. Size it to
+    # ~ rate x send-frequency x mean tail seconds per site (e.g. a 5% tail
+    # on a 25 ms heartbeat stream needs ~8); the pool only exists while
+    # buggify_delay_rate > 0
+    buggify_depth: int = 4
     # crash/restart chaos (0 disables): a random node crashes every
     # crash_interval, restarts after restart_delay
     crash_interval_lo_us: int = 0

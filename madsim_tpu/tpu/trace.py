@@ -66,8 +66,16 @@ def extract_trace(
     Steps after the lane finished record no events (active lanes only), so
     the list self-truncates at the violation/horizon.
     """
-    clock = np.asarray(recs.clock)[:, lane]
-    t_evt = np.asarray(recs.t_evt)[:, lane]  # [T,N] per-node event times
+    # times are (epoch, offset) pairs — combine to absolute int64 us
+    # (spec.REBASE_US; the record's offsets are post-rebase, so a step that
+    # rebased reports its events in the NEW basis consistently)
+    from .spec import REBASE_US
+
+    epoch = np.asarray(recs.epoch, np.int64)[:, lane]  # [T]
+    clock = np.asarray(recs.clock, np.int64)[:, lane] + epoch * REBASE_US
+    t_evt = (
+        np.asarray(recs.t_evt, np.int64)[:, lane] + epoch[:, None] * REBASE_US
+    )  # [T,N] per-node event times
     msg_fired = np.asarray(recs.msg_fired)[:, lane]  # [T,N]
     msg_src = np.asarray(recs.msg_src)[:, lane]
     msg_kind = np.asarray(recs.msg_kind)[:, lane]

@@ -367,9 +367,10 @@ def twopc_workload(
 
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
-        # engine regions: 128 // 50 candidate positions = 2 slots per
-        # origin region — measured zero overflow at this traffic shape
-        msg_capacity=128,
+        # ring depth 2: OUTCOME re-sends (DREQ answers) and back-to-back
+        # PREPARE/OUTCOME broadcasts can overlap within a latency window
+        msg_depth_msg=2,
+        msg_depth_timer=2,
         loss_rate=loss_rate,
         crash_interval_lo_us=400_000,
         crash_interval_hi_us=2_000_000,

@@ -1,0 +1,19 @@
+"""Build hook for `pip install (-e) .`: compiles the optional native
+executor core alongside the pure-Python package. `optional=True` keeps
+installs working on toolchain-less machines (madsim_tpu.native falls back
+to the bit-compatible pure-Python implementations; it also self-builds on
+first import from a plain checkout — see madsim_tpu/native/__init__.py)."""
+
+from setuptools import Extension, setup
+
+setup(
+    ext_modules=[
+        Extension(
+            "madsim_tpu.native._core",
+            sources=["madsim_tpu/native/_core.cpp"],
+            extra_compile_args=["-O2", "-std=c++17"],
+            language="c++",
+            optional=True,
+        )
+    ],
+)

@@ -51,6 +51,7 @@ def test_clean_raft_sweep_no_violations():
     assert result.summary["total_events"] > 0
 
 
+@pytest.mark.deep
 def test_violating_seeds_reported_with_repro_seed():
     wl = raft_workload(virtual_secs=5.0, spec=buggy_raft_spec())
     result = run_batch(range(128), wl, repro_on_host=False)
@@ -63,6 +64,7 @@ def test_violating_seeds_reported_with_repro_seed():
     assert f"MADSIM_TEST_SEED={seeds[0]}" in str(e.value)
 
 
+@pytest.mark.deep
 def test_chunked_sweep_matches_single_batch():
     wl = raft_workload(virtual_secs=1.0, spec=buggy_raft_spec())
     a = run_batch(range(64), wl, repro_on_host=False)
@@ -70,6 +72,7 @@ def test_chunked_sweep_matches_single_batch():
     assert a.violating_seeds == b.violating_seeds
 
 
+@pytest.mark.deep
 def test_violating_lane_reproduces_on_host_runtime():
     # TPU face finds the seed; host face re-runs it with full debugging.
     # The injected bug lives in the TPU spec only, so use the host face as a
@@ -97,6 +100,7 @@ def test_batch_test_decorator_reads_env(monkeypatch):
     assert seen["seeds"].tolist() == list(range(100, 132))
 
 
+@pytest.mark.deep
 def test_batch_test_decorator_raises_on_violation(monkeypatch):
     monkeypatch.setenv("MADSIM_TEST_NUM", "64")
 
@@ -125,3 +129,28 @@ def test_batch_test_decorator_is_pytest_collectable():
 
     assert not hasattr(my_test, "__wrapped__")
     assert "result" not in inspect.signature(my_test).parameters
+
+
+def test_multi_device_sweep_bit_identical_to_single_device():
+    """run_batch's production path uses EVERY visible device (the
+    runtime/builder.rs:118-136 'use all the hardware' analog): on the test
+    env's forced 8-CPU mesh, the auto-mesh sweep must produce bit-identical
+    per-seed results to the unsharded run — lane-position-independent PRNG
+    guarantees a seed's trajectory doesn't depend on device placement.
+    Includes a non-divisible seed count (67 % 8 != 0) to cover the padding
+    path, and a violating spec so the equality covers found bugs too."""
+    assert len(jax.devices()) == 8  # conftest forces the virtual mesh
+    wl = raft_workload(virtual_secs=2.0, spec=buggy_raft_spec())
+    sharded = run_batch(range(67), wl, repro_on_host=False, max_traces=0)
+    single = run_batch(range(67), wl, repro_on_host=False, max_traces=0,
+                       mesh=None)
+    assert sharded.summary["n_devices"] == 8
+    assert single.summary["n_devices"] == 1
+    assert np.array_equal(sharded.violated, single.violated)
+    assert np.array_equal(sharded.deadlocked, single.deadlocked)
+    for field in ("clock", "epoch", "steps", "events", "overflow"):
+        a = np.asarray(getattr(sharded.state, field))
+        b = np.asarray(getattr(single.state, field))
+        assert np.array_equal(a, b), field
+    assert sharded.violating_seeds == single.violating_seeds
+    assert sharded.violations > 0  # the equality covered real findings

@@ -1,0 +1,121 @@
+"""Heavy-tail delay buggify (SimConfig.buggify_delay_rate): the
+net/mod.rs:287-295 analog — a fraction of messages take SECONDS instead of
+milliseconds. Extreme stragglers are a bug class uniform latency cannot
+produce (they are why FoundationDB's buggify exists); the A/B test below
+demonstrates one: an in-doubt 2PC participant that unilaterally aborts is
+perfectly safe under <= 10 ms latencies and loses atomicity the moment an
+OUTCOME rides the tail."""
+
+import dataclasses
+import pytest
+
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu.tpu import BatchedSim, SimConfig, summarize
+from madsim_tpu.tpu import twopc as tp
+
+
+def unilateral_abort_spec(n_nodes=5):
+    """The canonical WRONG 2PC participant: when its in-doubt retry timer
+    fires, it aborts the oldest unresolved yes-vote locally instead of
+    asking the coordinator (cooperative termination skipped)."""
+    spec = tp.make_twopc_spec(n_nodes)
+    inner = spec.on_timer
+
+    def on_timer(s, nid, now, key):
+        state, out, timer = inner(s, nid, now, key)
+        voted_yes = (s.v_tid >= 0) & (s.v_val == tp.COMMIT)
+        resolved = (s.v_tid == s.o_tid) & (s.o_tid >= 0)
+        doubt = voted_yes & ~resolved
+        dreq_tid = jnp.where(doubt, s.v_tid, jnp.int32(2**30)).min()
+        # only the NEWEST vote counts as "timed out" for this bug: ancient
+        # ring-recycled doubts (a benign liveness wart — the coordinator's
+        # outcome slot was reused, so a DREQ would go unanswered forever)
+        # would trigger it even at microsecond latencies and drown the A/B
+        in_doubt = (nid != 0) & doubt.any() & (dreq_tid == s.v_tid.max())
+        # the bug: record a local ABORT for the txn instead of the DREQ
+        at = jnp.arange(s.o_tid.shape[0]) == (dreq_tid % s.o_tid.shape[0])
+        fresh = in_doubt & ~(at & (s.o_tid == dreq_tid)).any()
+        w = at & fresh
+        state = state._replace(
+            o_tid=jnp.where(w, dreq_tid, state.o_tid),
+            o_val=jnp.where(w, tp.ABORT, state.o_val),
+        )
+        # suppress the DREQ it would have sent (participant side only —
+        # the coordinator's broadcasts must keep flowing)
+        out = out._replace(valid=out.valid & ~in_doubt)
+        return state, out, timer
+
+    return dataclasses.replace(spec, on_timer=on_timer)
+
+
+def quiet_config(**kw):
+    """No loss, no crashes, no partitions: the ONLY chaos is whatever
+    latency the buggify tail adds."""
+    defaults = dict(
+        horizon_us=10_000_000,
+        loss_rate=0.0,
+        msg_depth_msg=2,
+        msg_depth_timer=2,
+    )
+    defaults.update(kw)
+    return SimConfig(**defaults)
+
+
+@pytest.mark.deep
+def test_unilateral_abort_dormant_without_tail():
+    # uniform 1-10 ms latency: the OUTCOME always lands long before the
+    # 80 ms in-doubt retry, so the bug never fires — 0 violations
+    sim = BatchedSim(unilateral_abort_spec(), quiet_config())
+    state = sim.run(jnp.arange(128), max_steps=40_000)
+    s = summarize(state, sim.spec)
+    assert s["violations"] == 0, s
+
+
+@pytest.mark.deep
+def test_unilateral_abort_caught_only_by_heavy_tail():
+    # same spec, same quiet network, plus a 5% 1-5 s delay tail: an OUTCOME
+    # rides the tail, the yes-voter "times out" and aborts a committed txn,
+    # and the atomicity invariant fires. This bug class is INVISIBLE to
+    # uniform latency (see the dormant test above).
+    sim = BatchedSim(
+        unilateral_abort_spec(),
+        quiet_config(buggify_delay_rate=0.05),
+    )
+    state = sim.run(jnp.arange(128), max_steps=40_000)
+    s = summarize(state, sim.spec)
+    assert s["violations"] > 0, s
+
+
+@pytest.mark.deep
+def test_correct_spec_survives_heavy_tail():
+    # control: correct 2PC (cooperative termination) holds atomicity
+    # through the same tail chaos
+    sim = BatchedSim(
+        tp.make_twopc_spec(5),
+        quiet_config(buggify_delay_rate=0.05),
+    )
+    state = sim.run(jnp.arange(128), max_steps=40_000)
+    s = summarize(state, sim.spec)
+    assert s["violations"] == 0, s
+
+
+def test_tail_messages_actually_ride_the_side_pool():
+    sim = BatchedSim(tp.make_twopc_spec(5), quiet_config(buggify_delay_rate=0.1))
+    assert sim._B > 0
+    state = sim.init(jnp.arange(32))
+    state = sim.run_steps(state, 400)
+    # stragglers are in flight mid-run (1-5 s deliveries vs ms traffic)
+    assert bool(np.asarray(state.strag.valid).any())
+    # and their deliver times are seconds out, not milliseconds
+    pend = np.asarray(state.strag.deliver)[np.asarray(state.strag.valid)]
+    clock = np.asarray(state.clock).max()
+    assert (pend > clock + 500_000).any()
+
+
+def test_buggify_disabled_builds_no_side_pool():
+    sim = BatchedSim(tp.make_twopc_spec(5), quiet_config())
+    assert sim._B == 0
+    state = sim.init(jnp.arange(4))
+    assert state.strag is None

@@ -140,3 +140,49 @@ def test_reseeding_global_random_is_ignored_inside_sim():
         return random.random()
 
     assert rt.block_on(main()) != rt2.block_on(main2())
+
+
+def test_datetime_now_is_virtual_inside_sim():
+    """The r3 documented determinism hole, closed: datetime.datetime.now /
+    utcnow / today and datetime.date.today read the VIRTUAL clock in-sim
+    (bit-identical across runs, advancing with simulated sleeps) and the
+    real clock outside (time/system_time.rs:4-110 parity)."""
+    import datetime
+
+    rt = ms.Runtime(seed=7)
+
+    async def main():
+        a = datetime.datetime.now()
+        await ms.time.sleep(5.0)
+        b = datetime.datetime.now()
+        return a, b, datetime.datetime.utcnow(), datetime.datetime.today(), \
+            datetime.date.today()
+
+    a, b, utc, today, d = rt.block_on(main())
+    assert abs((b - a).total_seconds() - 5.0) < 0.01
+    assert today.date() == a.date()
+    assert d == a.date()
+    # bit-identical across runs of the same seed
+    rt2 = ms.Runtime(seed=7)
+    a2, b2, utc2, today2, d2 = rt2.block_on(main())
+    assert (a, b, utc, today, d) == (a2, b2, utc2, today2, d2)
+    # the virtual base date is 2022ish (reference time/mod.rs:26-36)
+    assert a.year in (2022, 2023)
+
+
+def test_datetime_passthrough_and_type_sanity_outside_sim():
+    import datetime
+
+    ms.Runtime(seed=1)  # patches installed
+    real = datetime.datetime.now()
+    wall = time.time()
+    assert abs(real.timestamp() - wall) < 5.0
+    # isinstance semantics survive the subclass install: plain instances
+    # (constructed before/after install, parsed, arithmetic results) still
+    # satisfy checks against the patched classes
+    plain = datetime.datetime(2020, 1, 2, 3, 4, 5)
+    assert isinstance(plain, datetime.datetime)
+    assert isinstance(plain, datetime.date)
+    assert isinstance(real, datetime.datetime)
+    assert isinstance(real + datetime.timedelta(days=1), datetime.datetime)
+    assert isinstance(datetime.date(2020, 1, 2), datetime.date)

@@ -1,0 +1,150 @@
+"""The exact per-key linearizability checker (tpu/linearize.py) and the
+device-side watermark oracle (kv wm_rev/wm_t): together they close the two
+r3 oracle gaps — histories that pass revision monotonicity but are not
+linearizable, and staleness whose witness op was evicted by the history
+ring (SURVEY §7 step 5 / BASELINE config #4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu.tpu import BatchedSim, SimConfig
+from madsim_tpu.tpu.kv import OP_READ, OP_WRITE, kv_workload, make_kv_spec
+from madsim_tpu.tpu.linearize import Op, check_key_history, check_lane
+from madsim_tpu.tpu.batch import run_batch
+
+
+def W(tinv, trsp, val, rev, key=0, node=0):
+    return Op(tinv=tinv, trsp=trsp, is_write=True, key=key, val=val, rev=rev,
+              node=node)
+
+
+def R(tinv, trsp, val, rev, key=0, node=0):
+    return Op(tinv=tinv, trsp=trsp, is_write=False, key=key, val=val, rev=rev,
+              node=node)
+
+
+def test_sequential_history_linearizable():
+    ops = [W(0, 1, 7, 1), R(2, 3, 7, 1), W(4, 5, 9, 2), R(6, 7, 9, 2)]
+    ok, _, unmatched = check_key_history(ops)
+    assert ok and unmatched == 0
+
+
+def test_concurrent_reads_both_orders_linearizable():
+    # two reads concurrent with a write may split across it
+    ops = [W(0, 10, 7, 1), R(1, 9, 0, 0), R(2, 8, 7, 1)]
+    ok, _, _ = check_key_history(ops)
+    assert ok
+
+
+def test_future_read_caught_despite_monotone_revisions():
+    """The r3 oracle hole: a read that returns a write's value BEFORE that
+    write was even invoked. Revisions are perfectly monotone in real time
+    (read rev 2 comes after write rev 1; the rev-2 write comes last with
+    the highest rev), so the device's pairwise check passes — only a real
+    linearizability search rejects it."""
+    ops = [
+        W(0, 1, 7, 1),
+        R(2, 3, 9, 2),  # observes value 9 ...
+        W(5, 6, 9, 2),  # ... which is only written later
+    ]
+    ok, ce, _ = check_key_history(ops)
+    assert not ok
+    assert ce is not None
+
+
+def test_stale_read_between_completed_writes_caught():
+    # w(A) then w(B) complete sequentially; a later read returning A must
+    # linearize before w(B) yet starts after it — non-linearizable
+    ops = [W(0, 1, 7, 1), W(2, 3, 9, 2), R(4, 5, 7, 1)]
+    ok, _, _ = check_key_history(ops)
+    assert not ok
+
+
+def test_read_of_unacked_write_excluded_not_flagged():
+    # value 42 has no witness write (client timed out / ring evicted):
+    # excluded from the search, counted, NOT a violation
+    ops = [W(0, 1, 7, 1), R(2, 3, 42, 5)]
+    ok, _, unmatched = check_key_history(ops)
+    assert ok and unmatched == 1
+
+
+def test_check_lane_on_real_sweep_histories():
+    # a correct-kv sweep's recorded histories are linearizable, and the
+    # checker actually consumes them (ops_checked > 0)
+    wl = kv_workload(virtual_secs=2.0)
+    sim = BatchedSim(wl.spec, wl.config)
+    state = sim.run(jnp.arange(8), max_steps=4000)
+    for lane in range(8):
+        r = check_lane(state.node, lane)
+        assert r["linearizable"], r
+    assert sum(check_lane(state.node, i)["ops_checked"] for i in range(8)) > 0
+
+
+def test_run_batch_runs_lane_check_and_reports_counts():
+    wl = kv_workload(virtual_secs=2.0)
+    result = run_batch(range(16), wl, repro_on_host=False, max_traces=0)
+    assert result.summary.get("lane_check_histories_checked", 0) > 0
+    assert result.summary.get("lane_check_violations", 0) == 0
+    assert result.summary.get("lane_check_ops_checked", 0) > 0
+
+
+def _crafted_kv_state(spec, n_lanes=1):
+    node, timer = jax.vmap(
+        jax.vmap(spec.init, in_axes=(0, 0)), in_axes=(0, None)
+    )(jnp.zeros((n_lanes, spec.n_nodes), jnp.uint32),
+      jnp.arange(spec.n_nodes, dtype=jnp.int32))
+    return node
+
+
+def test_watermark_catches_stale_read_after_ring_wrap():
+    """The r3 coverage hole: the pairwise check only sees retained ring
+    entries, so a stale read whose high-rev witness was EVICTED passed.
+    The per-(node,key) watermark keeps the max-rev evidence forever."""
+    spec = make_kv_spec(n_nodes=3, ops_capacity=4)
+    node = _crafted_kv_state(spec)
+    alive = jnp.ones((3,), jnp.bool_)
+    ok = lambda n: bool(spec.check_invariants(
+        jax.tree_util.tree_map(lambda x: x[0], n), alive, jnp.int32(10_000)
+    ))
+
+    # node 1's ring holds ONLY a stale read: key 0, rev 3, invoked at
+    # t=2000 — no other ring entry anywhere (the rev-50 write that makes it
+    # stale was evicted long ago). Pairwise check alone cannot object.
+    node = node._replace(
+        h_kind=node.h_kind.at[0, 1, 0].set(OP_READ),
+        h_key=node.h_key.at[0, 1, 0].set(0),
+        h_val=node.h_val.at[0, 1, 0].set(7),
+        h_rev=node.h_rev.at[0, 1, 0].set(3),
+        h_tinv=node.h_tinv.at[0, 1, 0].set(2_000),
+        h_trsp=node.h_trsp.at[0, 1, 0].set(2_100),
+        h_len=node.h_len.at[0, 1].set(9),  # wrapped: 9 > OPS=4
+    )
+    assert ok(node)  # without the watermark evidence, nothing to object to
+
+    # node 0 acked rev 50 on key 0 at t=1000 (the op itself evicted; only
+    # the watermark survives). The read invoked at 2000 with rev 3 is now
+    # provably stale.
+    stale = node._replace(
+        wm_rev=node.wm_rev.at[0, 0, 0].set(50),
+        wm_t=node.wm_t.at[0, 0, 0].set(1_000),
+    )
+    assert not ok(stale)
+
+    # same watermark but established AFTER the read's invocation: the read
+    # may legitimately linearize before it — no violation
+    later = node._replace(
+        wm_rev=node.wm_rev.at[0, 0, 0].set(50),
+        wm_t=node.wm_t.at[0, 0, 0].set(2_050),
+    )
+    assert ok(later)
+
+
+def test_watermark_tracks_acked_ops_in_sweep():
+    # after a real sweep, watermarks reflect acked writes (nonzero), and a
+    # correct protocol violates nothing
+    wl = kv_workload(virtual_secs=2.0)
+    sim = BatchedSim(wl.spec, wl.config)
+    state = sim.run(jnp.arange(8), max_steps=4000)
+    assert int(np.asarray(state.node.wm_rev).max()) > 0
+    assert int(np.asarray(state.violated).sum()) == 0

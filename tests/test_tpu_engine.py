@@ -568,6 +568,7 @@ def test_leader_completeness_invariant_crafted_states():
     assert not ok(divergent)
 
 
+@pytest.mark.deep
 def test_unsafe_election_bug_caught_by_leader_completeness():
     """Injected bug: voters grant votes WITHOUT the log up-to-date check
     (Raft §5.4.1's election restriction removed). Candidates behind the

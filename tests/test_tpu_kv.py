@@ -7,6 +7,7 @@ injected stale-read bug caught ONLY when partition chaos is on.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -55,6 +56,7 @@ def test_kv_elects_primary_and_serves_ops():
     assert (kinds == 1).any() and (kinds == 2).any()
 
 
+@pytest.mark.deep
 def test_kv_safe_under_partitions_and_loss():
     sim = BatchedSim(make_kv_spec(5), partition_config())
     state = sim.run(jnp.arange(64), max_steps=60_000)
@@ -82,6 +84,7 @@ def test_kv_safe_under_crash_restart():
     assert s["violations"] == 0
 
 
+@pytest.mark.deep
 def test_kv_stale_read_bug_caught_only_under_partitions():
     """The headline bug-catching demo (VERDICT r2 'done' criterion): local
     reads without a quorum probe are indistinguishable from correct behavior
@@ -108,6 +111,7 @@ def test_kv_stale_read_bug_caught_only_under_partitions():
     )
 
 
+@pytest.mark.deep
 def test_kv_determinism():
     sim = BatchedSim(make_kv_spec(5), partition_config())
     a = sim.run(jnp.arange(16), max_steps=40_000)
@@ -124,6 +128,7 @@ def test_kv_workload_run_batch():
     assert result.summary["mean_acked_ops"] > 0
 
 
+@pytest.mark.deep
 def test_kv_mandate_recovery_regression_wide_sweep():
     """The fuzz-found stale-serve bug (round 3, seed 2484 of the 2048-lane
     bench sweep): replicas apply writes on receive, so a claim quorum can

@@ -6,6 +6,7 @@ bug-detection validated by injecting the canonical wrong implementation.
 """
 
 import dataclasses
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,7 @@ def full_chaos(**kw):
     return SimConfig(**cfg)
 
 
+@pytest.mark.deep
 def test_twopc_safe_under_full_chaos():
     """Atomicity + vote respect hold across loss, crashes (coordinator
     included — the blocking case) and partitions, while real work happens
@@ -47,6 +49,7 @@ def test_twopc_safe_under_full_chaos():
     assert s["mean_decided_txns"] > 20  # the fuzz isn't frozen
 
 
+@pytest.mark.deep
 def test_twopc_commits_and_aborts_both_happen():
     """Both outcomes occur across the sweep (vote_yes_p < 1 plus chaos):
     a fuzz that only ever aborts — or only ever commits — tests nothing."""
@@ -60,6 +63,7 @@ def test_twopc_commits_and_aborts_both_happen():
     assert aborts > 100, int(aborts)
 
 
+@pytest.mark.deep
 def test_twopc_determinism():
     sim = BatchedSim(make_twopc_spec(5), full_chaos())
     a = sim.run(jnp.arange(32), max_steps=30_000)
@@ -68,6 +72,7 @@ def test_twopc_determinism():
         assert (np.asarray(la) == np.asarray(lb)).all()
 
 
+@pytest.mark.deep
 def test_twopc_unilateral_abort_bug_caught():
     """The canonical wrong 2PC implementation: an in-doubt participant
     gets impatient and unilaterally aborts instead of running cooperative
@@ -106,6 +111,7 @@ def test_twopc_unilateral_abort_bug_caught():
     assert summarize(state)["violations"] > 0
 
 
+@pytest.mark.deep
 def test_twopc_workload_run_batch_smoke():
     """twopc_workload stays wired into run_batch (the kv_workload pattern):
     a small sweep completes clean with nothing dropped outside loss_rate."""

@@ -7,6 +7,7 @@ captured trace alone — no host twin — is enough to see the bug mechanics.
 """
 
 import dataclasses
+import pytest
 
 import jax.numpy as jnp
 import numpy as np
@@ -57,6 +58,7 @@ def split_brain_spec():
     return dataclasses.replace(spec, on_message=buggy_append_resp)
 
 
+@pytest.mark.deep
 def test_trace_matches_batch_lane_bitwise():
     # the traced single-lane rerun is the SAME trajectory as the batch lane:
     # seeds, not lane positions, drive all randomness
@@ -73,6 +75,7 @@ def test_trace_matches_batch_lane_bitwise():
         assert np.array_equal(leaf_b, leaf_s)
 
 
+@pytest.mark.deep
 def test_trace_is_deterministic():
     sim = BatchedSim(make_raft_spec(3), partition_config(horizon_us=1_000_000))
     a = trace_seed(sim, 123, max_steps=4_000)
@@ -81,6 +84,7 @@ def test_trace_is_deterministic():
     assert len(a) > 10
 
 
+@pytest.mark.deep
 def test_debug_split_brain_from_trace_alone():
     """run_batch on the buggy spec attaches a device trace for a violating
     seed; the trace alone shows the bug mechanics: a partition splits the
@@ -138,6 +142,7 @@ def test_debug_split_brain_from_trace_alone():
     )
 
 
+@pytest.mark.deep
 def test_trace_records_crash_restart():
     sim = BatchedSim(
         make_raft_spec(5),

@@ -5,7 +5,7 @@ The reference measures (criterion, std mode): empty RPC round-trip latency
 (rpc.rs:28-53) over its real TCP backend. Same harness here, over BOTH real
 transports (std/net/mod.rs:33-38 selection analog):
 
-    python benches/rpc_bench.py [--rounds 2000] [--backends tcp,uds]
+    python benches/rpc_bench.py [--rounds 2000] [--backends tcp,uds,shm]
 
 Prints one JSON line per (backend, measurement).
 """
@@ -35,7 +35,7 @@ class Echo:
 
 async def _bench_backend(backend: str, rounds: int, uds_dir: str) -> list:
     os.environ["MADSIM_NET_BACKEND"] = backend
-    if backend == "uds":
+    if backend in ("uds", "shm"):
         os.environ["MADSIM_UDS_DIR"] = uds_dir
 
     from madsim_tpu.net import Endpoint
@@ -95,7 +95,7 @@ async def _bench_backend(backend: str, rounds: int, uds_dir: str) -> list:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=2000)
-    parser.add_argument("--backends", default="tcp,uds")
+    parser.add_argument("--backends", default="tcp,uds,shm")
     args = parser.parse_args()
 
     with tempfile.TemporaryDirectory(prefix="rpcbench-") as uds_dir:

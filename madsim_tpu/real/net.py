@@ -2,14 +2,12 @@
 
 Analog of reference std/net/tcp.rs:22-325 (the production backend of the
 same Endpoint API): every peer pair communicates over stream connections
-carrying 4-byte-length-prefixed pickled frames (the LengthDelimitedCodec
-analog). Two connection kinds, declared by a hello frame:
+carrying length-prefixed TYPED frames (the LengthDelimitedCodec analog).
+Two connection kinds, declared by a hello frame:
 
-    ("dgram", sender_addr)   — a cached pipe for tagged datagrams
-                               (frames: (tag, payload)); replies go to the
-                               sender's advertised bound address
-    ("conn1", sender_addr)   — one reliable ordered stream (connect1/accept1),
-                               frames are raw payloads
+    dgram   — a cached pipe for tagged datagrams; replies go to the
+              sender's advertised bound address
+    conn1   — one reliable ordered stream (connect1/accept1)
 
 The mailbox tag-matching, rpc layer, and the gRPC facade are byte-for-byte
 the same code as in simulation — only this transport differs.
@@ -22,8 +20,32 @@ chooses TCP / UCX RDMA (ucx.rs) / eRPC (erpc.rs) by cargo feature): the
     tcp   (default) asyncio TCP; works cross-host
     uds   Unix domain sockets: each logical address maps to a socket path
           under MADSIM_UDS_DIR (default /tmp/madsim-uds-<uid>); a lower-
-          latency same-host path, filling the role UCX fills intra-cluster
-          (a faster fabric behind an unchanged Endpoint API)
+          latency same-host path
+    shm   uds doorbell + shared-memory bulk data plane (real/shm.py): a
+          frame body >= MADSIM_SHM_INLINE (default 256 B) is written to a
+          per-connection-direction SPSC ring and only an (offset, length)
+          descriptor rides the socket — the same-host stand-in for the
+          reference's RDMA-class fabrics (std/net/ucx.rs, erpc.rs).
+          Honest measurement (benches/rpc_bench.py): in pure Python the
+          kernel's UDS copy path already wins — shm completes the
+          selectable-fabric architecture (and is the hook for a native
+          data plane), it is not currently the fastest wire. The
+          reference's ucx.rs is likewise feature-gated experimental and
+          erpc.rs is a commented-out dependency (std/net/mod.rs:33-38).
+
+Frame codec (`MADSIM_NET_CODEC`):
+
+    pickle  (default) frame bodies are pickled Python objects — full API
+            surface (rpc, gRPC facade, arbitrary payloads), but BOTH ENDS
+            MUST BE TRUSTED: pickle.loads on network input executes code,
+            so use it only between peers you control (the reference's
+            serde codec makes no such trade; this one buys the ability to
+            ship the sim ecosystem's object payloads unchanged)
+    bytes   frame bodies are raw bytes with struct headers — no pickle on
+            the wire in either direction, safe across trust boundaries
+            and cross-language-friendly; supports the bytes Endpoint API
+            (send_to/recv_from/connect1 with bytes payloads). The object
+            layers (rpc.call, gRPC facade) need the pickle codec.
 """
 
 from __future__ import annotations
@@ -37,15 +59,35 @@ from typing import Any, Dict, Optional, Tuple
 from ..core.sync import Channel, ChannelClosed
 from ..net.addr import SocketAddr, ToSocketAddrs, lookup_host
 from ..net.endpoint import Mailbox, _Message
+from .shm import ShmRing
 
 _LEN = struct.Struct(">I")
+_DESC = struct.Struct(">QI")  # ring offset, body length
+_TAG = struct.Struct(">Q")
+_HELLO_BYTES = struct.Struct(">BH")  # conn kind, host len (then port, name)
+
+# frame types
+T_HELLO, T_DGRAM, T_PAYLOAD, T_DGRAM_SHM, T_PAYLOAD_SHM, T_HELLO_ACK = range(6)
 
 
 def _backend() -> str:
     be = os.environ.get("MADSIM_NET_BACKEND", "tcp")
-    if be not in ("tcp", "uds"):
-        raise ValueError(f"MADSIM_NET_BACKEND={be!r}: expected 'tcp' or 'uds'")
+    if be not in ("tcp", "uds", "shm"):
+        raise ValueError(
+            f"MADSIM_NET_BACKEND={be!r}: expected 'tcp', 'uds' or 'shm'"
+        )
     return be
+
+
+def _codec() -> str:
+    c = os.environ.get("MADSIM_NET_CODEC", "pickle")
+    if c not in ("pickle", "bytes"):
+        raise ValueError(f"MADSIM_NET_CODEC={c!r}: expected 'pickle' or 'bytes'")
+    return c
+
+
+def _shm_threshold() -> int:
+    return int(os.environ.get("MADSIM_SHM_INLINE", "256"))
 
 
 _checked_uds_dirs: set = set()
@@ -97,37 +139,155 @@ async def _uds_claim(path: str) -> None:
 
 async def _open_stream(dst: SocketAddr):
     """(reader, writer) toward a logical address over the selected wire."""
-    if _backend() == "uds":
+    if _backend() in ("uds", "shm"):
         return await asyncio.open_unix_connection(_uds_path(dst))
     return await asyncio.open_connection(dst[0], dst[1])
 
 
-def _write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
-    data = pickle.dumps(obj)
-    writer.write(_LEN.pack(len(data)) + data)
+# ------------------------------------------------------------------ framing
+# wire frame := u32 body-length | u8 type | body. SHM descriptor bodies are
+# struct-fixed (codec-independent); the other bodies go through the codec.
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Any:
+def _send_frame(writer: asyncio.StreamWriter, ftype: int, body: bytes) -> None:
+    writer.write(_LEN.pack(len(body) + 1) + bytes([ftype]) + body)
+
+
+async def _read_raw(reader: asyncio.StreamReader) -> Tuple[int, bytes]:
     try:
         header = await reader.readexactly(_LEN.size)
         data = await reader.readexactly(_LEN.unpack(header)[0])
     except (asyncio.IncompleteReadError, ConnectionError):
         raise ChannelClosed("connection closed") from None
-    return pickle.loads(data)
+    if not data:  # zero-length frame: malformed peer, treat as closed
+        raise ChannelClosed("malformed frame (empty)")
+    return data[0], data[1:]
+
+
+def _decode_or_close(fn, body):
+    """Peer bytes are untrusted input: any parse failure is a clean
+    ChannelClosed for the caller, never a struct.error/IndexError escaping
+    into application code."""
+    try:
+        return fn(body)
+    except (struct.error, IndexError, UnicodeDecodeError, ValueError,
+            pickle.UnpicklingError, EOFError) as e:
+        raise ChannelClosed(f"malformed frame: {e}") from None
+
+
+def _require_bytes(data: Any) -> bytes:
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise TypeError(
+            "MADSIM_NET_CODEC=bytes carries bytes payloads only (object "
+            "payloads — rpc/gRPC — need the pickle codec and mutual trust)"
+        )
+    return bytes(data)
+
+
+def _enc_dgram(tag: int, data: Any, codec: str) -> bytes:
+    if codec == "bytes":
+        return _TAG.pack(tag) + _require_bytes(data)
+    return pickle.dumps((tag, data))
+
+
+def _dec_dgram(body: bytes, codec: str) -> Tuple[int, Any]:
+    if codec == "bytes":
+        return _TAG.unpack_from(body)[0], body[_TAG.size :]
+    return pickle.loads(body)
+
+
+def _enc_payload(obj: Any, codec: str) -> bytes:
+    if codec == "bytes":
+        return _require_bytes(obj)
+    return pickle.dumps(obj)
+
+
+def _dec_payload(body: bytes, codec: str) -> Any:
+    if codec == "bytes":
+        return body
+    return pickle.loads(body)
+
+
+def _enc_hello(kind: str, addr: SocketAddr, shm_name: str, codec: str) -> bytes:
+    if codec == "bytes":
+        host = addr[0].encode()
+        name = shm_name.encode()
+        return (
+            _HELLO_BYTES.pack(0 if kind == "dgram" else 1, len(host))
+            + host
+            + struct.pack(">IH", addr[1], len(name))
+            + name
+        )
+    return pickle.dumps((kind, addr, shm_name))
+
+
+def _dec_hello(body: bytes, codec: str) -> Tuple[str, SocketAddr, str]:
+    if codec == "bytes":
+        k, hlen = _HELLO_BYTES.unpack_from(body)
+        off = _HELLO_BYTES.size
+        host = body[off : off + hlen].decode()
+        port, nlen = struct.unpack_from(">IH", body, off + hlen)
+        off += hlen + 6
+        return ("dgram" if k == 0 else "conn1", (host, port),
+                body[off : off + nlen].decode())
+    kind, addr, shm_name = pickle.loads(body)
+    return kind, tuple(addr), shm_name
+
+
+def _enc_hello_ack(shm_name: str) -> bytes:
+    return shm_name.encode()
+
+
+def _dec_hello_ack(body: bytes) -> str:
+    return body.decode()
+
+
+def _new_tx_ring() -> Optional[ShmRing]:
+    if _backend() != "shm":
+        return None
+    return ShmRing.create(int(os.environ.get("MADSIM_SHM_RING", str(1 << 20))))
+
+
+def _send_body(
+    writer: asyncio.StreamWriter,
+    ring: Optional[ShmRing],
+    inline_type: int,
+    shm_type: int,
+    body: bytes,
+    thresh: int,
+) -> None:
+    """Body via the shm ring when it's attached, big enough, and has room;
+    inline on the socket otherwise (the ring is never a correctness
+    dependency)."""
+    if ring is not None and len(body) >= thresh:
+        desc = ring.try_write(body)
+        if desc is not None:
+            _send_frame(writer, shm_type, _DESC.pack(*desc))
+            return
+    _send_frame(writer, inline_type, body)
 
 
 class RealPayloadSender:
-    """PayloadSender-compatible send half over a TCP stream."""
+    """PayloadSender-compatible send half over a stream (+ optional ring)."""
 
-    __slots__ = ("_writer",)
+    __slots__ = ("_writer", "_ring", "_codec", "_thresh")
 
-    def __init__(self, writer: asyncio.StreamWriter) -> None:
+    def __init__(
+        self, writer: asyncio.StreamWriter, ring: Optional[ShmRing] = None,
+        codec: Optional[str] = None, thresh: Optional[int] = None,
+    ) -> None:
         self._writer = writer
+        self._ring = ring
+        self._codec = codec if codec is not None else _codec()
+        self._thresh = thresh if thresh is not None else _shm_threshold()
 
     def send(self, payload: Any) -> None:
         if self._writer.is_closing():
             raise ChannelClosed("connection closed")
-        _write_frame(self._writer, payload)
+        _send_body(
+            self._writer, self._ring, T_PAYLOAD, T_PAYLOAD_SHM,
+            _enc_payload(payload, self._codec), self._thresh,
+        )
 
     def is_closed(self) -> bool:
         return self._writer.is_closing()
@@ -137,21 +297,39 @@ class RealPayloadSender:
             self._writer.close()
         except Exception:
             pass
+        if self._ring is not None:
+            self._ring.close()
 
 
 class RealPayloadReceiver:
-    """PayloadReceiver-compatible receive half over a TCP stream."""
+    """PayloadReceiver-compatible receive half over a stream (+ ring)."""
 
-    __slots__ = ("_reader", "_writer")
+    __slots__ = ("_reader", "_writer", "_ring", "_codec")
 
     def __init__(
-        self, reader: asyncio.StreamReader, writer: Optional[asyncio.StreamWriter]
+        self,
+        reader: asyncio.StreamReader,
+        writer: Optional[asyncio.StreamWriter],
+        ring: Optional[ShmRing] = None,
+        codec: Optional[str] = None,
     ) -> None:
         self._reader = reader
         self._writer = writer
+        self._ring = ring
+        self._codec = codec if codec is not None else _codec()
 
     async def recv(self) -> Any:
-        return await _read_frame(self._reader)
+        ftype, body = await _read_raw(self._reader)
+        if ftype == T_PAYLOAD_SHM and self._ring is not None:
+            off, length = _decode_or_close(_DESC.unpack, body)
+            body = _decode_or_close(
+                lambda _b: self._ring.read(off, length), body
+            )
+        elif ftype != T_PAYLOAD:
+            raise ChannelClosed(f"unexpected frame type {ftype} on conn1")
+        return _decode_or_close(
+            lambda b: _dec_payload(b, self._codec), body
+        )
 
     async def try_recv_eof(self) -> Optional[Any]:
         try:
@@ -165,20 +343,26 @@ class RealPayloadReceiver:
                 self._writer.close()
             except Exception:
                 pass
+        if self._ring is not None:
+            self._ring.close()
 
 
 class RealEndpoint:
     """The Endpoint API over real sockets (duck-type of net.Endpoint)."""
 
     def __init__(self) -> None:
+        self._codec = _codec()  # captured once: no env reads per message
+        self._thresh = _shm_threshold()
         self._mailbox = Mailbox()
         self._conn_chan: Channel = Channel()  # (tx, rx, peer_addr)
         self._server: Optional[asyncio.AbstractServer] = None
         self._addr: Optional[SocketAddr] = None
         self._peer: Optional[SocketAddr] = None
         self._uds_path: Optional[str] = None  # owned socket file (uds backend)
-        # dst -> (writer, pipe task) cache for datagram pipes
-        self._pipes: Dict[SocketAddr, asyncio.StreamWriter] = {}
+        # dst -> (writer, tx ring | None) cache for datagram pipes
+        self._pipes: Dict[
+            SocketAddr, Tuple[asyncio.StreamWriter, Optional[ShmRing]]
+        ] = {}
 
     # -- constructors --
 
@@ -186,7 +370,7 @@ class RealEndpoint:
     async def bind(addr: ToSocketAddrs) -> "RealEndpoint":
         host, port = await lookup_host(addr)
         ep = RealEndpoint()
-        if _backend() == "uds":
+        if _backend() in ("uds", "shm"):
             if port == 0:
                 # no OS port allocator for paths: reserve a logical port
                 # with an O_EXCL lock file (atomic, so concurrent binds in
@@ -238,7 +422,7 @@ class RealEndpoint:
         actually picked toward that peer) with our server's listen port.
         """
         host, port = self.local_addr()
-        if host in ("0.0.0.0", "::") and _backend() != "uds":
+        if host in ("0.0.0.0", "::") and _backend() == "tcp":
             # (uds: the logical tuple IS the address — it names a same-host
             # socket path, so the wildcard host needs no rewriting)
             sockname = writer.get_extra_info("sockname")
@@ -268,11 +452,13 @@ class RealEndpoint:
                 except OSError:
                     pass
             self._uds_path = None
-        for w in self._pipes.values():
+        for w, ring in self._pipes.values():
             try:
                 w.close()
             except Exception:
                 pass
+            if ring is not None:
+                ring.close()
         self._pipes.clear()
         self._conn_chan.close()
 
@@ -288,26 +474,64 @@ class RealEndpoint:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         try:
-            hello = await _read_frame(reader)
+            ftype, body = await _read_raw(reader)
         except ChannelClosed:
             writer.close()
             return
-        kind, sender_addr = hello
+        if ftype != T_HELLO:
+            writer.close()
+            return
+        try:
+            kind, sender_addr, shm_name = _decode_or_close(
+                lambda b: _dec_hello(b, self._codec), body
+            )
+            rx_ring = ShmRing.attach(shm_name) if shm_name else None
+        except (ChannelClosed, FileNotFoundError, OSError):
+            writer.close()
+            return
         if kind == "conn1":
-            tx = RealPayloadSender(writer)
-            rx = RealPayloadReceiver(reader, writer)
+            # duplex shm: ack with our own tx ring so both directions ride
+            # the fast path (non-shm backends skip the ack round-trip)
+            tx_ring = _new_tx_ring()
+            if _backend() == "shm":
+                _send_frame(
+                    writer, T_HELLO_ACK,
+                    _enc_hello_ack(tx_ring.name if tx_ring else ""),
+                )
+            tx = RealPayloadSender(writer, tx_ring, self._codec, self._thresh)
+            rx = RealPayloadReceiver(reader, writer, rx_ring, self._codec)
             try:
                 self._conn_chan.send_nowait((tx, rx, tuple(sender_addr)))
             except (ChannelClosed, RuntimeError):
+                tx.close()
+                rx.close()
                 writer.close()
             return
         # datagram pipe: pump frames into the mailbox
         from_addr = tuple(sender_addr)
         while True:
             try:
-                tag, payload = await _read_frame(reader)
+                ftype, body = await _read_raw(reader)
             except ChannelClosed:
                 writer.close()
+                if rx_ring is not None:
+                    rx_ring.close()
+                return
+            try:
+                if ftype == T_DGRAM_SHM and rx_ring is not None:
+                    off, length = _decode_or_close(_DESC.unpack, body)
+                    body = _decode_or_close(
+                        lambda _b: rx_ring.read(off, length), body
+                    )
+                elif ftype != T_DGRAM:
+                    continue  # tolerate unknown frame types on the pipe
+                tag, payload = _decode_or_close(
+                    lambda b: _dec_dgram(b, self._codec), body
+                )
+            except ChannelClosed:
+                writer.close()
+                if rx_ring is not None:
+                    rx_ring.close()
                 return
             self._mailbox.deliver(_Message(tag, payload, from_addr))
 
@@ -337,12 +561,22 @@ class RealEndpoint:
         return data
 
     async def send_to_raw(self, dst: SocketAddr, tag: int, data: Any) -> None:
-        writer = self._pipes.get(dst)
-        if writer is None or writer.is_closing():
+        pipe = self._pipes.get(dst)
+        if pipe is None or pipe[0].is_closing():
+            if pipe is not None and pipe[1] is not None:
+                pipe[1].close()  # dead pipe's ring must not leak /dev/shm
             reader, writer = await _open_stream(dst)
-            _write_frame(writer, ("dgram", self._advertised(writer)))
-            self._pipes[dst] = writer
-        _write_frame(writer, (tag, data))
+            ring = _new_tx_ring()
+            _send_frame(
+                writer, T_HELLO,
+                _enc_hello("dgram", self._advertised(writer),
+                           ring.name if ring else "", self._codec),
+            )
+            pipe = (writer, ring)
+            self._pipes[dst] = pipe
+        writer, ring = pipe
+        _send_body(writer, ring, T_DGRAM, T_DGRAM_SHM,
+                   _enc_dgram(tag, data, self._codec), self._thresh)
         await writer.drain()
 
     async def recv_from_raw(self, tag: int) -> Tuple[Any, SocketAddr]:
@@ -359,10 +593,30 @@ class RealEndpoint:
     ) -> Tuple[RealPayloadSender, RealPayloadReceiver, SocketAddr]:
         resolved = await lookup_host(dst)
         reader, writer = await _open_stream(resolved)
-        _write_frame(writer, ("conn1", self._advertised(writer)))
+        tx_ring = _new_tx_ring()
+        _send_frame(
+            writer, T_HELLO,
+            _enc_hello("conn1", self._advertised(writer),
+                       tx_ring.name if tx_ring else "", self._codec),
+        )
+        rx_ring = None
+        if _backend() == "shm":
+            # the acceptor acks with its own ring name (duplex shm); other
+            # backends skip the round-trip — the ack would always be empty
+            try:
+                ftype, body = await _read_raw(reader)
+                if ftype == T_HELLO_ACK:
+                    name = _decode_or_close(_dec_hello_ack, body)
+                    if name:
+                        rx_ring = ShmRing.attach(name)
+            except (ChannelClosed, FileNotFoundError, OSError):
+                if tx_ring is not None:
+                    tx_ring.close()
+                writer.close()
+                raise ChannelClosed("conn1 handshake failed") from None
         return (
-            RealPayloadSender(writer),
-            RealPayloadReceiver(reader, writer),
+            RealPayloadSender(writer, tx_ring, self._codec, self._thresh),
+            RealPayloadReceiver(reader, writer, rx_ring, self._codec),
             resolved,
         )
 

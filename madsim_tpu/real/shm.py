@@ -1,0 +1,131 @@
+"""SPSC shared-memory ring: the data plane of the `shm` net backend.
+
+The reference ships RDMA-class intra-cluster fabrics behind the same
+Endpoint API (std/net/ucx.rs UCX tag-matching, std/net/erpc.rs verbs);
+actual RDMA hardware is out of scope here, so the same-host analog is a
+shared-memory bulk-data path: each connection direction gets one
+single-producer single-consumer byte ring in a POSIX shared-memory
+segment, and the Unix socket that carries small frames doubles as the
+doorbell — a descriptor frame (offset, length) tells the reader where the
+bulk body landed, and the socket's FIFO ordering is the memory barrier
+between the producer's copy and the consumer's read.
+
+Flow control is one shared u64: the CONSUMED counter (reader-owned cell at
+offset 0); the producer keeps its PRODUCED counter privately and refuses a
+write that would overlap unconsumed bytes (the caller then falls back to
+sending the body inline on the socket — the ring is an optimization, never
+a correctness dependency). Offsets in descriptors are logical (monotonic);
+positions wrap modulo the capacity with two-part copies.
+
+Trust boundary: shm is a SAME-USER fabric. The doorbell sockets live in a
+0700 directory and the segments are created 0600, so only same-UID
+processes can connect or attach — and a same-UID peer is inside your trust
+domain on any OS (it can ptrace you). Cross-trust transport is the `bytes`
+codec over tcp, not this backend.
+"""
+
+from __future__ import annotations
+
+import secrets
+import struct
+from multiprocessing import shared_memory
+from typing import Optional, Tuple
+
+_U64 = struct.Struct("<Q")
+HEADER = 8  # consumed counter
+DEFAULT_RING = 1 << 20  # 1 MiB per direction
+
+
+class ShmRing:
+    """One direction's byte ring. Create on the sending side, attach on
+    the receiving side (the segment name travels in the connection hello).
+    """
+
+    def __init__(self, shm: shared_memory.SharedMemory, owner: bool) -> None:
+        self._shm = shm
+        self._owner = owner
+        self._cap = shm.size - HEADER
+        self._produced = 0  # writer-private
+        self._closed = False
+
+    # -- lifecycle --
+
+    @classmethod
+    def create(cls, size: int = DEFAULT_RING) -> "ShmRing":
+        shm = shared_memory.SharedMemory(
+            create=True, size=size + HEADER, name=f"madsim_{secrets.token_hex(8)}"
+        )
+        shm.buf[:HEADER] = b"\x00" * HEADER
+        return cls(shm, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        return cls(shared_memory.SharedMemory(name=name), owner=False)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._shm.close()
+            if self._owner:
+                self._shm.unlink()
+        except (OSError, ValueError):
+            pass
+
+    # -- producer side --
+
+    def _consumed(self) -> int:
+        return _U64.unpack_from(self._shm.buf, 0)[0]
+
+    def try_write(self, data: bytes) -> Optional[Tuple[int, int]]:
+        """Copy `data` in; returns (logical offset, length) for the
+        descriptor frame, or None when the ring lacks space (caller sends
+        inline instead)."""
+        if self._closed:
+            return None
+        n = len(data)
+        if n == 0 or n > self._cap:
+            return None
+        free = self._cap - (self._produced - self._consumed())
+        if n > free:
+            return None
+        off = self._produced
+        pos = off % self._cap
+        first = min(n, self._cap - pos)
+        buf = self._shm.buf
+        buf[HEADER + pos : HEADER + pos + first] = data[:first]
+        if first < n:
+            buf[HEADER : HEADER + n - first] = data[first:]
+        self._produced = off + n
+        return off, n
+
+    # -- consumer side --
+
+    def read(self, off: int, length: int) -> bytes:
+        """Copy a descriptor's body out and release its bytes.
+
+        Descriptors come off the wire: validate before touching the ring —
+        a malformed (off, length) must close the connection (ValueError,
+        mapped to ChannelClosed by the caller), never index out of range
+        or wreck the flow-control counter."""
+        if self._closed or length <= 0 or length > self._cap:
+            raise ValueError(f"bad shm descriptor: off={off} len={length}")
+        pos = off % self._cap
+        first = min(length, self._cap - pos)
+        buf = self._shm.buf
+        out = bytes(buf[HEADER + pos : HEADER + pos + first])
+        if first < length:
+            out += bytes(buf[HEADER : HEADER + length - first])
+        # descriptors arrive in FIFO socket order == ring order, so
+        # consumption is contiguous: release through the end of this body
+        _U64.pack_into(buf, 0, off + length)
+        return out

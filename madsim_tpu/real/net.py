@@ -566,14 +566,22 @@ class RealEndpoint:
             if pipe is not None and pipe[1] is not None:
                 pipe[1].close()  # dead pipe's ring must not leak /dev/shm
             reader, writer = await _open_stream(dst)
-            ring = _new_tx_ring()
-            _send_frame(
-                writer, T_HELLO,
-                _enc_hello("dgram", self._advertised(writer),
-                           ring.name if ring else "", self._codec),
-            )
-            pipe = (writer, ring)
-            self._pipes[dst] = pipe
+            # two tasks may race past the cache miss (the open is a
+            # suspension point): the loser must close its writer AND its
+            # would-be ring, not leak a /dev/shm segment per race
+            raced = self._pipes.get(dst)
+            if raced is not None and not raced[0].is_closing():
+                writer.close()
+                pipe = raced
+            else:
+                ring = _new_tx_ring()
+                _send_frame(
+                    writer, T_HELLO,
+                    _enc_hello("dgram", self._advertised(writer),
+                               ring.name if ring else "", self._codec),
+                )
+                pipe = (writer, ring)
+                self._pipes[dst] = pipe
         writer, ring = pipe
         _send_body(writer, ring, T_DGRAM, T_DGRAM_SHM,
                    _enc_dgram(tag, data, self._codec), self._thresh)

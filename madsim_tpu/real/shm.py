@@ -46,6 +46,7 @@ class ShmRing:
         self._owner = owner
         self._cap = shm.size - HEADER
         self._produced = 0  # writer-private
+        self._expected = 0  # reader-private: next descriptor's offset
         self._closed = False
 
     # -- lifecycle --
@@ -116,9 +117,16 @@ class ShmRing:
         Descriptors come off the wire: validate before touching the ring —
         a malformed (off, length) must close the connection (ValueError,
         mapped to ChannelClosed by the caller), never index out of range
-        or wreck the flow-control counter."""
-        if self._closed or length <= 0 or length > self._cap:
+        or wreck the flow-control counter. Ring consumption is contiguous
+        (socket FIFO == ring order), so the only legal offset is the
+        reader's own cursor; anything else is a corrupt/replayed
+        descriptor."""
+        if (
+            self._closed or length <= 0 or length > self._cap
+            or off != self._expected
+        ):
             raise ValueError(f"bad shm descriptor: off={off} len={length}")
+        self._expected = off + length
         pos = off % self._cap
         first = min(length, self._cap - pos)
         buf = self._shm.buf

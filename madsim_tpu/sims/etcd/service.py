@@ -316,6 +316,19 @@ class ServiceInner:
         self.lease: Dict[int, _Lease] = {}
         self.watcher = EventBus()
         self._txn_depth = 0  # >0: inside a txn; ops share ONE revision
+        # MVCC history for get(revision=N): per key, (mod_revision,
+        # KeyValue-or-None) versions in order; None is a delete tombstone.
+        # The reference leaves historical reads as todo!() (service.rs:325);
+        # this sim implements them — a snapshot load() compacts history
+        # away, and reads below the compaction point raise like real etcd.
+        self.history: Dict[bytes, list] = {}
+        self.compacted: int = 0
+
+    def _hist_put(self, kv: KeyValue) -> None:
+        self.history.setdefault(kv.key, []).append((kv.mod_revision, kv))
+
+    def _hist_del(self, key: Key) -> None:
+        self.history.setdefault(key, []).append((self.revision, None))
 
     # -- header
 
@@ -346,17 +359,45 @@ class ServiceInner:
             mod_revision=self.revision,
         )
         self.kv[key] = kv
+        self._hist_put(kv)
         self.watcher.publish(Event(EventType.PUT, kv))
         return PutResponse(header=self.header(), prev_kv=prev if prev_kv else None)
 
     def get(self, key: Key, prefix: bool = False, revision: int = 0) -> GetResponse:
         if revision > 0:
-            raise EtcdError("get with revision is not supported")  # ref todo!() :325
+            return self._get_at(key, prefix, revision)
         if prefix:
             kvs = [self.kv[k] for k in sorted(self.kv) if k.startswith(key)]
         else:
             kvs = [self.kv[key]] if key in self.kv else []
         return GetResponse(header=self.header(), kvs=list(kvs))
+
+    def _get_at(self, key: Key, prefix: bool, revision: int) -> GetResponse:
+        """Historical read at a past revision, from the MVCC history.
+
+        The reference panics here (service.rs:325 todo!()); real etcd
+        serves it, so this sim does too — with real etcd's error shapes at
+        the edges (future revision / compacted revision).
+        """
+        if revision > self.revision:
+            raise EtcdError("etcdserver: mvcc: required revision is a future revision")
+        if revision <= self.compacted:
+            raise EtcdError("etcdserver: mvcc: required revision has been compacted")
+        keys = (
+            sorted(k for k in self.history if k.startswith(key))
+            if prefix
+            else ([key] if key in self.history else [])
+        )
+        kvs = []
+        for k in keys:
+            snap = None
+            for rev, kv in self.history[k]:
+                if rev > revision:
+                    break
+                snap = kv  # txn writes share a revision: last one wins
+            if snap is not None:
+                kvs.append(snap)
+        return GetResponse(header=self.header(), kvs=kvs)
 
     def delete(self, key: Key, prefix: bool = False) -> DeleteResponse:
         keys = (
@@ -369,6 +410,7 @@ class ServiceInner:
             deleted += 1
             if self._txn_depth == 0:
                 self.revision += 1
+            self._hist_del(k)
             if kv.lease != 0:
                 lease_obj = self.lease.get(kv.lease)
                 if lease_obj is not None and k in lease_obj.keys:
@@ -437,10 +479,11 @@ class ServiceInner:
         lease_obj = self.lease.pop(id, None)
         if lease_obj is None:
             raise lease_not_found()
+        self.revision += 1
         for key in lease_obj.keys:
             kv = self.kv.pop(key)
+            self._hist_del(key)
             self.watcher.publish(Event(EventType.DELETE, kv))
-        self.revision += 1
         return LeaseRevokeResponse(header=self.header())
 
     def lease_keep_alive(self, id: int) -> LeaseKeepAliveResponse:
@@ -478,13 +521,14 @@ class ServiceInner:
             lease_obj.ttl -= 1
             if lease_obj.ttl <= 0:
                 expired.append(id)
+        if expired:
+            self.revision += 1
         for id in expired:
             lease_obj = self.lease.pop(id)
             for key in lease_obj.keys:
                 kv = self.kv.pop(key)
+                self._hist_del(key)
                 self.watcher.publish(Event(EventType.DELETE, kv))
-        if expired:
-            self.revision += 1
 
     # -- election (service.rs:488-592)
 
@@ -509,6 +553,7 @@ class ServiceInner:
             if key not in lease_obj.keys:
                 lease_obj.keys.append(key)
             self.kv[key] = kv
+            self._hist_put(kv)
             self.watcher.publish(Event(EventType.PUT, kv))
 
         leader = self.leader(name)
@@ -528,6 +573,7 @@ class ServiceInner:
         # and detect changes by comparison (server.rs observe loop)
         kv = dataclasses.replace(kv, value=value, mod_revision=self.revision)
         self.kv[leader.key] = kv
+        self._hist_put(kv)
         self.watcher.publish(Event(EventType.PUT, kv))
         return ProclaimResponse(header=self.header())
 
@@ -548,8 +594,9 @@ class ServiceInner:
         lease_obj = self.lease.get(kv.lease)
         if lease_obj is not None and leader.key in lease_obj.keys:
             lease_obj.keys.remove(leader.key)
-        self.watcher.publish(Event(EventType.DELETE, kv))
         self.revision += 1
+        self._hist_del(leader.key)
+        self.watcher.publish(Event(EventType.DELETE, kv))
         return ResignResponse(header=self.header())
 
     def status(self) -> StatusResponse:
@@ -588,6 +635,10 @@ class ServiceInner:
         doc = tomllib.loads(data)
         inner = ServiceInner()
         inner.revision = int(doc.get("revision", 0))
+        # a snapshot is COMPACTED state (real etcd restore semantics):
+        # historical reads below the snapshot revision raise; at or after
+        # it they serve from the re-seeded history
+        inner.compacted = max(0, inner.revision - 1)
         for e in doc.get("kv", []):
             key = base64.b64decode(e["key"])
             inner.kv[key] = KeyValue(
@@ -597,6 +648,7 @@ class ServiceInner:
                 create_revision=int(e.get("create_revision", 0)),
                 mod_revision=int(e.get("modify_revision", 0)),
             )
+            inner._hist_put(inner.kv[key])
         for e in doc.get("lease", []):
             inner.lease[int(e["id"])] = _Lease(
                 ttl=int(e["ttl"]),

@@ -65,7 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, tree_select
+from .spec import Outbox, ProtocolSpec
 
 REPLICA, CLAIMING, PRIMARY = 0, 1, 2
 HB, CLAIM, CLAIM_ACK, WREP, WACK, RPROBE, RACK, CREQ, CRSP = range(9)
@@ -141,72 +141,11 @@ def make_kv_spec(
     kidx = jnp.arange(K, dtype=jnp.int32)
     oidx = jnp.arange(OPS, dtype=jnp.int32)
 
-    def no_out():
-        return Outbox(
-            valid=jnp.zeros((N,), jnp.bool_),
-            dst=jnp.zeros((N,), jnp.int32),
-            kind=jnp.zeros((N,), jnp.int32),
-            payload=jnp.zeros((N, P), jnp.int32),
-        )
-
-    def reply(dst, kind, fields):
-        """One message in outbox ROW dst (not row 0): each row is its own
-        pool candidate position, so replies to different peers never share
-        a ring region — a node acking overlapping quorum rounds from row 0
-        alone measured real drops at ring depth 2."""
-        row = jnp.stack([jnp.asarray(v, jnp.int32) for v in fields])
-        row = jnp.concatenate(
-            [row, jnp.zeros((P - row.shape[0],), jnp.int32)]
-        )
-        at = peers == dst
-        pay = jnp.where(at[:, None], row[None, :], 0)
-        return Outbox(
-            valid=at,  # exactly one slot, in row dst
-            dst=jnp.full((N,), dst, jnp.int32),
-            kind=jnp.full((N,), kind, jnp.int32),
-            payload=pay,
-        )
-
-    def broadcast(nid, kind, fields):
-        pay = jnp.zeros((P,), jnp.int32)
-        for i, v in enumerate(fields):
-            pay = pay.at[i].set(jnp.asarray(v, jnp.int32))
-        return Outbox(
-            valid=(peers != nid),
-            dst=peers,
-            kind=jnp.full((N,), kind, jnp.int32),
-            payload=jnp.broadcast_to(pay[None, :], (N, P)),
-        )
-
-    pick_out = tree_select  # elementwise outbox select (shared helper)
-
-    def out_if(cond, out: Outbox) -> Outbox:
-        return pick_out(cond, out, no_out())
-
-    def record(s: KvState, kind, key_, val, rev, tinv, now):
-        """Append one acked op to the history RING (oldest evicted).
-
-        Every entry is a real acked op with true times, so any violating
-        pair among currently-retained entries is a true violation — the
-        ring only narrows coverage to the last OPS ops per node, and the
-        stale pairs the check hunts (write on one partition side, read on
-        the other) are temporally close. Evicted ops leave their max-rev
-        evidence in the per-key watermark (wm_rev/wm_t), so wrapping never
-        silently drops assertions."""
-        at = oidx == (s.h_len % OPS)
-        at_k = kidx == key_
-        raise_wm = at_k & (rev > s.wm_rev)
-        return s._replace(
-            h_kind=jnp.where(at, kind, s.h_kind),
-            h_key=jnp.where(at, key_, s.h_key),
-            h_val=jnp.where(at, val, s.h_val),
-            h_rev=jnp.where(at, rev, s.h_rev),
-            h_tinv=jnp.where(at, tinv, s.h_tinv),
-            h_trsp=jnp.where(at, now, s.h_trsp),
-            h_len=s.h_len + 1,
-            wm_rev=jnp.where(raise_wm, rev, s.wm_rev),
-            wm_t=jnp.where(raise_wm, now, s.wm_t),
-        )
+    # (the per-kind outbox helpers and the record() appender of r3 are now
+    # inlined in the merged on_message below; the history-ring contract —
+    # every entry is a real acked op with true times, wrapping narrows
+    # pairwise coverage to the last OPS ops while watermarks keep evicted
+    # ops' max-rev evidence — is documented on KvState.)
 
     # ------------------------------------------------------------------ init
 
@@ -338,193 +277,212 @@ def make_kv_spec(
 
     # --------------------------------------------------------------- message
 
-    def adopt(s: KvState, msg_epoch, now):
-        """Adopt a higher (or equal) epoch seen in any quorum traffic."""
-        higher = msg_epoch > s.epoch
-        return s._replace(
-            epoch=jnp.where(higher, msg_epoch, s.epoch),
-            role=jnp.where(higher, REPLICA, s.role),
-            last_hb=jnp.where(msg_epoch >= s.epoch, now, s.last_hb),
+    def on_message(s: KvState, nid, src, kind, payload, now, key):
+        """All nine message kinds as ONE masked handler.
+
+        Under vmap, a lax.switch on a traced kind executes EVERY branch and
+        selects — nine full KvState materializations per step, measured at
+        ~a third of the whole kv step. The merged form computes each state
+        field once under mutually exclusive kind masks; each kind's logic
+        is the direct transcription of the r3 per-kind handlers (h_hb,
+        h_claim, h_claim_ack, h_wrep, h_wack, h_rprobe, h_rack, h_creq,
+        h_crsp — see git history for the originals side by side)."""
+        f = payload
+        is_hb = kind == HB
+        is_claim = kind == CLAIM
+        is_cack = kind == CLAIM_ACK
+        is_wrep = kind == WREP
+        is_wack = kind == WACK
+        is_rprobe = kind == RPROBE
+        is_rack = kind == RACK
+        is_creq = kind == CREQ
+        is_crsp = kind == CRSP
+        f0 = f[0]
+
+        def majority(mask):
+            return jax.lax.population_count(
+                mask.astype(jnp.uint32)
+            ).astype(jnp.int32) > N // 2
+
+        # -- epoch adoption: HB/WREP/RPROBE adopt a higher epoch and
+        # refresh last_hb on >=; a CLAIM additionally deposes + drops the
+        # open round (the claimer must not inherit it)
+        adopty = is_hb | is_wrep | is_rprobe
+        higher = f0 > s.epoch
+        accept = is_claim & higher
+        epoch = jnp.where((adopty | is_claim) & higher, f0, s.epoch)
+        role = jnp.where((adopty | is_claim) & higher, REPLICA, s.role)
+        last_hb = jnp.where(
+            (adopty & (f0 >= s.epoch)) | accept, now, s.last_hb
         )
 
-    def h_hb(s, nid, src, f, now, key):
-        s = adopt(s, f[0], now)
-        return s, no_out(), jnp.int32(-1)
-
-    def h_claim(s, nid, src, f, now, key):
-        e = f[0]
-        accept = e > s.epoch
-        s = s._replace(
-            epoch=jnp.where(accept, e, s.epoch),
-            role=jnp.where(accept, REPLICA, s.role),  # deposes a primary
-            last_hb=jnp.where(accept, now, s.last_hb),
-            pend_kind=jnp.where(accept, 0, s.pend_kind),
-            pend_recover=jnp.where(accept, 0, s.pend_recover),
+        # -- CLAIM_ACK: tally; merge the responder's store (highest rev per
+        # key); majority => PRIMARY with a full recovery mandate
+        cmine = is_cack & (s.role == CLAIMING) & (f0 == s.epoch)
+        claim_acks = jnp.where(
+            cmine, s.claim_acks | (jnp.int32(1) << src), s.claim_acks
         )
-        fields = [s.epoch] + [s.kv_val[k] for k in range(K)] + [
-            s.kv_rev[k] for k in range(K)
-        ]
-        out = out_if(accept, reply(src, CLAIM_ACK, fields))
-        return s, out, jnp.int32(-1)
-
-    def h_claim_ack(s: KvState, nid, src, f, now, key):
-        mine = (s.role == CLAIMING) & (f[0] == s.epoch)
-        acks = jnp.where(mine, s.claim_acks | (jnp.int32(1) << src), s.claim_acks)
-        # merge the responder's store: highest revision wins per key
         r_val = f[1 : 1 + K]
         r_rev = f[1 + K : 1 + 2 * K]
-        newer = mine & (r_rev > s.kv_rev)
-        kv_val = jnp.where(newer, r_val, s.kv_val)
-        kv_rev = jnp.where(newer, r_rev, s.kv_rev)
-        won = mine & (
-            jax.lax.population_count(acks.astype(jnp.uint32)).astype(jnp.int32)
-            > N // 2
-        )
-        s = s._replace(
-            claim_acks=acks, kv_val=kv_val, kv_rev=kv_rev,
-            role=jnp.where(won, PRIMARY, s.role),
-            wcount=jnp.where(won, 0, s.wcount),
-            pend_kind=jnp.where(won, 0, s.pend_kind),
-            # mandate recovery: every key must re-commit under this epoch
-            # before any client op is served (see module docstring)
-            recover_left=jnp.where(won, K, s.recover_left),
-        )
-        return s, no_out(), jnp.int32(-1)
+        ca_newer = cmine & (r_rev > s.kv_rev)  # [K]
+        won = cmine & majority(claim_acks)
+        role = jnp.where(won, PRIMARY, role)
 
-    def h_wrep(s: KvState, nid, src, f, now, key):
-        e, rev, key_, val = f[0], f[1], f[2], f[3]
-        ok = e >= s.epoch
-        s = adopt(s, e, now)
-        at = kidx == key_
-        apply_ = ok & at & (rev > s.kv_rev)
-        s = s._replace(
-            kv_val=jnp.where(apply_, val, s.kv_val),
-            kv_rev=jnp.where(apply_, rev, s.kv_rev),
-        )
-        out = out_if(ok, reply(src, WACK, [s.epoch, rev]))
-        return s, out, jnp.int32(-1)
+        # -- WREP: apply the replicated write if fresh, from a current+
+        # epoch sender
+        wrep_ok = is_wrep & (f0 >= s.epoch)
+        wrep_apply = wrep_ok & (kidx == f[2]) & (f[1] > s.kv_rev)  # [K]
 
-    def h_wack(s: KvState, nid, src, f, now, key):
-        rev = f[1]
-        mine = (s.role == PRIMARY) & (s.pend_kind == OP_WRITE) & (rev == s.pend_rev)
-        acks = jnp.where(mine, s.pend_acks | (jnp.int32(1) << src), s.pend_acks)
-        commit = mine & (
-            jax.lax.population_count(acks.astype(jnp.uint32)).astype(jnp.int32)
-            > N // 2
+        # -- WACK / RACK: the primary's one outstanding quorum round
+        wmine = (
+            is_wack & (s.role == PRIMARY) & (s.pend_kind == OP_WRITE)
+            & (f[1] == s.pend_rev)
         )
-        at = kidx == s.pend_key
-        apply_ = commit & at & (s.pend_rev > s.kv_rev)
+        rmine = (
+            is_rack & (s.role == PRIMARY) & (s.pend_kind == OP_READ)
+            & (f[1] == s.pend_rev)
+        )
+        qmine = wmine | rmine
+        pend_acks = jnp.where(
+            qmine, s.pend_acks | (jnp.int32(1) << src), s.pend_acks
+        )
+        commit_w = wmine & majority(pend_acks)
+        commit_r = rmine & majority(pend_acks)
+        at_p = kidx == s.pend_key  # [K]
+        wack_apply = commit_w & at_p & (s.pend_rev > s.kv_rev)
         is_rec = s.pend_recover > 0
-        s = s._replace(
-            pend_acks=acks,
-            kv_val=jnp.where(apply_, s.pend_val, s.kv_val),
-            kv_rev=jnp.where(apply_, s.pend_rev, s.kv_rev),
-            pend_kind=jnp.where(commit, 0, s.pend_kind),
-            pend_recover=jnp.where(commit, 0, s.pend_recover),
-            # a committed recovery round finishes one key of the mandate
-            recover_left=jnp.where(
-                commit & is_rec, jnp.maximum(s.recover_left - 1, 0),
-                s.recover_left,
-            ),
-        )
-        out = out_if(
-            commit & ~is_rec,  # recovery rounds have no client to answer
-            reply(
-                s.pend_client,
-                CRSP,
-                [s.epoch, OP_WRITE, s.pend_key, s.pend_val, s.pend_rev, s.pend_tinv],
-            ),
-        )
-        return s, out, jnp.int32(-1)
+        cur_at = at_p.astype(jnp.int32)
+        cur_val = (s.kv_val * cur_at).sum()
+        cur_rev = (s.kv_rev * cur_at).sum()
 
-    def h_rprobe(s: KvState, nid, src, f, now, key):
-        e, probe_id = f[0], f[1]
-        ok = e >= s.epoch
-        s = adopt(s, e, now)
-        out = out_if(ok, reply(src, RACK, [s.epoch, probe_id]))
-        return s, out, jnp.int32(-1)
-
-    def h_rack(s: KvState, nid, src, f, now, key):
-        probe_id = f[1]
-        mine = (s.role == PRIMARY) & (s.pend_kind == OP_READ) & (
-            probe_id == s.pend_rev
-        )
-        acks = jnp.where(mine, s.pend_acks | (jnp.int32(1) << src), s.pend_acks)
-        commit = mine & (
-            jax.lax.population_count(acks.astype(jnp.uint32)).astype(jnp.int32)
-            > N // 2
-        )
-        at = (kidx == s.pend_key).astype(jnp.int32)
-        cur_val = (s.kv_val * at).sum()
-        cur_rev = (s.kv_rev * at).sum()
-        s = s._replace(
-            pend_acks=acks,
-            pend_kind=jnp.where(commit, 0, s.pend_kind),
-        )
-        out = out_if(
-            commit,
-            reply(
-                s.pend_client,
-                CRSP,
-                [s.epoch, OP_READ, s.pend_key, cur_val, cur_rev, s.pend_tinv],
-            ),
-        )
-        return s, out, jnp.int32(-1)
-
-    def h_creq(s: KvState, nid, src, f, now, key):
-        op_kind, op_key, op_val, tinv = f[1], f[2], f[3], f[4]
-        # only an idle primary with a FULLY RECOVERED mandate starts a
-        # quorum round; otherwise drop (the client times out and retries —
-        # standard overload shedding). Serving before recovery completes is
-        # exactly the fuzz-found stale-serve bug (module docstring).
+        # -- CREQ: an idle, fully recovered primary starts a quorum round;
+        # anything else drops (client times out and retries)
         start = (
-            (s.role == PRIMARY) & (s.pend_kind == 0) & (op_kind > 0)
+            is_creq & (s.role == PRIMARY) & (s.pend_kind == 0) & (f[1] > 0)
             & (s.recover_left == 0)
         )
         rid = s.epoch * REV_STRIDE + s.wcount + 1
-        s = s._replace(
-            pend_kind=jnp.where(start, op_kind, s.pend_kind),
-            pend_key=jnp.where(start, op_key, s.pend_key),
-            pend_val=jnp.where(start, op_val, s.pend_val),
-            pend_rev=jnp.where(start, rid, s.pend_rev),
-            pend_acks=jnp.where(start, jnp.int32(1) << nid, s.pend_acks),
-            pend_client=jnp.where(start, src, s.pend_client),
-            pend_tinv=jnp.where(start, tinv, s.pend_tinv),
-            pend_t=jnp.where(start, now, s.pend_t),
-            wcount=jnp.where(start, s.wcount + 1, s.wcount),
-        )
-        is_write = op_kind == OP_WRITE
-        wout = broadcast(nid, WREP, [s.epoch, rid, op_key, op_val])
-        rout = broadcast(nid, RPROBE, [s.epoch, rid, op_key])
-        out = out_if(start, pick_out(is_write, wout, rout))
-        return s, out, jnp.int32(-1)
 
-    def h_crsp(s: KvState, nid, src, f, now, key):
-        op_kind, op_key, op_val, rev, tinv = f[1], f[2], f[3], f[4], f[5]
-        # match against the outstanding request (tinv is the correlation id)
-        mine = (s.creq_kind > 0) & (tinv == s.creq_t) & (op_kind == s.creq_kind)
-        # record the invocation time from LOCAL state, not the payload echo:
-        # payload times are frozen at send and go stale across an epoch
-        # rebase (spec.REBASE_US), while s.creq_t rebases with the lane —
-        # equal to tinv whenever `mine` holds, and always current-basis
-        s2 = record(s, op_kind, op_key, op_val, rev, s.creq_t, now)
-        s = jax.tree_util.tree_map(
-            lambda a, b: jnp.where(
-                jnp.broadcast_to(jnp.reshape(mine, (1,) * a.ndim), a.shape), a, b
+        # -- CRSP: the client records its acked op (invocation time from
+        # LOCAL state, not the payload echo: payload times freeze at send
+        # and go stale across an epoch rebase; s.creq_t rebases with the
+        # lane and equals the echo whenever `mine` holds)
+        rmatch = (
+            is_crsp & (s.creq_kind > 0) & (f[5] == s.creq_t)
+            & (f[1] == s.creq_kind)
+        )
+        at_o = rmatch & (oidx == (s.h_len % OPS))  # [OPS]
+        at_k = kidx == f[2]  # [K]
+        raise_wm = rmatch & at_k & (f[4] > s.wm_rev)
+
+        # -- merged field writes (kind masks are mutually exclusive)
+        state = s._replace(
+            epoch=epoch,
+            role=role,
+            last_hb=last_hb,
+            claim_acks=claim_acks,
+            kv_val=jnp.where(
+                ca_newer, r_val,
+                jnp.where(wrep_apply, f[3],
+                          jnp.where(wack_apply, s.pend_val, s.kv_val)),
             ),
-            s2,
-            s,
-        )  # record only when the response matches the outstanding request
-        s = s._replace(creq_kind=jnp.where(mine, 0, s.creq_kind))
-        return s, no_out(), jnp.int32(-1)
-
-    def on_message(s: KvState, nid, src, kind, payload, now, key):
-        return jax.lax.switch(
-            jnp.clip(kind, 0, 8),
-            [h_hb, h_claim, h_claim_ack, h_wrep, h_wack, h_rprobe, h_rack,
-             h_creq, h_crsp],
-            s, nid, src, payload, now, key,
+            kv_rev=jnp.where(
+                ca_newer, r_rev,
+                jnp.where(wrep_apply, f[1],
+                          jnp.where(wack_apply, s.pend_rev, s.kv_rev)),
+            ),
+            pend_kind=jnp.where(
+                accept | won | commit_w | commit_r, 0,
+                jnp.where(start, f[1], s.pend_kind),
+            ),
+            pend_key=jnp.where(start, f[2], s.pend_key),
+            pend_val=jnp.where(start, f[3], s.pend_val),
+            pend_rev=jnp.where(start, rid, s.pend_rev),
+            pend_acks=jnp.where(start, jnp.int32(1) << nid, pend_acks),
+            pend_client=jnp.where(start, src, s.pend_client),
+            pend_tinv=jnp.where(start, f[4], s.pend_tinv),
+            pend_t=jnp.where(start, now, s.pend_t),
+            pend_recover=jnp.where(accept | commit_w, 0, s.pend_recover),
+            recover_left=jnp.where(
+                won, K,
+                jnp.where(
+                    commit_w & is_rec,
+                    jnp.maximum(s.recover_left - 1, 0),
+                    s.recover_left,
+                ),
+            ),
+            wcount=jnp.where(won, 0, s.wcount + start.astype(jnp.int32)),
+            creq_kind=jnp.where(rmatch, 0, s.creq_kind),
+            h_kind=jnp.where(at_o, f[1], s.h_kind),
+            h_key=jnp.where(at_o, f[2], s.h_key),
+            h_val=jnp.where(at_o, f[3], s.h_val),
+            h_rev=jnp.where(at_o, f[4], s.h_rev),
+            h_tinv=jnp.where(at_o, s.creq_t, s.h_tinv),
+            h_trsp=jnp.where(at_o, now, s.h_trsp),
+            h_len=s.h_len + rmatch.astype(jnp.int32),
+            wm_rev=jnp.where(raise_wm, f[4], s.wm_rev),
+            wm_t=jnp.where(raise_wm, now, s.wm_t),
         )
+
+        # -- outbox: at most one reply (row dst) OR one broadcast (CREQ)
+        pad = jnp.zeros((P,), jnp.int32)
+        ca_fields = jnp.concatenate([
+            jnp.reshape(epoch, (1,)), s.kv_val, s.kv_rev,
+            pad[: P - 1 - 2 * K],
+        ])  # CLAIM_ACK carries the whole (unmodified-by-claim) store
+
+        def fields(*vals):
+            row = jnp.stack([jnp.asarray(v, jnp.int32) for v in vals])
+            return jnp.concatenate([row, pad[: P - row.shape[0]]])
+
+        reply_valid = (
+            accept | wrep_ok | is_rprobe & (f0 >= s.epoch)
+            | (commit_w & ~is_rec) | commit_r
+        )
+        reply_dst = jnp.where(
+            commit_w | commit_r, s.pend_client, src
+        ).astype(jnp.int32)
+        reply_kind = jnp.where(
+            accept, CLAIM_ACK,
+            jnp.where(wrep_ok, WACK,
+                      jnp.where(is_rprobe, RACK, CRSP)),
+        )
+        reply_pay = jnp.where(
+            accept, ca_fields,
+            jnp.where(
+                wrep_ok, fields(epoch, f[1]),
+                jnp.where(
+                    is_rprobe, fields(epoch, f[1]),
+                    jnp.where(
+                        commit_w,
+                        fields(s.epoch, OP_WRITE, s.pend_key, s.pend_val,
+                               s.pend_rev, s.pend_tinv),
+                        fields(s.epoch, OP_READ, s.pend_key, cur_val,
+                               cur_rev, s.pend_tinv),
+                    ),
+                ),
+            ),
+        )
+        is_write = f[1] == OP_WRITE
+        bc_pay = jnp.where(
+            is_write,
+            fields(s.epoch, rid, f[2], f[3]),
+            fields(s.epoch, rid, f[2]),
+        )
+        bc_kind = jnp.where(is_write, WREP, RPROBE)
+
+        at_row = peers == reply_dst
+        out = Outbox(
+            valid=jnp.where(start, peers != nid, reply_valid & at_row),
+            dst=jnp.where(start, peers, jnp.full((N,), reply_dst, jnp.int32)),
+            kind=jnp.where(start, bc_kind, reply_kind).astype(jnp.int32)
+            * jnp.ones((N,), jnp.int32),
+            payload=jnp.where(
+                jnp.reshape(start, (1, 1)), bc_pay[None, :],
+                jnp.where(at_row[:, None], reply_pay[None, :], 0),
+            ),
+        )
+        return state, out, jnp.int32(-1)
 
     # --------------------------------------------------------------- restart
 

@@ -36,10 +36,10 @@ bit is kept per destination (`[L, N, CK]`). Consequences:
     and its earliest event is a plain min-reduce (the r3 layout's `[L,S,N]`
     one-hot expansions and `[L,N,S,P]` payload contraction, measured as the
     dominant step cost, are gone);
-  * the PACK side is pure elementwise writes: ring slot k = seq_c mod K
-    (rotation aligned across destinations), dst routing via a tiny
-    `[L,C,N]` one-hot; a send whose ring slot is still pending anywhere is
-    dropped and counted (`overflow`) rather than corrupted;
+  * the PACK side is pure elementwise writes: a send takes the first of
+    its K ring slots unreferenced by every destination, dst routing via a
+    tiny `[L,C,N]` one-hot; with all K pending the send is dropped and
+    counted (`overflow`) rather than corrupted;
   * the message's source is a compile-time constant per slot
     (`src_of_slot`), and pool bandwidth — the pool is rewritten every step,
     so its bytes are a top step cost — is ~N x smaller than materializing
@@ -77,24 +77,24 @@ from .spec import INF_GUARD, INF_US, Outbox, ProtocolSpec, REBASE_US, SimConfig
 class MsgPool(NamedTuple):
     """In-flight messages: per-destination validity + per-candidate ring.
 
-    A send event from candidate position c (static source node) broadcasts
-    ONE (deliver time, kind, payload) to up to N destinations — the network
-    rolls loss per destination but latency per candidate — so those fields
-    live once in a per-candidate ring slot (c, k), k = seq_c mod K, and only
+    A send event from candidate position c (static source node) carries
+    ONE (deliver time, kind, payload) — latency is rolled per candidate —
+    so those fields live once in a per-candidate ring slot (c, k), and only
     the validity bit is per destination. The destination slot (n, c, k)
-    references ring slot (c, k) BY POSITION: rotation is aligned across
-    destinations, and a candidate whose next ring slot is still pending at
-    any destination drops the new send (counted in `overflow`) rather than
-    corrupt it. This keeps pool bandwidth ~N x smaller than materializing
-    per-destination copies — the pool is rewritten every step, so its bytes
-    are a top step cost.
+    references ring slot (c, k) BY POSITION. A send takes the first of its
+    K ring slots that no destination still references (globally free); if
+    all K are pending, the send drops (counted in `overflow`) rather than
+    corrupt one in flight. This keeps pool bandwidth ~N x smaller than
+    materializing per-destination copies — the pool is rewritten every
+    step, so its bytes are a top step cost — and first-free placement
+    needs roughly half the depth of strict rotation for burst traffic
+    (measured: raft reply bursts need K=4 rotating, K=2 first-free).
     """
 
     valid: Any  # bool [L,N,CK]  (CK = C * K ring slots)
     deliver: Any  # i32 [L,CK] (offset us)
     kind: Any  # i32 [L,CK]
     payload: Any  # i32 [L,CK,P]
-    seq: Any  # i32 [L,C] per-candidate send counter (ring rotation)
 
 
 class StragPool(NamedTuple):
@@ -327,7 +327,6 @@ class BatchedSim:
                 deliver=jnp.full((L, CK), INF_US, jnp.int32),
                 kind=jnp.zeros((L, CK), jnp.int32),
                 payload=jnp.zeros((L, CK, spec.payload_width), jnp.int32),
-                seq=jnp.zeros((L, self._C), jnp.int32),
             ),
             strag=strag,
         )
@@ -666,36 +665,29 @@ class BatchedSim:
         # measured from the send instant, not the lane's window maximum
         deliver_at = t_evt[:, self._src_of_c] + lat.astype(jnp.int32)  # [L,C]
 
-        # main-pool pack: candidate c's message rotates into ring slot
-        # k = seq_c mod K; the send is DROPPED (counted) when that slot is
-        # still pending at any destination — overwriting it would corrupt a
-        # message in flight. Everything is elementwise on [L,c,K] / [L,N,c,K]
-        # masks, per depth segment (see SimConfig).
+        # main-pool pack: candidate c's message takes the FIRST of its K
+        # ring slots that no destination still references; if all K are
+        # pending the send is DROPPED (counted) — overwriting one would
+        # corrupt a message in flight. Everything is elementwise on
+        # [L,c,K] / [L,N,c,K] masks, per depth segment (see SimConfig).
         send = keep & ~bug  # [L,C] candidate sends this step
         dst_major = cand_dst_oh.transpose(0, 2, 1)  # [L,N,C]
         ring_w_parts = []  # [L, nc*K] ring-slot write masks
         place_parts = []  # [L, N, nc*K] validity-bit writes
         ovf = jnp.zeros((L,), jnp.int32)
-        seq_inc = []
         for c0, c1, K, s0, s1 in self._segs:
             nc = c1 - c0
             send_seg = send[:, c0:c1]  # [L,nc]
-            k_oh = (
-                (msgs.seq[:, c0:c1] % K)[:, :, None]
-                == jnp.arange(K)[None, None, :]
-            )  # [L,nc,K]
-            occupied = valid[:, :, s0:s1].reshape(L, N, nc, K).any(1)  # [L,nc,K]
-            blocked = (occupied & k_oh).any(2)  # [L,nc]
-            ok = send_seg & ~blocked
-            ovf = ovf + (send_seg & blocked).sum(axis=1, dtype=jnp.int32)
-            ring_w = ok[:, :, None] & k_oh  # [L,nc,K]
+            free = ~valid[:, :, s0:s1].reshape(L, N, nc, K).any(1)  # [L,nc,K]
+            ring_w = send_seg[:, :, None] & _first_free(free, K)  # [L,nc,K]
+            placed = ring_w.any(2)  # [L,nc]
+            ovf = ovf + (send_seg & ~placed).sum(axis=1, dtype=jnp.int32)
             ring_w_parts.append(ring_w.reshape(L, nc * K))
             place_parts.append(
                 (dst_major[:, :, c0:c1, None] & ring_w[:, None]).reshape(
                     L, N, nc * K
                 )
             )
-            seq_inc.append(ok)
         ring_w = (
             ring_w_parts[0] if len(ring_w_parts) == 1
             else jnp.concatenate(ring_w_parts, axis=1)
@@ -704,9 +696,6 @@ class BatchedSim:
             place_parts[0] if len(place_parts) == 1
             else jnp.concatenate(place_parts, axis=2)
         )  # [L,N,CK]
-        ok_all = (
-            seq_inc[0] if len(seq_inc) == 1 else jnp.concatenate(seq_inc, axis=1)
-        )  # [L,C]
         overflow = state.overflow + ovf
 
         def ring_expand(cand_vals):  # [L,C(,P)] -> [L,CK(,P)] per segment
@@ -738,7 +727,6 @@ class BatchedSim:
         new_deliver = put(msgs.deliver, deliver_at)
         new_kind = put(msgs.kind, cand_kind)
         new_payload = put(msgs.payload, cand_pay)
-        new_seq = msgs.seq + ok_all.astype(jnp.int32)
 
         # straggler pack: region c owns K4 slots of the side pool
         if self._B:
@@ -838,7 +826,6 @@ class BatchedSim:
                 deliver=new_deliver,
                 kind=new_kind,
                 payload=new_payload,
-                seq=new_seq,
             ),
             strag=new_strag,
         )

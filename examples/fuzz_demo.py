@@ -45,7 +45,7 @@ def buggy_raft_spec():
     return dataclasses.replace(spec, on_message=buggy_on_message)
 
 
-def main() -> None:
+def main(n_seeds: int = 2048) -> None:
     from madsim_tpu.tpu import run_batch, raft_workload
     from madsim_tpu.tpu.trace import format_trace
 
@@ -62,8 +62,8 @@ def main() -> None:
         ),
     )
 
-    print(f"sweeping 2048 seeds on {jax.devices()[0]} ...")
-    result = run_batch(range(2048), wl, repro_on_host=False, max_traces=1)
+    print(f"sweeping {n_seeds} seeds on {jax.devices()[0]} ...")
+    result = run_batch(range(n_seeds), wl, repro_on_host=False, max_traces=1)
     print(f"violations: {result.violations}")
     print(f"violating seeds: {result.violating_seeds[:10]}")
     assert result.violations > 0, "the planted bug should be found"

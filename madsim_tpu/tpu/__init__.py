@@ -1,6 +1,7 @@
 """The TPU batched simulation backend (SURVEY.md §7, BASELINE.json north star)."""
 
 from .batch import (  # noqa: F401
+    BatchDeterminismError,
     BatchResult,
     BatchViolation,
     BatchWorkload,

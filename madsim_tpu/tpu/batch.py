@@ -69,6 +69,24 @@ class BatchWorkload:
     lane_check_sample: int = 8
 
 
+class BatchDeterminismError(AssertionError):
+    """Two runs of the same seed batch diverged (the device analog of the
+    reference's MADSIM_TEST_CHECK_DETERMINISM RNG-trace comparison,
+    rand.rs:63-111 / runtime/mod.rs:167-191)."""
+
+
+def _assert_runs_bitwise_equal(a: SimState, b: SimState, context: str) -> None:
+    leaves_a, treedef = jax.tree_util.tree_flatten(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    for i, (x, y) in enumerate(zip(leaves_a, leaves_b)):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            raise BatchDeterminismError(
+                f"determinism check failed ({context}): state leaf {i} of "
+                f"{treedef.num_leaves} differs between two runs of the same "
+                "seeds — the spec or backend is nondeterministic"
+            )
+
+
 class BatchViolation(AssertionError):
     """Violations found in a batch; carries repro seeds (builder.rs DX analog)."""
 
@@ -143,8 +161,18 @@ def run_batch(
     chunk: int = DEFAULT_CHUNK,
     max_traces: int = 2,
     mesh: Any = "auto",
+    check_determinism: bool = False,
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
+
+    `check_determinism` runs every chunk TWICE and bitwise-compares the
+    full final states (the reference's MADSIM_TEST_CHECK_DETERMINISM mode;
+    `@batch_test` turns it on from that same env var). The engine is
+    deterministic by construction, so this is a tripwire for impure specs
+    and misbehaving backends; note that an execution-caching transport
+    (e.g. a dev tunnel that memoizes identical dispatches) can mask
+    backend-level nondeterminism, though spec-level impurity still bakes
+    in at trace time and is caught.
 
     The TPU pass is the seed sweep (runtime/builder.rs:110-148 made wide)
     over ALL visible devices by default (see `resolve_mesh`); the host pass
@@ -176,6 +204,11 @@ def run_batch(
         else:
             part_in = part
         state = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+        if check_determinism:
+            rerun = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+            _assert_runs_bitwise_equal(
+                state, rerun, f"seeds[{off}:{off + part.size}]"
+            )
         if pad:
             state = jax.tree_util.tree_map(lambda x: x[: part.size], state)
         violated_parts.append(np.asarray(state.violated))
@@ -260,7 +293,12 @@ def batch_test(
             env = os.environ
             first = int(env.get("MADSIM_TEST_SEED", "0"))
             num = int(env.get("MADSIM_TEST_NUM", str(default_num)))
-            result = run_batch(range(first, first + num), workload)
+            check = env.get("MADSIM_TEST_CHECK_DETERMINISM", "") in (
+                "1", "true", "TRUE",
+            )
+            result = run_batch(
+                range(first, first + num), workload, check_determinism=check
+            )
             if not expect_violations:
                 result.raise_on_violation()
             return fn(result, *args, **kwargs)

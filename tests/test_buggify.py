@@ -119,3 +119,30 @@ def test_buggify_disabled_builds_no_side_pool():
     assert sim._B == 0
     state = sim.init(jnp.arange(4))
     assert state.strag is None
+
+
+def test_buggify_composes_with_multi_device_mesh():
+    """The straggler side pool must shard lane-only (its dim 1 is the
+    candidate axis, not nodes) and stay bit-identical across mesh layouts."""
+    import jax
+    import dataclasses
+
+    from madsim_tpu.tpu.batch import run_batch
+    from madsim_tpu.tpu.twopc import twopc_workload
+
+    wl = twopc_workload(virtual_secs=1.0)
+    wl = dataclasses.replace(
+        wl, config=dataclasses.replace(wl.config, buggify_delay_rate=0.1)
+    )
+    assert len(jax.devices()) == 8
+    sharded = run_batch(range(16), wl, repro_on_host=False, max_traces=0)
+    single = run_batch(range(16), wl, repro_on_host=False, max_traces=0,
+                       mesh=None)
+    assert sharded.summary["n_devices"] == 8
+    assert np.array_equal(
+        np.asarray(sharded.state.events), np.asarray(single.state.events)
+    )
+    assert np.array_equal(
+        np.asarray(sharded.state.strag.valid),
+        np.asarray(single.state.strag.valid),
+    )

@@ -276,8 +276,20 @@ def batch_test(
 ):
     """Decorator: run the env-configured seed range as ONE device batch.
 
-    Reads the same env vars as `@madsim_test` (MADSIM_TEST_SEED,
-    MADSIM_TEST_NUM); the decorated function receives the BatchResult. When
+    Reads the same env vars as `@madsim_test` / the reference's
+    `Builder::from_env` (runtime/builder.rs:55-107):
+
+        MADSIM_TEST_SEED               first seed (default 0)
+        MADSIM_TEST_NUM                seeds to sweep (one batch)
+        MADSIM_TEST_TIME_LIMIT         virtual-time limit in seconds
+                                       (overrides the workload's horizon)
+        MADSIM_TEST_CONFIG             path to a TOML file whose keys are
+                                       SimConfig fields (loss_rate,
+                                       latency_*, chaos knobs, ...)
+        MADSIM_TEST_CHECK_DETERMINISM  run every chunk twice + compare
+
+    (MADSIM_TEST_JOBS is host-harness-only: the device sweep IS the
+    parallelism.) The decorated function receives the BatchResult; when
     `expect_violations` is False, any violation raises BatchViolation with
     repro seeds (and host repro results attached, if the workload has a
     host face).
@@ -296,8 +308,36 @@ def batch_test(
             check = env.get("MADSIM_TEST_CHECK_DETERMINISM", "") in (
                 "1", "true", "TRUE",
             )
+            wl = workload
+            overrides: Dict[str, Any] = {}
+            if "MADSIM_TEST_TIME_LIMIT" in env:
+                overrides["horizon_us"] = int(
+                    float(env["MADSIM_TEST_TIME_LIMIT"]) * 1e6
+                )
+            if "MADSIM_TEST_CONFIG" in env:
+                import tomllib
+
+                with open(env["MADSIM_TEST_CONFIG"], "rb") as f:
+                    doc = tomllib.load(f)
+                cfg_fields = {
+                    fld.name for fld in dataclasses.fields(SimConfig)
+                }
+                unknown = set(doc) - cfg_fields
+                if unknown:
+                    raise ValueError(
+                        f"MADSIM_TEST_CONFIG: unknown SimConfig fields "
+                        f"{sorted(unknown)}"
+                    )
+                overrides.update(doc)
+            if overrides:
+                wl = dataclasses.replace(
+                    wl,
+                    config=dataclasses.replace(
+                        wl.config or SimConfig(), **overrides
+                    ),
+                )
             result = run_batch(
-                range(first, first + num), workload, check_determinism=check
+                range(first, first + num), wl, check_determinism=check
             )
             if not expect_violations:
                 result.raise_on_violation()

@@ -195,6 +195,27 @@ class BatchedSim:
         self.config = config or SimConfig()
         cfg = self.config
         N = spec.n_nodes
+        # fail loudly at construction, not as shape errors deep inside jit
+        if N < 2:
+            raise ValueError(f"spec.n_nodes must be >= 2, got {N}")
+        if spec.payload_width < 1 or spec.max_out < 1 or spec.max_out_msg < 1:
+            raise ValueError(
+                "spec payload_width / max_out / max_out_msg must be >= 1 "
+                f"(got {spec.payload_width}/{spec.max_out}/{spec.max_out_msg})"
+            )
+        if cfg.latency_lo_us < 0 or cfg.latency_hi_us < cfg.latency_lo_us:
+            raise ValueError(
+                f"latency range [{cfg.latency_lo_us}, {cfg.latency_hi_us}] "
+                "must satisfy 0 <= lo <= hi"
+            )
+        if not (0.0 <= cfg.loss_rate < 1.0):
+            raise ValueError(f"loss_rate must be in [0, 1), got {cfg.loss_rate}")
+        if cfg.horizon_us <= 0:
+            raise ValueError(f"horizon_us must be positive, got {cfg.horizon_us}")
+        for name in ("msg_depth_msg", "msg_depth_timer"):
+            v = getattr(cfg, name)
+            if v is not None and v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
         import numpy as _np
 
         # Candidate positions: the fixed send sites of one step — each node's

@@ -202,3 +202,44 @@ def test_fuzz_demo_example_runs():
         demo.main(n_seeds=192)
     finally:
         sys.path.pop(0)
+
+
+def test_batch_test_env_time_limit_and_config(monkeypatch, tmp_path):
+    """builder.rs:55-107 env parity on the device path: TIME_LIMIT bounds
+    virtual time (the horizon), CONFIG overlays SimConfig fields from
+    TOML, and unknown fields fail loudly."""
+    from madsim_tpu.tpu import abs_time_us
+
+    monkeypatch.setenv("MADSIM_TEST_NUM", "8")
+    monkeypatch.setenv("MADSIM_TEST_TIME_LIMIT", "0.5")
+    cfg_file = tmp_path / "cfg.toml"
+    cfg_file.write_text("loss_rate = 0.2\nlatency_hi_us = 5000\n")
+    monkeypatch.setenv("MADSIM_TEST_CONFIG", str(cfg_file))
+
+    @batch_test(raft_workload(virtual_secs=30.0))  # env must override 30 s
+    def inner(result):
+        t = abs_time_us(result.state)
+        assert (t <= 1_500_000).all()  # ~0.5 s horizon, not 30 s
+        return True
+
+    assert inner()
+
+    bad = tmp_path / "bad.toml"
+    bad.write_text("not_a_field = 1\n")
+    monkeypatch.setenv("MADSIM_TEST_CONFIG", str(bad))
+    with pytest.raises(ValueError, match="unknown SimConfig"):
+        inner()
+
+
+def test_simconfig_validation_fails_loudly():
+    from madsim_tpu.tpu import BatchedSim, SimConfig, make_raft_spec
+
+    spec = make_raft_spec(5)
+    with pytest.raises(ValueError, match="loss_rate"):
+        BatchedSim(spec, SimConfig(loss_rate=1.5))
+    with pytest.raises(ValueError, match="latency"):
+        BatchedSim(spec, SimConfig(latency_lo_us=10_000, latency_hi_us=100))
+    with pytest.raises(ValueError, match="horizon"):
+        BatchedSim(spec, SimConfig(horizon_us=0))
+    with pytest.raises(ValueError, match="msg_depth"):
+        BatchedSim(spec, SimConfig(msg_depth_msg=0))

@@ -91,7 +91,14 @@ def make_raft_spec(
     election_hi_us: int = 300_000,
     heartbeat_us: int = 50_000,
     client_rate: float = 0.5,
+    buggify_rate: float = 0.0,
 ) -> ProtocolSpec:
+    """`buggify_rate` arms the spec's cooperative fault points (the
+    buggify.rs:8-32 analog, spec.buggify): a leader whose timer fires
+    occasionally SKIPS its whole broadcast (a silent heartbeat/replication
+    stall burst — leadership wobbles without any network fault), the
+    hardest-to-reach corner of the election state machine. 0 disables
+    (the reference's default too)."""
     N, LOG = n_nodes, log_capacity
     ridx = jnp.arange(LOG, dtype=jnp.int32)  # relative window slots
     peers = jnp.arange(N, dtype=jnp.int32)
@@ -267,9 +274,19 @@ def make_raft_spec(
             pack(new_term, last_idx, term_at(s, last_idx), 0, 0, 0),
             (N, PAYLOAD_WIDTH),
         )
+        # cooperative buggify: a leader occasionally goes silent for one
+        # tick — no heartbeats, no replication — exercising the "leader
+        # alive but mute" corner that network chaos reaches only via
+        # correlated per-link drops
+        if buggify_rate > 0:
+            from .spec import buggify as _buggify
+
+            mute = is_leader & _buggify(key, 28, buggify_rate)
+        else:
+            mute = jnp.bool_(False)
         ldr = jnp.broadcast_to(jnp.reshape(is_leader, (1,)), (N,))
         out = Outbox(
-            valid=(peers != nid),
+            valid=(peers != nid) & ~mute,
             dst=peers,
             kind=jnp.where(
                 ldr,

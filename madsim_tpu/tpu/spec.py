@@ -66,6 +66,24 @@ REBASE_US = 1 << 28  # ~268 virtual seconds per epoch
 INF_GUARD = jnp.int32(1 << 30)
 
 
+def buggify(key, site: int, p: float = 0.25):
+    """Cooperative fault injection inside spec handlers — the
+    FoundationDB-style `buggify!()` (reference buggify.rs:8-32) for the
+    batched engine: a deterministic per-(lane, node, step) coin drawn from
+    the handler's own key at a distinct site constant.
+
+    Spec authors call this at hand-chosen fault points ("what if this
+    heartbeat were skipped / this cache were cold / this batch were
+    length 1?") and gate the rate through a spec-factory parameter that
+    defaults to 0 — exactly how the reference's buggify is disabled unless
+    the harness turns it on. See make_raft_spec(buggify_rate=...) for the
+    worked example and docs/authoring_protocol_specs.md for guidance.
+    """
+    from . import prng
+
+    return prng.bernoulli(key, site, p)
+
+
 def tree_select(cond, a, b):
     """Elementwise pytree select on a traced scalar condition — the shared
     helper behind every spec's pick_out/pick_state (works for Outbox, state

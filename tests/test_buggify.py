@@ -146,3 +146,35 @@ def test_buggify_composes_with_multi_device_mesh():
         np.asarray(sharded.state.strag.valid),
         np.asarray(single.state.strag.valid),
     )
+
+
+def test_cooperative_buggify_raft_leader_mute():
+    """The spec-side cooperative fault hook (spec.buggify, the
+    buggify.rs:8-32 analog): raft with leaders randomly going silent for
+    a tick must still hold every safety invariant under partitions, and
+    the fault point must actually perturb trajectories (same seeds, more
+    elections than the unbuggified run)."""
+    from madsim_tpu.tpu import make_raft_spec
+
+    cfg = SimConfig(
+        horizon_us=5_000_000,
+        loss_rate=0.05,
+        partition_interval_lo_us=400_000,
+        partition_interval_hi_us=1_500_000,
+        partition_heal_lo_us=500_000,
+        partition_heal_hi_us=2_000_000,
+    )
+    plain = BatchedSim(make_raft_spec(5), cfg).run(
+        jnp.arange(64), max_steps=30_000
+    )
+    bugged = BatchedSim(make_raft_spec(5, buggify_rate=0.25), cfg).run(
+        jnp.arange(64), max_steps=30_000
+    )
+    assert summarize(plain)["violations"] == 0
+    assert summarize(bugged)["violations"] == 0
+    terms_plain = np.asarray(plain.node.term).max(axis=1)
+    terms_bugged = np.asarray(bugged.node.term).max(axis=1)
+    # silent leaders force re-elections: term churn must rise
+    assert terms_bugged.mean() > terms_plain.mean() + 0.5, (
+        terms_plain.mean(), terms_bugged.mean(),
+    )

@@ -404,9 +404,9 @@ def main() -> None:
             "one chip here). Headline keeps the zero-drop discipline "
             "(overflow==0 at first-free ring depths 4/2); configs that "
             "tolerate ~0.003% drops measure ~15-20% faster. Virtual time "
-            "is now unbounded (epoch+offset rebasing; int64 tensors "
-            "measured 93x slower than int32 on v5e, so offsets stay "
-            "int32). The C++ denominator swings with host contention "
+            "is now unbounded (epoch+offset rebasing; int64 time tensors "
+            "measure 2-3x slower than int32 on v5e reductions and double "
+            "the bytes, so offsets stay int32). The C++ denominator swings with host contention "
             "(419-837 seeds/s across r4 runs); compare vs_baseline across "
             "rounds with that in mind."
         ),

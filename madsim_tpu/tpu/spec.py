@@ -57,8 +57,10 @@ INF_US = jnp.int32(2**31 - 1)
 # epoch increments. Absolute virtual time = epoch * REBASE_US + offset,
 # giving ~2^59 us (~18k years) of headroom — the reference's effectively
 # unbounded clock (time/mod.rs:21-225) — while every hot-path comparison
-# stays int32: int64 min/argmin measured 93x slower than int32 on TPU v5e
-# (see BENCH notes), so literally widening the tensors was not an option.
+# stays int32: int64 min/argmin measures 2-3x slower than int32 on TPU
+# v5e and doubles every time tensor's bytes in a bandwidth-bound step
+# (benches/micro_gather.py), so widening the tensors buys nothing the
+# epoch cannot provide for free.
 # Values >= INF_GUARD are sentinels (disarmed timers, disabled chaos) and
 # are never rebased; real offsets stay far below it by construction
 # (offset < REBASE_US + horizon-window slack << INF_GUARD).

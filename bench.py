@@ -34,10 +34,11 @@ def raft_bench_config(virtual_secs: float):
         horizon_us=int(virtual_secs * 1e6),
         # ring depths measured for ZERO overflow at 32k lanes x 10 virtual
         # seconds (headline config must drop NOTHING the network didn't
-        # roll to drop): reply positions burst up to 4 acks inside one
-        # latency window when a post-partition backlog drains; timer
-        # broadcasts need 2 (election-win AE overlapping a pending RV)
-        msg_depth_msg=4,
+        # roll to drop): ack bursts spread over raft's TWO alternating
+        # reply rows (RaftState.reply_parity), so depth 2 covers both
+        # candidate classes — and equal depths collapse the pack to one
+        # segment (the mixed-depth concat tax measured ~0.5 ms/step)
+        msg_depth_msg=2,
         msg_depth_timer=2,
         loss_rate=0.10,
         crash_interval_lo_us=500_000,
@@ -120,20 +121,22 @@ def bench_step_breakdown(lanes: int, virtual_secs: float,
     cfg = raft_bench_config(virtual_secs)
 
     def id_on_message(s, nid, src, kind, payload, now, key):
+        E = spec.max_out_msg
         out = Outbox(
-            valid=jnp.zeros((1,), jnp.bool_),
-            dst=jnp.zeros((1,), jnp.int32),
-            kind=jnp.zeros((1,), jnp.int32),
-            payload=jnp.zeros((1, spec.payload_width), jnp.int32),
+            valid=jnp.zeros((E,), jnp.bool_),
+            dst=jnp.zeros((E,), jnp.int32),
+            kind=jnp.zeros((E,), jnp.int32),
+            payload=jnp.zeros((E, spec.payload_width), jnp.int32),
         )
         return s, out, jnp.int32(-1)
 
     def id_on_timer(s, nid, now, key):
+        E = spec.max_out
         out = Outbox(
-            valid=jnp.zeros((5,), jnp.bool_),
-            dst=jnp.zeros((5,), jnp.int32),
-            kind=jnp.zeros((5,), jnp.int32),
-            payload=jnp.zeros((5, spec.payload_width), jnp.int32),
+            valid=jnp.zeros((E,), jnp.bool_),
+            dst=jnp.zeros((E,), jnp.int32),
+            kind=jnp.zeros((E,), jnp.int32),
+            payload=jnp.zeros((E, spec.payload_width), jnp.int32),
         )
         return s, out, now + 50_000
 

@@ -73,20 +73,22 @@ def main() -> None:
     spec0 = make_raft_spec(n_nodes=5, client_rate=0.1)
 
     def id_on_message(s, nid, src, kind, payload, now, key):
+        E = spec0.max_out_msg
         out = Outbox(
-            valid=jnp.zeros((1,), jnp.bool_),
-            dst=jnp.zeros((1,), jnp.int32),
-            kind=jnp.zeros((1,), jnp.int32),
-            payload=jnp.zeros((1, spec0.payload_width), jnp.int32),
+            valid=jnp.zeros((E,), jnp.bool_),
+            dst=jnp.zeros((E,), jnp.int32),
+            kind=jnp.zeros((E,), jnp.int32),
+            payload=jnp.zeros((E, spec0.payload_width), jnp.int32),
         )
         return s, out, jnp.int32(-1)
 
     def id_on_timer(s, nid, now, key):
+        E = spec0.max_out
         out = Outbox(
-            valid=jnp.zeros((5,), jnp.bool_),
-            dst=jnp.zeros((5,), jnp.int32),
-            kind=jnp.zeros((5,), jnp.int32),
-            payload=jnp.zeros((5, spec0.payload_width), jnp.int32),
+            valid=jnp.zeros((E,), jnp.bool_),
+            dst=jnp.zeros((E,), jnp.int32),
+            kind=jnp.zeros((E,), jnp.int32),
+            payload=jnp.zeros((E, spec0.payload_width), jnp.int32),
         )
         return s, out, now + 50_000
 

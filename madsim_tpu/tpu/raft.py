@@ -77,6 +77,13 @@ class RaftState(NamedTuple):
     next_idx: jnp.ndarray  # i32 [N] absolute      (leader volatile)
     match_idx: jnp.ndarray  # i32 [N] absolute     (leader volatile)
     next_cmd: jnp.ndarray  # i32 client-write counter
+    # which of the TWO reply outbox rows the next reply uses (volatile).
+    # All of a follower's acks target one leader, so a single reply row
+    # funnels every ack through one pool ring; alternating rows halves the
+    # per-ring burst depth (ack bursts of 4 inside one latency window ->
+    # 2 per ring), letting the headline config run uniform ring depth 2
+    # (single pack segment — the mixed-depth concat tax measured ~0.5 ms)
+    reply_parity: jnp.ndarray  # i32 0|1            (volatile)
 
 
 def _chain_fold(h, term, cmd):
@@ -154,6 +161,7 @@ def make_raft_spec(
             next_idx=jnp.zeros((N,), jnp.int32),
             match_idx=jnp.full((N,), -1, jnp.int32),
             next_cmd=jnp.int32(1),
+            reply_parity=jnp.int32(0),
         )
         return state, election_deadline(jnp.int32(0), key, 20)
 
@@ -446,6 +454,11 @@ def make_raft_spec(
                 jnp.where(adopt, snap_idx, s.commit),
             ),
         )
+        # -- reply: RV => VOTE_RESP; AE/SNAP => APPEND_RESP; else nothing.
+        # The reply alternates between the two outbox rows (reply_parity)
+        # so ack bursts to one leader spread over two pool rings — see the
+        # RaftState.reply_parity comment.
+        replies = is_rv | is_ae | is_sn
         state = s._replace(
             term=term, role=role, voted_for=voted_for, votes=votes,
             base=jnp.where(adopt, snap_idx + 1, s.base),
@@ -454,22 +467,24 @@ def make_raft_spec(
             log_term=log_term_new, log_cmd=log_cmd_new,
             log_chain=log_chain_new, log_len=log_len_new,
             commit=commit, next_idx=next_idx, match_idx=match_idx,
+            reply_parity=jnp.where(replies, 1 - s.reply_parity, s.reply_parity),
         )
-
-        # -- reply: RV => VOTE_RESP; AE/SNAP => APPEND_RESP; else nothing
-        replies = is_rv | is_ae | is_sn
         r_kind = jnp.where(is_rv, VOTE_RESP, APPEND_RESP)
         r_f1 = jnp.where(
             is_rv, grant.astype(jnp.int32),
             jnp.where(is_ae, ae_ok, ~stale_ldr).astype(jnp.int32),
         )
         r_f2 = jnp.where(is_ae, match_ae, match_sn)
+        at_row = jnp.arange(2) == s.reply_parity  # [2]
         out = Outbox(
-            valid=jnp.reshape(replies, (1,)),
-            dst=jnp.reshape(src, (1,)).astype(jnp.int32),
-            kind=jnp.reshape(r_kind, (1,)).astype(jnp.int32),
-            payload=jnp.reshape(
-                pack(term, r_f1, r_f2, 0, 0, 0), (1, PAYLOAD_WIDTH)
+            valid=at_row & replies,
+            dst=jnp.full((2,), src, jnp.int32),
+            kind=jnp.full((2,), r_kind, jnp.int32),
+            payload=jnp.where(
+                at_row[:, None],
+                jnp.reshape(pack(term, r_f1, r_f2, 0, 0, 0),
+                            (1, PAYLOAD_WIDTH)),
+                0,
             ),
         )
 
@@ -492,6 +507,7 @@ def make_raft_spec(
             commit=s.base - 1,
             next_idx=jnp.zeros((N,), jnp.int32),
             match_idx=jnp.full((N,), -1, jnp.int32),
+            reply_parity=jnp.int32(0),
         )
         return state, election_deadline(now, key, 25)
 
@@ -580,7 +596,7 @@ def make_raft_spec(
         n_nodes=N,
         payload_width=PAYLOAD_WIDTH,
         max_out=N,
-        max_out_msg=1,
+        max_out_msg=2,
         init=init,
         on_message=on_message,
         on_timer=on_timer,

@@ -586,9 +586,13 @@ def test_unsafe_election_bug_caught_by_leader_completeness():
         voted_for = jnp.where(newer, -1, s.voted_for)
         # the buggy grant: no comparison of candidate log freshness
         grant = is_rv & (c_term == term) & ((voted_for == -1) | (voted_for == src))
-        # overwrite the VOTE_RESP's granted field and record the vote
-        pay = out.payload.at[0, 1].set(
-            jnp.where(is_rv, grant.astype(jnp.int32), out.payload[0, 1])
+        # overwrite the VOTE_RESP's granted field in WHICHEVER outbox row
+        # carries the reply (replies alternate rows via reply_parity)
+        pay = jnp.where(
+            (is_rv & out.valid)[:, None]
+            & (jnp.arange(out.payload.shape[1]) == 1)[None, :],
+            grant.astype(jnp.int32),
+            out.payload,
         )
         state = state._replace(
             voted_for=jnp.where(is_rv & grant, src, state.voted_for)

@@ -657,12 +657,16 @@ def kv_workload(
         return linearize.check_lanes(state.node, lanes)
 
     def host_repro(seed: int):
-        """Re-run ONE seed single-lane and hand its full history to the
-        linearizability checker — the kv microscope (no host twin exists
-        for this protocol; the device trace + exact checker are the DX)."""
+        """Two microscopes for one seed: (a) re-run it single-lane on
+        device and hand the full history to the exact linearizability
+        checker; (b) run the HOST TWIN (workloads/kv_host.py — same
+        protocol as coroutines over the debuggable runtime, print
+        statements and breakpoints welcome) under the same seed's chaos
+        flavor, verified by the same oracle."""
         import jax.numpy as jnp
 
         from . import linearize
+        from ..workloads import kv_host
         from .engine import BatchedSim
 
         sim = BatchedSim(the_spec, cfg)
@@ -670,7 +674,16 @@ def kv_workload(
             jnp.asarray([seed], jnp.uint32),
             max_steps=int(virtual_secs * 1200) + 2000,
         )
-        return linearize.check_lane(state.node, 0)
+        out = {"device": linearize.check_lane(state.node, 0)}
+        try:
+            out["host_twin"] = kv_host.fuzz_one_seed(
+                seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+                loss_rate=loss_rate, partitions=partitions,
+            )
+        except kv_host.InvariantViolation as e:
+            out["host_twin"] = e
+        out["violations"] = out["device"]["violations"]
+        return out
 
     return BatchWorkload(
         spec=the_spec,

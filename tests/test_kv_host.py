@@ -1,0 +1,42 @@
+"""The kv host twin (workloads/kv_host.py): same protocol as tpu/kv.py on
+the host runtime, verified by the SAME exact oracle (per-key Wing-Gong
+linearizability + revision monotonicity) — kv's debuggable second face."""
+
+import pytest
+
+from madsim_tpu.workloads.kv_host import InvariantViolation, fuzz_one_seed
+
+
+def test_clean_kv_linearizable_under_partitions():
+    for seed in (1, 2, 3):
+        r = fuzz_one_seed(seed, virtual_secs=5.0, partitions=True)
+        assert r["acked_ops"] > 20, r
+        assert r["max_epoch"] > 0
+
+
+def test_determinism_same_seed_same_stats():
+    a = fuzz_one_seed(7, virtual_secs=3.0)
+    b = fuzz_one_seed(7, virtual_secs=3.0)
+    assert a == b
+
+
+@pytest.mark.deep
+def test_buggy_local_reads_caught_by_linearizability():
+    """The planted stale-read bug (serve reads locally, no quorum probe)
+    must be caught by the host oracle under partitions — the same bug
+    class the device face plants and catches (tpu/kv.py
+    buggy_local_read_spec)."""
+    caught = 0
+    for seed in range(12):
+        try:
+            fuzz_one_seed(seed, virtual_secs=8.0, partitions=True, buggy=True)
+        except InvariantViolation:
+            caught += 1
+    assert caught > 0, "the stale-read bug was never caught in 12 seeds"
+
+
+@pytest.mark.deep
+def test_clean_kv_with_crashes_and_partitions():
+    for seed in (11, 12):
+        r = fuzz_one_seed(seed, virtual_secs=8.0, chaos=True, partitions=True)
+        assert r["acked_ops"] > 10, r

@@ -155,16 +155,25 @@ class KvNode:
     # ------------------------------------------------------- quorum rounds
 
     async def _gather(self, make_call) -> int:
-        """Fan a call to every peer, return 1 + positive acks (self counts)."""
-        acks = 1
-        for peer in range(self.n):
-            if peer == self.node_id:
-                continue
+        """Fan a call to every peer CONCURRENTLY; 1 + positive acks (self
+        counts). Serial awaits would stack up to (n-1) x RPC_TIMEOUT of
+        pure waiting under a partition — enough to starve client timeouts
+        and stretch the heartbeat period past follower patience."""
+
+        async def one(peer):
             try:
-                if await make_call(peer):
-                    acks += 1
+                return bool(await make_call(peer))
             except (ms.time.TimeoutError_, OSError, ms.sync.ChannelClosed):
-                pass
+                return False
+
+        tasks = [
+            ms.spawn(one(peer))
+            for peer in range(self.n) if peer != self.node_id
+        ]
+        acks = 1
+        for t in tasks:
+            if await t:
+                acks += 1
         return acks
 
     async def quorum_write(self, key: int, val: int) -> Optional[int]:
@@ -194,7 +203,10 @@ class KvNode:
                 RPC_TIMEOUT, rpc.call(self.ep, self.addrs[peer], ReadProbe(epoch))
             )
 
-        return self.epoch == epoch and (await self._gather(call)) > self.n // 2
+        # depose re-check must run AFTER the gather (a mid-probe adopt of
+        # a higher epoch invalidates the mandate even with majority acks)
+        acks = await self._gather(call)
+        return self.epoch == epoch and acks > self.n // 2
 
     async def try_claim(self) -> None:
         gen = self.epoch // self.n + 1
@@ -254,16 +266,17 @@ class KvNode:
             await ms.time.sleep(TICK)
             now = ms.time.current().elapsed()
             if self.role == PRIMARY:
-                if self.serving:
-                    epoch = self.epoch
+                # (recovery runs inside try_claim, so this loop only ever
+                # heartbeats for a serving primary)
+                epoch = self.epoch
 
-                    async def hb(peer):
-                        return await ms.time.timeout(
-                            RPC_TIMEOUT,
-                            rpc.call(self.ep, self.addrs[peer], Heartbeat(epoch)),
-                        )
+                async def hb(peer):
+                    return await ms.time.timeout(
+                        RPC_TIMEOUT,
+                        rpc.call(self.ep, self.addrs[peer], Heartbeat(epoch)),
+                    )
 
-                    await self._gather(hb)
+                await self._gather(hb)
             elif now - self.last_hb > hb_timeout:
                 await self.try_claim()
                 hb_timeout = HB_TIMEOUT_LO + ms.rand() * (

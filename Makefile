@@ -1,0 +1,35 @@
+# Developer entry points (the reference's Makefile:1-24 analog: its targets
+# build+test every crate twice, normal and --cfg madsim; ours split by test
+# tier and mode instead — the sim/real duality is exercised inside the
+# suite via MADSIM_NET_BACKEND / real-mode tests).
+
+PY ?= python
+
+.PHONY: test deep test-all real native bench dryrun demo clean
+
+test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
+	$(PY) -m pytest tests/ -q
+
+deep:            ## deep device sweeps (~10 min; CI nightly)
+	$(PY) -m pytest tests/ -q -m deep
+
+test-all: test deep
+
+real:            ## real-socket mode across all three net backends
+	$(PY) -m pytest tests/test_real_mode.py tests/test_unix.py -q
+
+native:          ## (re)build the C++ executor core in place
+	$(PY) setup_native.py build_ext --inplace
+
+bench:           ## the headline JSON line (runs on the live jax backend)
+	$(PY) bench.py
+
+dryrun:          ## multi-chip sharding dry run on a virtual 8-device mesh
+	cd /tmp && $(PY) $(CURDIR)/__graft_entry__.py
+
+demo:            ## the fuzz workflow end to end (plant bug, sweep, trace)
+	$(PY) examples/fuzz_demo.py
+
+clean:
+	rm -rf build .pytest_cache madsim_tpu/native/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

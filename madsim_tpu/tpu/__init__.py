@@ -28,5 +28,6 @@ from .spec import (  # noqa: F401
     SimConfig,
     empty_outbox,
 )
+from .paxos import PaxosState, make_paxos_spec, paxos_workload  # noqa: F401
 from .twopc import TpcState, make_twopc_spec, twopc_workload  # noqa: F401
 from .trace import TraceEvent, extract_trace, format_trace, trace_seed  # noqa: F401

@@ -65,7 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec
+from .spec import Outbox, ProtocolSpec, majority as majority_of
 
 REPLICA, CLAIMING, PRIMARY = 0, 1, 2
 HB, CLAIM, CLAIM_ACK, WREP, WACK, RPROBE, RACK, CREQ, CRSP = range(9)
@@ -300,9 +300,7 @@ def make_kv_spec(
         f0 = f[0]
 
         def majority(mask):
-            return jax.lax.population_count(
-                mask.astype(jnp.uint32)
-            ).astype(jnp.int32) > N // 2
+            return majority_of(mask, N)
 
         # -- epoch adoption: HB/WREP/RPROBE adopt a higher epoch and
         # refresh last_hb on >=; a CLAIM additionally deposes + drops the

@@ -1,0 +1,329 @@
+"""Single-decree Paxos — the fourth device fuzz protocol.
+
+A fourth *shape* again (tpu/raft.py: symmetric replicated log; tpu/kv.py:
+primary/backup quorum rounds; tpu/twopc.py: asymmetric one-shot commit):
+ballot-numbered two-phase consensus where EVERY node is proposer, acceptor
+and learner at once, and dueling proposers are the steady state rather
+than a fault. Written mask-merged from the start per
+docs/authoring_protocol_specs.md (this file is also the guide's
+"a fourth protocol is an afternoon" claim, made good).
+
+Protocol (the synod, Paxos Made Simple):
+
+  * An undecided node's timer starts a PREPARE round with a fresh unique
+    ballot b = round * N + nid; acceptors promise (never going back on a
+    higher promise) and report their highest accepted (ballot, value).
+  * On a promise majority the proposer enters phase 2 proposing THE
+    HIGHEST-BALLOT ACCEPTED VALUE IT SAW — its own candidate value only
+    if phase 1 found none (the rule that makes Paxos safe; dropping it is
+    this spec's canonical injected bug).
+  * Acceptors accept b's value unless already promised higher; on an
+    ACCEPTED majority the proposer decides and broadcasts DECIDED;
+    learners record it. Decided nodes gossip DECIDED on their timer so
+    laggards (crashed through the decision, partitioned minority) learn.
+  * Random per-node retry timers break proposer duels (the classic
+    livelock); chaos (loss, crashes, partitions, heavy tails) supplies
+    the rest of the adversary.
+
+Safety invariant (per lane, per step): AGREEMENT — all recorded decisions
+across nodes name one value. (Validity holds by construction: values only
+ever originate from proposer candidates or discovered accepteds.)
+
+Durable across crashes: promised / accepted / decided (the acceptor's
+stable storage, Paxos' one hard requirement). Volatile: every proposer
+bookkeeping field.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .spec import Outbox, ProtocolSpec, majority as majority_of
+
+PREPARE, PROMISE, ACCEPT, ACCEPTED, DECIDED = range(5)
+PAYLOAD_WIDTH = 3  # (ballot, value, acc_ballot)
+
+
+class PaxosState(NamedTuple):
+    promised: jnp.ndarray  # i32 highest ballot promised      (durable)
+    acc_bal: jnp.ndarray  # i32 accepted ballot, -1 none      (durable)
+    acc_val: jnp.ndarray  # i32 accepted value                (durable)
+    decided: jnp.ndarray  # i32 decided value, 0 none         (durable)
+    # proposer bookkeeping (volatile)
+    prop_bal: jnp.ndarray  # i32 my live ballot, -1 none
+    prop_phase: jnp.ndarray  # i32 0 idle | 1 preparing | 2 accepting
+    prop_val: jnp.ndarray  # i32 value being pushed in phase 2
+    best_bal: jnp.ndarray  # i32 highest accepted ballot seen in phase 1
+    best_val: jnp.ndarray  # i32 its value
+    acks: jnp.ndarray  # i32 bitmask (promises or accepteds for prop_bal)
+    round: jnp.ndarray  # i32 ballot round counter            (durable)
+
+
+def make_paxos_spec(
+    n_nodes: int = 5,
+    retry_lo_us: int = 150_000,
+    retry_hi_us: int = 400_000,
+    gossip_us: int = 200_000,
+    buggy_ignore_discovered: bool = False,
+) -> ProtocolSpec:
+    """`buggy_ignore_discovered=True` plants the canonical Paxos mistake:
+    phase 2 proposes the proposer's OWN value even when phase 1 discovered
+    an accepted one — safe on a calm network, agreement-splitting the
+    moment chaos lets two ballots' quorums interleave."""
+    N = n_nodes
+    peers = jnp.arange(N, dtype=jnp.int32)
+
+    def majority(mask):
+        return majority_of(mask, N)
+
+    # ------------------------------------------------------------------ init
+
+    def init(key, nid):
+        z = jnp.int32(0)
+        state = PaxosState(
+            promised=jnp.int32(-1),
+            acc_bal=jnp.int32(-1),
+            acc_val=z,
+            decided=z,
+            prop_bal=jnp.int32(-1),
+            prop_phase=z,
+            prop_val=z,
+            best_bal=jnp.int32(-1),
+            best_val=z,
+            acks=z,
+            round=z,
+        )
+        return state, prng.randint(key, 40, 0, retry_hi_us)
+
+    # ----------------------------------------------------------------- timer
+
+    def on_timer(s: PaxosState, nid, now, key):
+        # decided nodes gossip the decision; undecided nodes (re)start a
+        # prepare round with a fresh unique ballot — a stale in-flight
+        # round is simply abandoned (its ballot can never win against the
+        # new one's promises)
+        is_decided = s.decided != 0
+        new_round = s.round + 1
+        bal = new_round * N + nid
+        start = ~is_decided
+        # THE PROPOSER'S OWN NODE IS AN ACCEPTOR TOO — counting a self
+        # promise/acceptance in the quorum without RECORDING it in the
+        # acceptor state is the "phantom self-vote" bug this spec shipped
+        # with and this framework's own fuzz caught within seconds (5/256
+        # lanes; two ACCEPT rounds with different values whose quorums
+        # intersected only at the phantom voter — docs/bugs_found.md #8).
+        # Self-promise follows the same rule as any acceptor: only if the
+        # fresh ballot beats every prior promise, else the round starts
+        # without the self vote. Self-DISCOVERY likewise: phase 1 begins
+        # from the proposer's own accepted (ballot, value), not from -1.
+        self_prom = start & (bal > s.promised)
+        state = s._replace(
+            promised=jnp.where(self_prom, bal, s.promised),
+            prop_bal=jnp.where(start, bal, s.prop_bal),
+            prop_phase=jnp.where(start, 1, s.prop_phase),
+            prop_val=jnp.where(start, nid * 100_000 + new_round, s.prop_val),
+            best_bal=jnp.where(start, s.acc_bal, s.best_bal),
+            best_val=jnp.where(start, s.acc_val, s.best_val),
+            acks=jnp.where(
+                start,
+                jnp.where(self_prom, jnp.int32(1) << nid, 0),
+                s.acks,
+            ),
+            round=jnp.where(start, new_round, s.round),
+        )
+        pay_prep = jnp.stack([bal, jnp.int32(0), jnp.int32(0)])
+        pay_dec = jnp.stack([jnp.int32(0), s.decided, jnp.int32(0)])
+        out = Outbox(
+            valid=peers != nid,
+            dst=peers,
+            kind=jnp.where(is_decided, DECIDED, PREPARE)
+            * jnp.ones((N,), jnp.int32),
+            payload=jnp.broadcast_to(
+                jnp.where(is_decided, pay_dec, pay_prep)[None, :],
+                (N, PAYLOAD_WIDTH),
+            ),
+        )
+        timer = now + jnp.where(
+            is_decided,
+            gossip_us,
+            prng.randint(key, 41, retry_lo_us, retry_hi_us),
+        )
+        return state, out, timer
+
+    # --------------------------------------------------------------- message
+
+    def on_message(s: PaxosState, nid, src, kind, payload, now, key):
+        """All five kinds, mask-merged (see the authoring guide on why:
+        a vmapped lax.switch executes every branch)."""
+        bal, val, a_bal = payload[0], payload[1], payload[2]
+        is_prep = kind == PREPARE
+        is_prom = kind == PROMISE
+        is_acc = kind == ACCEPT
+        is_acd = kind == ACCEPTED
+        is_dec = kind == DECIDED
+
+        # -- acceptor, PREPARE: promise iff ballot beats any prior promise
+        prep_ok = is_prep & (bal > s.promised)
+        # -- acceptor, ACCEPT: accept iff not promised beyond this ballot
+        acc_ok = is_acc & (bal >= s.promised)
+        promised = jnp.where(
+            prep_ok | acc_ok, jnp.maximum(s.promised, bal), s.promised
+        )
+        acc_bal = jnp.where(acc_ok, bal, s.acc_bal)
+        acc_val = jnp.where(acc_ok, val, s.acc_val)
+
+        # -- proposer, PROMISE tally (phase 1)
+        p_live = (s.prop_phase == 1) & (bal == s.prop_bal)
+        prom_mine = is_prom & p_live
+        acks = jnp.where(prom_mine, s.acks | (jnp.int32(1) << src), s.acks)
+        # fold the responder's highest accepted into the discovery
+        better = prom_mine & (a_bal > s.best_bal)
+        best_bal = jnp.where(better, a_bal, s.best_bal)
+        best_val = jnp.where(better, val, s.best_val)
+        to_phase2 = prom_mine & majority(acks)
+        # THE rule: push the discovered value when one exists
+        if buggy_ignore_discovered:
+            push_val = s.prop_val
+        else:
+            push_val = jnp.where(best_bal >= 0, best_val, s.prop_val)
+
+        # -- proposer, ACCEPTED tally (phase 2)
+        a_live = (s.prop_phase == 2) & (bal == s.prop_bal)
+        acd_mine = is_acd & a_live
+        acks = jnp.where(acd_mine, acks | (jnp.int32(1) << src), acks)
+        wins = acd_mine & majority(acks)
+
+        # -- learner
+        decided = jnp.where(
+            is_dec & (s.decided == 0), val,
+            jnp.where(wins & (s.decided == 0), s.prop_val, s.decided),
+        )
+
+        # entering phase 2, the proposer SELF-ACCEPTS (recording it!) iff
+        # its ballot still satisfies its own acceptor's promise — the other
+        # half of the phantom-self-vote fix
+        self_acc = to_phase2 & (s.prop_bal >= promised)
+        state = s._replace(
+            promised=jnp.where(self_acc, jnp.maximum(promised, s.prop_bal),
+                               promised),
+            acc_bal=jnp.where(self_acc, s.prop_bal, acc_bal),
+            acc_val=jnp.where(self_acc, push_val, acc_val),
+            decided=decided,
+            prop_phase=jnp.where(
+                to_phase2, 2, jnp.where(wins, 0, s.prop_phase)
+            ),
+            prop_val=jnp.where(to_phase2, push_val, s.prop_val),
+            best_bal=best_bal,
+            best_val=best_val,
+            acks=jnp.where(
+                to_phase2,
+                jnp.where(self_acc, jnp.int32(1) << nid, 0),
+                acks,
+            ),
+        )
+
+        # -- outbox: replies are single-target (placed in row `src`, so
+        # replies to different peers never share a pool ring); phase
+        # transitions broadcast from all rows
+        bc = to_phase2 | wins  # ACCEPT round or DECIDED announcement
+        bc_kind = jnp.where(to_phase2, ACCEPT, DECIDED)
+        bc_pay = jnp.where(
+            to_phase2,
+            jnp.stack([s.prop_bal, push_val, jnp.int32(0)]),
+            jnp.stack([jnp.int32(0), state.decided, jnp.int32(0)]),
+        )
+        reply = prep_ok | acc_ok
+        r_kind = jnp.where(is_prep, PROMISE, ACCEPTED)
+        r_pay = jnp.where(
+            is_prep,
+            jnp.stack([bal, s.acc_val, s.acc_bal]),
+            jnp.stack([bal, jnp.int32(0), jnp.int32(0)]),
+        )
+        at_row = peers == jnp.where(bc, -1, src)  # row src for replies
+        out = Outbox(
+            valid=jnp.where(bc, peers != nid, reply & at_row),
+            dst=jnp.where(bc, peers, jnp.full((N,), src, jnp.int32)),
+            kind=jnp.where(bc, bc_kind, r_kind) * jnp.ones((N,), jnp.int32),
+            payload=jnp.where(
+                jnp.reshape(bc, (1, 1)),
+                jnp.broadcast_to(bc_pay[None, :], (N, PAYLOAD_WIDTH)),
+                jnp.where(at_row[:, None], r_pay[None, :], 0),
+            ),
+        )
+        return state, out, jnp.int32(-1)
+
+    # --------------------------------------------------------------- restart
+
+    def on_restart(s: PaxosState, nid, now, key):
+        state = s._replace(
+            prop_bal=jnp.int32(-1),
+            prop_phase=jnp.int32(0),
+            prop_val=jnp.int32(0),
+            best_bal=jnp.int32(-1),
+            best_val=jnp.int32(0),
+            acks=jnp.int32(0),
+        )
+        return state, now + prng.randint(key, 42, 0, retry_hi_us)
+
+    # ------------------------------------------------------------ invariants
+
+    def check_invariants(ns: PaxosState, alive, now):
+        # AGREEMENT: all nonzero decisions equal (pairwise over [N])
+        d = ns.decided
+        have = d != 0
+        disagree = (
+            have[:, None] & have[None, :] & (d[:, None] != d[None, :])
+        )
+        return ~disagree.any()
+
+    def lane_metrics(node):
+        have = node.decided != 0  # [L,N]
+        return {
+            "all_decided_lanes": have.all(axis=-1),
+            "mean_decided_nodes": have.sum(axis=-1).astype(jnp.float32),
+        }
+
+    return ProtocolSpec(
+        name=f"paxos{N}",
+        n_nodes=N,
+        payload_width=PAYLOAD_WIDTH,
+        max_out=N,
+        max_out_msg=N,  # a final PROMISE/ACCEPTED triggers a broadcast
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+        lane_metrics=lane_metrics,
+        msg_kind_names=("PREPARE", "PROMISE", "ACCEPT", "ACCEPTED", "DECIDED"),
+    )
+
+
+def paxos_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
+                   loss_rate: float = 0.1):
+    """Single-decree consensus under the full chaos battery."""
+    from .batch import BatchWorkload
+    from .spec import SimConfig
+
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        # reply rings need 3: a proposer can broadcast ACCEPT and DECIDED
+        # from the same message rows within one latency window, on top of
+        # an in-flight reply (measured: depth 2 dropped ~1 per 32 lanes)
+        msg_depth_msg=3,
+        msg_depth_timer=2,
+        loss_rate=loss_rate,
+        crash_interval_lo_us=400_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=1_000_000,
+        partition_interval_lo_us=300_000,
+        partition_interval_hi_us=1_500_000,
+        partition_heal_lo_us=400_000,
+        partition_heal_hi_us=1_500_000,
+    )
+    return BatchWorkload(spec=make_paxos_spec(n_nodes), config=cfg)

@@ -86,6 +86,15 @@ def buggify(key, site: int, p: float = 0.25):
     return prng.bernoulli(key, site, p)
 
 
+def majority(mask, n_nodes: int):
+    """Popcount-majority over an int32 ack bitmask (> n/2). Shared by every
+    quorum-based spec; note the bitmask representation caps n_nodes at 31
+    (`1 << nid` in int32) — widen the mask dtype before going bigger."""
+    return jax.lax.population_count(
+        mask.astype(jnp.uint32)
+    ).astype(jnp.int32) > n_nodes // 2
+
+
 def tree_select(cond, a, b):
     """Elementwise pytree select on a traced scalar condition — the shared
     helper behind every spec's pick_out/pick_state (works for Outbox, state

@@ -42,7 +42,9 @@ def buggy_raft_spec():
         )
         return state._replace(commit=bogus), out, timer
 
-    return dataclasses.replace(spec, on_message=buggy_on_message)
+    # on_event=None: replacing on_message on a fused spec must also drop
+    # the fused handler, or the engine keeps using the original body
+    return dataclasses.replace(spec, on_message=buggy_on_message, on_event=None)
 
 
 def main(n_seeds: int = 2048) -> None:

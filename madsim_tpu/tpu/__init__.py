@@ -27,6 +27,7 @@ from .spec import (  # noqa: F401
     REBASE_US,
     SimConfig,
     empty_outbox,
+    replace_handlers,
 )
 from .paxos import PaxosState, make_paxos_spec, paxos_workload  # noqa: F401
 from .twopc import TpcState, make_twopc_spec, twopc_workload  # noqa: F401

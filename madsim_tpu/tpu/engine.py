@@ -216,18 +216,42 @@ class BatchedSim:
             v = getattr(cfg, name)
             if v is not None and v < 1:
                 raise ValueError(f"{name} must be >= 1, got {v}")
+        if cfg.msg_spare_slots < 0:
+            raise ValueError(
+                f"msg_spare_slots must be >= 0, got {cfg.msg_spare_slots}"
+            )
+        if (
+            spec.on_event is not None
+            and cfg.msg_depth_timer is not None
+            and cfg.msg_depth_msg is not None
+            and cfg.msg_depth_timer != cfg.msg_depth_msg
+        ):
+            raise ValueError(
+                "fused (on_event) specs have ONE candidate class: "
+                "msg_depth_timer has no effect and must equal msg_depth_msg "
+                f"(got {cfg.msg_depth_timer} != {cfg.msg_depth_msg}); tune "
+                "msg_depth_msg and msg_spare_slots instead"
+            )
         import numpy as _np
 
-        # Candidate positions: the fixed send sites of one step — each node's
-        # max_out_msg on_message slots then its max_out on_timer slots, in
-        # flat() order. Position c's source node is a compile-time constant.
-        self._C = N * spec.max_out_msg + N * spec.max_out
-        self._src_of_c = _np.concatenate(
-            [
-                _np.arange(N * spec.max_out_msg) // spec.max_out_msg,
-                _np.arange(N * spec.max_out) // spec.max_out,
-            ]
-        )
+        # Candidate positions: the fixed send sites of one step. Fused
+        # (spec.on_event) specs have ONE event per node per step emitting up
+        # to max_out rows => C = N * max_out; two-handler specs have each
+        # node's max_out_msg on_message slots then its max_out on_timer
+        # slots, in flat() order. Position c's source node is a
+        # compile-time constant either way.
+        self._fused = spec.on_event is not None
+        if self._fused:
+            self._C = N * spec.max_out
+            self._src_of_c = _np.arange(self._C) // spec.max_out
+        else:
+            self._C = N * spec.max_out_msg + N * spec.max_out
+            self._src_of_c = _np.concatenate(
+                [
+                    _np.arange(N * spec.max_out_msg) // spec.max_out_msg,
+                    _np.arange(N * spec.max_out) // spec.max_out,
+                ]
+            )
         # Main pool: candidate position c owns K consecutive ring slots;
         # msg_capacity is the TOTAL ring-slot budget per lane (C * K ~
         # msg_capacity, the r3 semantics — per-destination state is just
@@ -237,28 +261,45 @@ class BatchedSim:
         uniform = max(1, cfg.msg_capacity // self._C)
         self._Km = cfg.msg_depth_msg or uniform
         self._Kt = cfg.msg_depth_timer or uniform
-        self._Cm = N * spec.max_out_msg
-        self._Ct = N * spec.max_out
-        self._Sm = self._Cm * self._Km  # slots of the message-position segment
-        self._CK = self._Sm + self._Ct * self._Kt
-        self._src_of_slot = jnp.asarray(
-            _np.concatenate([
-                _np.repeat(self._src_of_c[: self._Cm], self._Km),
-                _np.repeat(self._src_of_c[self._Cm :], self._Kt),
-            ]),
-            jnp.int32,
-        )  # [CK]
-        # pack segments: (cand lo, cand hi, depth, slot lo, slot hi). Equal
-        # depths collapse to ONE segment: the per-segment path concatenates
-        # full pool-sized parts (extra HBM copies), so the uniform case must
-        # not pay for the split.
-        if self._Km == self._Kt:
-            self._segs = ((0, self._C, self._Km, 0, self._CK),)
+        if self._fused:
+            # NODE-POOLED slots: node n owns the SK = E*K (+ spare)
+            # contiguous slots [n*SK, (n+1)*SK), shared by ALL its sends —
+            # a send takes the i-th free slot of its node's pool, not a
+            # fixed per-row ring. Bursts that cluster on one row (an ack
+            # burst plus a broadcast in one latency window) then borrow
+            # slack from quiet rows: depth 2 + 2 spare absorbs election
+            # storms that per-row rings drop, at 2 extra slots instead of
+            # a whole extra depth level (+E slots).
+            self._Kt = self._Km
+            self._SK = spec.max_out * self._Km + cfg.msg_spare_slots
+            self._CK = N * self._SK
+            self._src_of_slot = jnp.asarray(
+                _np.repeat(_np.arange(N), self._SK), jnp.int32
+            )  # [CK]
+            self._segs = None
         else:
-            self._segs = (
-                (0, self._Cm, self._Km, 0, self._Sm),
-                (self._Cm, self._C, self._Kt, self._Sm, self._CK),
-            )
+            self._Cm = N * spec.max_out_msg
+            self._Ct = N * spec.max_out
+            self._Sm = self._Cm * self._Km  # slots of the msg-position segment
+            self._CK = self._Sm + self._Ct * self._Kt
+            self._src_of_slot = jnp.asarray(
+                _np.concatenate([
+                    _np.repeat(self._src_of_c[: self._Cm], self._Km),
+                    _np.repeat(self._src_of_c[self._Cm :], self._Kt),
+                ]),
+                jnp.int32,
+            )  # [CK]
+            # pack segments: (cand lo, cand hi, depth, slot lo, slot hi).
+            # Equal depths collapse to ONE segment: the per-segment path
+            # concatenates full pool-sized parts (extra HBM copies), so the
+            # uniform case must not pay for the split.
+            if self._Km == self._Kt:
+                self._segs = ((0, self._C, self._Km, 0, self._CK),)
+            else:
+                self._segs = (
+                    (0, self._Cm, self._Km, 0, self._Sm),
+                    (self._Cm, self._C, self._Kt, self._Sm, self._CK),
+                )
         # Straggler side pool (only when the heavy tail is on)
         if cfg.buggify_delay_rate > 0:
             self._K4 = max(1, cfg.buggify_depth)
@@ -273,14 +314,20 @@ class BatchedSim:
         # under the lookahead window, nodes in one step process events at
         # different virtual times.
         self._v_init = jax.vmap(jax.vmap(spec.init, in_axes=(0, 0)), in_axes=(0, None))
-        self._v_on_message = jax.vmap(
-            jax.vmap(spec.on_message, in_axes=(0, 0, 0, 0, 0, 0, 0)),
-            in_axes=(0, 0, 0, 0, 0, 0, 0),
-        )
-        self._v_on_timer = jax.vmap(
-            jax.vmap(spec.on_timer, in_axes=(0, 0, 0, 0)),
-            in_axes=(0, 0, 0, 0),
-        )
+        if self._fused:
+            self._v_on_event = jax.vmap(
+                jax.vmap(spec.on_event, in_axes=(0, 0, 0, 0, 0, 0, 0)),
+                in_axes=(0, 0, 0, 0, 0, 0, 0),
+            )
+        else:
+            self._v_on_message = jax.vmap(
+                jax.vmap(spec.on_message, in_axes=(0, 0, 0, 0, 0, 0, 0)),
+                in_axes=(0, 0, 0, 0, 0, 0, 0),
+            )
+            self._v_on_timer = jax.vmap(
+                jax.vmap(spec.on_timer, in_axes=(0, 0, 0, 0)),
+                in_axes=(0, 0, 0, 0),
+            )
         self._v_on_restart = jax.vmap(
             jax.vmap(spec.on_restart, in_axes=(0, 0, None, 0)), in_axes=(0, 0, 0, 0)
         )
@@ -509,10 +556,6 @@ class BatchedSim:
         else:
             restart_mask = None
 
-        ns_m, out_m, timer_m = self._v_on_message(
-            state.node, node_ids, m_src, m_kind, m_pay, t_evt, mkeys
-        )
-        ns_t, out_t, timer_t = self._v_on_timer(state.node, node_ids, t_evt, tkeys)
         if cfg.chaos_enabled:
             # `now` for a restarting node is the chaos instant t_next (the
             # window collapses to it on chaos steps), never an earlier
@@ -521,21 +564,58 @@ class BatchedSim:
                 state.node, node_ids, t_next, rkeys
             )
 
-        def merge(old, m, t, r):
-            mk = has_msg.reshape(has_msg.shape + (1,) * (old.ndim - 2))
-            tk = due_t.reshape(mk.shape)
-            out = jnp.where(tk, t, jnp.where(mk, m, old))
-            if r is not None:
-                rk = restart_mask.reshape(mk.shape)
-                out = jnp.where(rk, r, out)
-            return out
-
-        if cfg.chaos_enabled:
-            node = jax.tree_util.tree_map(merge, state.node, ns_m, ns_t, ns_r)
-        else:
-            node = jax.tree_util.tree_map(
-                lambda old, m, t: merge(old, m, t, None), state.node, ns_m, ns_t
+        if self._fused:
+            # ONE handler invocation per node per step: kind == -1 encodes
+            # "your timer fired" (see ProtocolSpec.on_event). This avoids
+            # materializing two full candidate states and the 3-way merge —
+            # the dual-handler tax measured larger than either handler body.
+            evt = has_msg | due_t
+            evt_kind = jnp.where(has_msg, m_kind, jnp.int32(-1))
+            ns_e, out_e, timer_e = self._v_on_event(
+                state.node, node_ids, m_src, evt_kind, m_pay, t_evt, mkeys
             )
+
+            def merge(old, e, r):
+                ek = evt.reshape(evt.shape + (1,) * (old.ndim - 2))
+                out = jnp.where(ek, e, old)
+                if r is not None:
+                    rk = restart_mask.reshape(ek.shape)
+                    out = jnp.where(rk, r, out)
+                return out
+
+            if cfg.chaos_enabled:
+                node = jax.tree_util.tree_map(merge, state.node, ns_e, ns_r)
+            else:
+                node = jax.tree_util.tree_map(
+                    lambda old, e: merge(old, e, None), state.node, ns_e
+                )
+            timer_m = timer_t = timer_e
+        else:
+            ns_m, out_m, timer_m = self._v_on_message(
+                state.node, node_ids, m_src, m_kind, m_pay, t_evt, mkeys
+            )
+            ns_t, out_t, timer_t = self._v_on_timer(
+                state.node, node_ids, t_evt, tkeys
+            )
+
+            def merge(old, m, t, r):
+                mk = has_msg.reshape(has_msg.shape + (1,) * (old.ndim - 2))
+                tk = due_t.reshape(mk.shape)
+                out = jnp.where(tk, t, jnp.where(mk, m, old))
+                if r is not None:
+                    rk = restart_mask.reshape(mk.shape)
+                    out = jnp.where(rk, r, out)
+                return out
+
+            if cfg.chaos_enabled:
+                node = jax.tree_util.tree_map(
+                    merge, state.node, ns_m, ns_t, ns_r
+                )
+            else:
+                node = jax.tree_util.tree_map(
+                    lambda old, m, t: merge(old, m, t, None),
+                    state.node, ns_m, ns_t,
+                )
         # message handlers return a negative timer to keep the current
         # deadline; timer handlers return a negative value to disarm
         timer = jnp.where(has_msg & (timer_m >= 0), timer_m, state.timer)
@@ -641,14 +721,18 @@ class BatchedSim:
                 out.payload.reshape(L, N * e, P),
             )
 
-        E_m, E_t = spec.max_out_msg, spec.max_out
-        mv, md, mk, mp = flat(out_m, has_msg, E_m)
-        tv, td, tk, tp = flat(out_t, due_t, E_t)
         C = self._C
-        cand_valid = jnp.concatenate([mv, tv], axis=1)  # [L,C]
-        cand_dst = jnp.clip(jnp.concatenate([md, td], axis=1), 0, N - 1)
-        cand_kind = jnp.concatenate([mk, tk], axis=1)
-        cand_pay = jnp.concatenate([mp, tp], axis=1)
+        if self._fused:
+            cand_valid, cd, cand_kind, cand_pay = flat(out_e, evt, spec.max_out)
+            cand_dst = jnp.clip(cd, 0, N - 1)
+        else:
+            E_m, E_t = spec.max_out_msg, spec.max_out
+            mv, md, mk, mp = flat(out_m, has_msg, E_m)
+            tv, td, tk, tp = flat(out_t, due_t, E_t)
+            cand_valid = jnp.concatenate([mv, tv], axis=1)  # [L,C]
+            cand_dst = jnp.clip(jnp.concatenate([md, td], axis=1), 0, N - 1)
+            cand_kind = jnp.concatenate([mk, tk], axis=1)
+            cand_pay = jnp.concatenate([mp, tp], axis=1)
 
         # network rolls: loss + latency (+ buggify heavy-tail coin)
         cidx = jnp.arange(C, dtype=jnp.uint32)[None, :]
@@ -686,66 +770,125 @@ class BatchedSim:
         # measured from the send instant, not the lane's window maximum
         deliver_at = t_evt[:, self._src_of_c] + lat.astype(jnp.int32)  # [L,C]
 
-        # main-pool pack: candidate c's message takes the FIRST of its K
-        # ring slots that no destination still references; if all K are
-        # pending the send is DROPPED (counted) — overwriting one would
-        # corrupt a message in flight. Everything is elementwise on
-        # [L,c,K] / [L,N,c,K] masks, per depth segment (see SimConfig).
         send = keep & ~bug  # [L,C] candidate sends this step
-        dst_major = cand_dst_oh.transpose(0, 2, 1)  # [L,N,C]
-        ring_w_parts = []  # [L, nc*K] ring-slot write masks
-        place_parts = []  # [L, N, nc*K] validity-bit writes
-        ovf = jnp.zeros((L,), jnp.int32)
-        for c0, c1, K, s0, s1 in self._segs:
-            nc = c1 - c0
-            send_seg = send[:, c0:c1]  # [L,nc]
-            free = ~valid[:, :, s0:s1].reshape(L, N, nc, K).any(1)  # [L,nc,K]
-            ring_w = send_seg[:, :, None] & _first_free(free, K)  # [L,nc,K]
-            placed = ring_w.any(2)  # [L,nc]
-            ovf = ovf + (send_seg & ~placed).sum(axis=1, dtype=jnp.int32)
-            ring_w_parts.append(ring_w.reshape(L, nc * K))
-            place_parts.append(
-                (dst_major[:, :, c0:c1, None] & ring_w[:, None]).reshape(
-                    L, N, nc * K
-                )
-            )
-        ring_w = (
-            ring_w_parts[0] if len(ring_w_parts) == 1
-            else jnp.concatenate(ring_w_parts, axis=1)
-        )  # [L,CK]
-        written = (
-            place_parts[0] if len(place_parts) == 1
-            else jnp.concatenate(place_parts, axis=2)
-        )  # [L,N,CK]
-        overflow = state.overflow + ovf
+        if self._fused:
+            # NODE-POOLED pack (fused specs): the i-th valid send of node n
+            # takes the i-th free slot of n's SK-slot pool — rank matching,
+            # fully parallel (no sequential first-free over rows), and
+            # bursts that cluster on one outbox row borrow slack from quiet
+            # rows. A send ranks past the free count => DROPPED (counted):
+            # overwriting a pending slot would corrupt a message in flight.
+            E, SK = spec.max_out, self._SK
+            send_n = send.reshape(L, N, E)
+            free = (~valid.any(1)).reshape(L, N, SK)  # [L,Nsrc,SK]
 
-        def ring_expand(cand_vals):  # [L,C(,P)] -> [L,CK(,P)] per segment
-            outs = []
+            def prefix_counts(m):  # exclusive prefix count, unrolled
+                out = []
+                acc = jnp.zeros(m.shape[:-1], jnp.int32)
+                for k in range(m.shape[-1]):
+                    out.append(acc)
+                    acc = acc + m[..., k].astype(jnp.int32)
+                return jnp.stack(out, -1), acc
+
+            r_send, _ = prefix_counts(send_n)  # [L,N,E]
+            r_free, n_free = prefix_counts(free)  # [L,N,SK], [L,N]
+            place = (
+                send_n[:, :, :, None]
+                & free[:, :, None, :]
+                & (r_send[:, :, :, None] == r_free[:, :, None, :])
+            )  # [L,N,E,SK]
+            ring_w = place.any(2).reshape(L, CK)
+            overflow = state.overflow + (
+                send_n & (r_send >= n_free[:, :, None])
+            ).sum(axis=(1, 2), dtype=jnp.int32)
+            place_i = place.astype(jnp.int32)
+
+            def put(ring_vals, cand_vals):
+                cv = cand_vals.reshape((L, N, E) + cand_vals.shape[2:])
+                if cand_vals.ndim == 2:
+                    inc = (place_i * cv[:, :, :, None]).sum(2)
+                    return jnp.where(ring_w, inc.reshape(L, CK), ring_vals)
+                inc = (place_i[:, :, :, :, None] * cv[:, :, :, None, :]).sum(2)
+                return jnp.where(
+                    ring_w[:, :, None], inc.reshape(L, CK, P), ring_vals
+                )
+
+            # validity bits: dst d references slot s iff the send that
+            # took s targets d
+            dsts = cand_dst_oh.reshape(L, N, E, N)
+            written = (
+                place[:, :, :, :, None] & dsts[:, :, :, None, :]
+            ).any(2).transpose(0, 3, 1, 2).reshape(L, N, CK)
+        else:
+            # per-candidate rings: candidate c's message takes the FIRST of
+            # its K ring slots that no destination still references; if all
+            # K are pending the send is DROPPED (counted). Everything is
+            # elementwise on [L,c,K] / [L,N,c,K] masks, per depth segment
+            # (see SimConfig).
+            dst_major = cand_dst_oh.transpose(0, 2, 1)  # [L,N,C]
+            ring_w_parts = []  # [L, nc*K] ring-slot write masks
+            place_parts = []  # [L, N, nc*K] validity-bit writes
+            ovf = jnp.zeros((L,), jnp.int32)
             for c0, c1, K, s0, s1 in self._segs:
                 nc = c1 - c0
-                seg = cand_vals[:, c0:c1]
-                if cand_vals.ndim == 2:
-                    outs.append(
-                        jnp.broadcast_to(
-                            seg[:, :, None], (L, nc, K)
-                        ).reshape(L, nc * K)
+                send_seg = send[:, c0:c1]  # [L,nc]
+                free = ~valid[:, :, s0:s1].reshape(L, N, nc, K).any(1)
+                ring_w = send_seg[:, :, None] & _first_free(free, K)
+                placed = ring_w.any(2)  # [L,nc]
+                ovf = ovf + (send_seg & ~placed).sum(axis=1, dtype=jnp.int32)
+                ring_w_parts.append(ring_w.reshape(L, nc * K))
+                place_parts.append(
+                    (dst_major[:, :, c0:c1, None] & ring_w[:, None]).reshape(
+                        L, N, nc * K
                     )
-                else:
-                    outs.append(
-                        jnp.broadcast_to(
-                            seg[:, :, None, :], (L, nc, K, P)
-                        ).reshape(L, nc * K, P)
-                    )
-            return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+                )
+            ring_w = (
+                ring_w_parts[0] if len(ring_w_parts) == 1
+                else jnp.concatenate(ring_w_parts, axis=1)
+            )  # [L,CK]
+            written = (
+                place_parts[0] if len(place_parts) == 1
+                else jnp.concatenate(place_parts, axis=2)
+            )  # [L,N,CK]
+            overflow = state.overflow + ovf
 
-        def put(ring_vals, cand_vals):
-            inc = ring_expand(cand_vals)
-            if cand_vals.ndim == 2:
-                return jnp.where(ring_w, inc, ring_vals)
-            return jnp.where(ring_w[:, :, None], inc, ring_vals)
+            def ring_expand(cand_vals):  # [L,C(,P)] -> [L,CK(,P)] per segment
+                outs = []
+                for c0, c1, K, s0, s1 in self._segs:
+                    nc = c1 - c0
+                    seg = cand_vals[:, c0:c1]
+                    if cand_vals.ndim == 2:
+                        outs.append(
+                            jnp.broadcast_to(
+                                seg[:, :, None], (L, nc, K)
+                            ).reshape(L, nc * K)
+                        )
+                    else:
+                        outs.append(
+                            jnp.broadcast_to(
+                                seg[:, :, None, :], (L, nc, K, P)
+                            ).reshape(L, nc * K, P)
+                        )
+                return (
+                    outs[0] if len(outs) == 1
+                    else jnp.concatenate(outs, axis=1)
+                )
+
+            def put(ring_vals, cand_vals):
+                inc = ring_expand(cand_vals)
+                if cand_vals.ndim == 2:
+                    return jnp.where(ring_w, inc, ring_vals)
+                return jnp.where(ring_w[:, :, None], inc, ring_vals)
 
         new_valid = valid | written
-        new_deliver = put(msgs.deliver, deliver_at)
+        # slots no destination references anymore reset their deliver
+        # offset to INF_US: a stale offset would be rebased epoch after
+        # epoch (rb() below) and eventually wrap int32 — benign for current
+        # readers (validity-gated) but a trap, and it makes long-soak state
+        # non-canonical (ADVICE r4)
+        new_deliver = put(
+            jnp.where(valid.any(1), msgs.deliver, INF_US), deliver_at
+        )
         new_kind = put(msgs.kind, cand_kind)
         new_payload = put(msgs.payload, cand_pay)
 

@@ -59,8 +59,15 @@ class RaftState(NamedTuple):
     voted_for: jnp.ndarray  # i32, -1 = none       (durable)
     role: jnp.ndarray  # i32                       (volatile)
     votes: jnp.ndarray  # i32 bitmask              (volatile)
-    # log window: absolute indices [base, log_len) at relative slots
+    # log window: absolute indices [base, log_len) in a CIRCULAR buffer —
+    # absolute index i lives at physical slot (i - base + head) % LOG.
+    # Compaction advances (base, head) WITHOUT touching the arrays (the
+    # r4 physical-shift compaction re-wrote all three log arrays per
+    # compact; at 32k lanes those shift passes were a measured top cost
+    # of the whole step). Freed slots keep stale bytes; every reader
+    # masks to [base, log_len), so they are unreachable.
     base: jnp.ndarray  # i32 first retained index  (durable)
+    head: jnp.ndarray  # i32 physical slot of index `base` (durable)
     base_hash: jnp.ndarray  # i32 chain hash of [0, base)   (durable)
     base_term: jnp.ndarray  # i32 term of entry base-1      (durable)
     log_term: jnp.ndarray  # i32 [LOG] window      (durable)
@@ -77,12 +84,10 @@ class RaftState(NamedTuple):
     next_idx: jnp.ndarray  # i32 [N] absolute      (leader volatile)
     match_idx: jnp.ndarray  # i32 [N] absolute     (leader volatile)
     next_cmd: jnp.ndarray  # i32 client-write counter
-    # which of the TWO reply outbox rows the next reply uses (volatile).
-    # All of a follower's acks target one leader, so a single reply row
-    # funnels every ack through one pool ring; alternating rows halves the
-    # per-ring burst depth (ack bursts of 4 inside one latency window ->
-    # 2 per ring), letting the headline config run uniform ring depth 2
-    # (single pack segment — the mixed-depth concat tax measured ~0.5 ms)
+    # which outbox row (0 or 1) the next reply uses (volatile):
+    # alternating spreads an ack burst inside one latency window over two
+    # rows; the engine's node-pooled placement shares the node's whole
+    # slot budget, so the headline config runs depth 2 with zero drops
     reply_parity: jnp.ndarray  # i32 0|1            (volatile)
 
 
@@ -113,14 +118,22 @@ def make_raft_spec(
     def election_deadline(now, key, site):
         return now + prng.randint(key, site, election_lo_us, election_hi_us)
 
+    def phys_oh(s: RaftState, i, dtype):
+        """One-hot of absolute index i's physical slot, all-false when i is
+        outside the retained window [base, base + LOG) — the circular
+        analog of the old `ridx == i - base` mask. (Stale slots beyond
+        log_len hold reused bytes; callers guard with log_len as before.)"""
+        rel = jnp.asarray(i) - s.base
+        phys = jnp.remainder(rel + s.head, LOG)
+        in_win = (rel >= 0) & (rel < LOG)
+        return ((ridx == phys[..., None]) & in_win[..., None]).astype(dtype)
+
     def at_abs(s: RaftState, log_arr, i):
         """log_arr value at ABSOLUTE index i via one-hot contraction; 0 when
         i is outside the retained window (i may be [k] or scalar). einsum
         (not mul+sum) so XLA lowers a dot_general instead of materializing
         the broadcast product under the engine's lane x node vmap."""
-        rel = jnp.asarray(i) - s.base
-        oh = (ridx == rel[..., None]).astype(log_arr.dtype)  # [..., LOG]
-        return jnp.einsum("...r,r->...", oh, log_arr)
+        return jnp.einsum("...r,r->...", phys_oh(s, i, log_arr.dtype), log_arr)
 
     def term_at(s: RaftState, i):
         """Term of entry at absolute index i: window lookup, snapshot
@@ -133,8 +146,7 @@ def make_raft_spec(
         """Chain hash of prefix [0, i] at absolute i, from the cache;
         validity checked by caller (known iff base-1 <= i < log_len)."""
         i_arr = jnp.asarray(i)
-        oh = (ridx == (i_arr - s.base)[..., None]).astype(jnp.uint32)
-        win = jnp.einsum("...r,r->...", oh, s.log_chain)
+        win = jnp.einsum("...r,r->...", phys_oh(s, i, jnp.uint32), s.log_chain)
         return jnp.where(
             i_arr == s.base - 1, s.base_hash.astype(jnp.uint32), win
         )
@@ -151,6 +163,7 @@ def make_raft_spec(
             role=jnp.int32(FOLLOWER),
             votes=jnp.int32(0),
             base=jnp.int32(0),
+            head=jnp.int32(0),
             base_hash=jnp.int32(0x9E37),
             base_term=jnp.int32(0),
             log_term=jnp.zeros((LOG,), jnp.int32),
@@ -182,158 +195,56 @@ def make_raft_spec(
         the window is pressured, freeing slots for new appends (real Raft's
         log compaction). Committed entries are immutable, so folding them
         into base_hash loses nothing the invariant check needs beyond window
-        reach (the chain hash still witnesses the whole prefix)."""
+        reach (the chain hash still witnesses the whole prefix).
+
+        Circular window: compaction is POINTER ARITHMETIC — (base, head)
+        advance by D and the log arrays are untouched (the freed slots'
+        stale bytes are unreachable: every reader masks to [base,
+        log_len)). The r4 physical shift re-wrote all three [LOG] arrays
+        per compact — a measured top cost of the whole step."""
         D = D_COMPACT
         pressure = (s.log_len - s.base) > (LOG // 2)
         do = pressure & (s.commit + 1 - s.base >= D)
 
-        # boundary values at new_base - 1 = base + D - 1: static slot D - 1
-        nb_hash = s.log_chain[D - 1]
-        nb_term = s.log_term[D - 1]
-
-        def shift(arr):  # arr[r] = old arr[r + D], zero-padded tail
-            return jnp.concatenate([arr[D:], jnp.zeros((D,), arr.dtype)])
+        # boundary values at new_base - 1 = base + D - 1 (circular lookup)
+        nb_hash = hash_at(s, s.base + D - 1)
+        nb_term = term_at(s, s.base + D - 1)
 
         return s._replace(
             base=jnp.where(do, s.base + D, s.base),
+            head=jnp.where(do, jnp.remainder(s.head + D, LOG), s.head),
             base_hash=jnp.where(do, nb_hash.astype(jnp.int32), s.base_hash),
             base_term=jnp.where(do, nb_term, s.base_term),
-            log_term=jnp.where(do, shift(s.log_term), s.log_term),
-            log_cmd=jnp.where(do, shift(s.log_cmd), s.log_cmd),
-            log_chain=jnp.where(do, shift(s.log_chain), s.log_chain),
         )
 
-    # ----------------------------------------------------------------- timer
+    # ----------------------------------------------------------- fused event
 
-    def on_timer(s: RaftState, nid, now, key):
-        # Field-level masked merge of the leader (heartbeat/replicate) and
-        # non-leader (start election) paths: building two full RaftStates
-        # and tree_select-ing them costs three full state passes per leaf;
-        # this writes each field once. (The engine runs this body for every
-        # (lane, node) every step, so its cost is the step's biggest term.)
-        s = compact(s)
-        is_leader = s.role == LEADER
-
-        # -- leader: maybe append a client command, then heartbeat/replicate
-        can_append = (s.log_len - s.base) < LOG
-        do_append = is_leader & can_append & (prng.uniform(key, 26) < client_rate)
-        at_end = ridx == (s.log_len - s.base)
-        new_cmd = nid * 100_000 + s.next_cmd
-        wr = do_append & at_end
-        log_cmd = jnp.where(wr, new_cmd, s.log_cmd)
-        log_term = jnp.where(wr, s.term, s.log_term)
-        # chain cache: fold the new entry onto the hash of the prefix below
-        append_h = _chain_fold(hash_at(s, s.log_len - 1), s.term, new_cmd)
-        log_chain = jnp.where(wr, append_h, s.log_chain)
-        log_len = s.log_len + do_append.astype(jnp.int32)
-
-        prev_idx = s.next_idx - 1  # [N] absolute
-        # post-append window lookups for the AE payloads
-        s_app = s._replace(
-            log_term=log_term, log_cmd=log_cmd, log_len=log_len
-        )
-        prev_term = term_at(s_app, prev_idx)
-        has_entry = s.next_idx < log_len
-        e_term = jnp.where(has_entry, at_abs(s_app, log_term, s.next_idx), 0)
-        e_cmd = jnp.where(has_entry, at_abs(s_app, log_cmd, s.next_idx), 0)
-        # a follower lagging behind the window gets an InstallSnapshot
-        # instead of an entry it can no longer be served
-        needs_snap = s.next_idx < s.base
-
-        # -- non-leader: election timeout => become candidate
-        start_el = ~is_leader
-        new_term = jnp.where(start_el, s.term + 1, s.term)
-        last_idx = s.log_len - 1
-
-        state = s._replace(
-            term=new_term,
-            voted_for=jnp.where(start_el, nid, s.voted_for),
-            role=jnp.where(start_el, CANDIDATE, s.role),
-            votes=jnp.where(start_el, jnp.int32(1) << nid, s.votes),
-            log_term=log_term, log_cmd=log_cmd, log_chain=log_chain,
-            log_len=log_len,
-            next_cmd=s.next_cmd + do_append.astype(jnp.int32),
-        )
-
-        # -- outbox: one broadcast either way (AE/SNAP per peer, or RV)
-        ae_payload = jnp.stack(
-            [
-                jnp.full((N,), s.term, jnp.int32),
-                prev_idx,
-                prev_term,
-                e_term,
-                e_cmd,
-                jnp.full((N,), s.commit, jnp.int32),
-            ],
-            axis=1,
-        )
-        snap_payload = jnp.stack(
-            [
-                jnp.full((N,), s.term, jnp.int32),
-                jnp.full((N,), s.base - 1, jnp.int32),
-                jnp.full((N,), s.base_term, jnp.int32),
-                jnp.full((N,), s.base_hash, jnp.int32),
-                jnp.zeros((N,), jnp.int32),
-                jnp.full((N,), s.commit, jnp.int32),
-            ],
-            axis=1,
-        )
-        rv_payload = jnp.broadcast_to(
-            pack(new_term, last_idx, term_at(s, last_idx), 0, 0, 0),
-            (N, PAYLOAD_WIDTH),
-        )
-        # cooperative buggify: a leader occasionally goes silent for one
-        # tick — no heartbeats, no replication — exercising the "leader
-        # alive but mute" corner that network chaos reaches only via
-        # correlated per-link drops
-        if buggify_rate > 0:
-            from .spec import buggify as _buggify
-
-            mute = is_leader & _buggify(key, 28, buggify_rate)
-        else:
-            mute = jnp.bool_(False)
-        ldr = jnp.broadcast_to(jnp.reshape(is_leader, (1,)), (N,))
-        out = Outbox(
-            valid=(peers != nid) & ~mute,
-            dst=peers,
-            kind=jnp.where(
-                ldr,
-                jnp.where(needs_snap, SNAP, APPEND),
-                REQUEST_VOTE,
-            ).astype(jnp.int32),
-            payload=jnp.where(
-                ldr[:, None],
-                jnp.where(needs_snap[:, None], snap_payload, ae_payload),
-                rv_payload,
-            ),
-        )
-        timer = jnp.where(
-            is_leader, now + heartbeat_us, election_deadline(now, key, 22)
-        )
-        return state, out, timer
-
-    # --------------------------------------------------------------- message
-
-    def on_message(s: RaftState, nid, src, kind, payload, now, key):
-        """All five message kinds as ONE masked handler.
+    def on_event(s: RaftState, nid, src, kind, payload, now, key):
+        """ALL events — the five message kinds AND the timer fire
+        (kind == -1) — as ONE masked handler (ProtocolSpec.on_event).
 
         Under vmap, a lax.switch on a traced kind executes EVERY branch and
-        selects — five full RaftState materializations per step. The merged
-        form computes each state field exactly once under kind masks (the
-        masks are mutually exclusive), which measured ~2x cheaper. Each
-        kind's logic is the direct transcription of the r3 per-kind
-        handlers (h_request_vote/h_vote_resp/h_append/h_append_resp/h_snap);
-        see git history for the originals side by side.
+        selects — five full RaftState materializations per step; the same
+        argument applies one level up to running on_message and on_timer as
+        separate bodies (the engine's dual-state 3-way merge measured ~0.9 ms
+        of a 3.1 ms step — more than either handler alone). The fused form
+        computes each state field exactly once under mutually-exclusive
+        event masks and shares the expensive log-window lookups between the
+        timer and message paths. Each kind's logic is the direct
+        transcription of the r3 per-kind handlers; see git history for the
+        originals side by side.
         """
-        # Compaction here covers the follower side: a healthy leader resets
-        # the election timer with every AppendEntries, so the timer (the
-        # only other compaction site) would starve follower compaction
-        # forever — the window fills, writes stall at capacity, and the
-        # leader's majority commit wedges (the round-2 "silently saturated
-        # lane" bug). Running it for every kind is sound: it only folds
-        # already-committed entries under window pressure.
+        # Compaction covers every event — in particular the follower side:
+        # a healthy leader resets the election timer with every
+        # AppendEntries, so a timer-only compaction site would starve
+        # follower compaction forever — the window fills, writes stall at
+        # capacity, and the leader's majority commit wedges (the round-2
+        # "silently saturated lane" bug). Running it for every event is
+        # sound: it only folds already-committed entries under pressure.
         s = compact(s)
         f = payload
+        is_timer = kind == -1
+        is_msg = ~is_timer
         is_rv = kind == REQUEST_VOTE
         is_vr = kind == VOTE_RESP
         is_ae = kind == APPEND
@@ -341,19 +252,63 @@ def make_raft_spec(
         is_sn = kind == SNAP
         msg_term = f[0]  # every kind carries the sender's term first
 
+        # shared log-window lookups (used by both the timer and msg paths)
+        my_last_idx = s.log_len - 1
+        my_last_term = term_at(s, my_last_idx)
+        my_last_hash = hash_at(s, my_last_idx)
+
+        # ====================== timer path (kind == -1) ===================
+        is_leader = is_timer & (s.role == LEADER)
+
+        # -- leader: maybe append a client command, then heartbeat/replicate
+        can_append = (s.log_len - s.base) < LOG
+        do_append = is_leader & can_append & (prng.uniform(key, 26) < client_rate)
+        # physical slot of the append (phys_oh is all-false when the window
+        # is full, which can_append already excludes)
+        at_end = phys_oh(s, s.log_len, jnp.bool_)
+        new_cmd = nid * 100_000 + s.next_cmd
+        t_wr = do_append & at_end
+        # chain cache: fold the new entry onto the hash of the prefix below
+        append_h = _chain_fold(my_last_hash, s.term, new_cmd)
+        log_len_t = s.log_len + do_append.astype(jnp.int32)
+
+        prev_idx = s.next_idx - 1  # [N] absolute
+        # AE payload lookups read the PRE-append window (prev_idx <=
+        # log_len - 1 always) and special-case the just-appended entry —
+        # materializing a post-append copy of the log arrays (the r4
+        # `s_app`) cost two full [LOG]-array passes per step
+        prev_term = term_at(s, prev_idx)
+        ae_has_entry = s.next_idx < log_len_t
+        at_appended = do_append & (s.next_idx == s.log_len)
+        e_term_out = jnp.where(
+            at_appended, s.term,
+            jnp.where(ae_has_entry, at_abs(s, s.log_term, s.next_idx), 0),
+        )
+        e_cmd_out = jnp.where(
+            at_appended, new_cmd,
+            jnp.where(ae_has_entry, at_abs(s, s.log_cmd, s.next_idx), 0),
+        )
+        # a follower lagging behind the window gets an InstallSnapshot
+        # instead of an entry it can no longer be served
+        needs_snap = s.next_idx < s.base
+
+        # -- non-leader: election timeout => become candidate
+        start_el = is_timer & ~is_leader
+
+        # ====================== message path (kind >= 0) ==================
         # -- shared term adoption: newer term => step down, clear vote
-        newer = msg_term > s.term
-        term = jnp.where(newer, msg_term, s.term)
-        voted_for = jnp.where(newer, -1, s.voted_for)
-        role = jnp.where(newer, FOLLOWER, s.role)
+        newer = is_msg & (msg_term > s.term)
+        term = jnp.where(newer, msg_term, jnp.where(start_el, s.term + 1, s.term))
+        voted_for = jnp.where(newer, -1, jnp.where(start_el, nid, s.voted_for))
+        role = jnp.where(
+            newer, FOLLOWER, jnp.where(start_el, CANDIDATE, s.role)
+        )
         # current-term AE/SNAP is valid leader contact: candidate steps down
         stale_ldr = msg_term < s.term  # sender behind (AE/SNAP staleness)
         ldr_contact = (is_ae | is_sn) & ~stale_ldr
         role = jnp.where(ldr_contact, FOLLOWER, role)
 
         # -- REQUEST_VOTE: grant iff candidate's log is up to date (§5.4.1)
-        my_last_idx = s.log_len - 1
-        my_last_term = term_at(s, my_last_idx)
         log_ok = (f[2] > my_last_term) | (
             (f[2] == my_last_term) & (f[1] >= my_last_idx)
         )
@@ -365,7 +320,10 @@ def make_raft_spec(
 
         # -- VOTE_RESP: tally; majority => leader, reset replication state
         tally = is_vr & (role == CANDIDATE) & (msg_term == term) & (f[1] > 0)
-        votes = jnp.where(tally, s.votes | (jnp.int32(1) << src), s.votes)
+        votes = jnp.where(
+            tally, s.votes | (jnp.int32(1) << src),
+            jnp.where(start_el, jnp.int32(1) << nid, s.votes),
+        )
         won = is_vr & (role == CANDIDATE) & (
             jax.lax.population_count(votes.astype(jnp.uint32)).astype(jnp.int32)
             > N // 2
@@ -373,21 +331,21 @@ def make_raft_spec(
         role = jnp.where(won, LEADER, role)
 
         # -- APPEND: consistency check, window write, commit advance
-        prev_idx, prev_term_in, e_term, e_cmd, l_commit = (
+        m_prev_idx, prev_term_in, e_term, e_cmd, l_commit = (
             f[1], f[2], f[3], f[4], f[5],
         )
-        prev_ok = (prev_idx < 0) | (
-            (prev_idx < s.log_len)
-            & (prev_idx >= s.base - 1)
-            & (term_at(s, prev_idx) == prev_term_in)
+        prev_ok = (m_prev_idx < 0) | (
+            (m_prev_idx < s.log_len)
+            & (m_prev_idx >= s.base - 1)
+            & (term_at(s, m_prev_idx) == prev_term_in)
         )
         ae_ok = is_ae & ~stale_ldr & prev_ok
         has_entry = e_term > 0
-        write_at = prev_idx + 1  # absolute
+        write_at = m_prev_idx + 1  # absolute
         rel_w = write_at - s.base
         in_window = (rel_w >= 0) & (rel_w < LOG)
         do_write = ae_ok & has_entry & in_window
-        at_w = ridx == rel_w
+        at_w = phys_oh(s, write_at, jnp.bool_)
         # conflict: entry at write_at with different term => truncate+replace
         existing_term = at_abs(s, s.log_term, write_at)
         same = (write_at < s.log_len) & (existing_term == e_term)
@@ -395,7 +353,7 @@ def make_raft_spec(
         # term => same entry in Raft, so the `same` overwrite is a no-op)
         write_h = _chain_fold(hash_at(s, write_at - 1), e_term, e_cmd)
         match_ae = jnp.where(
-            ae_ok, jnp.where(has_entry & in_window, write_at, prev_idx), -1
+            ae_ok, jnp.where(has_entry & in_window, write_at, m_prev_idx), -1
         )
 
         # -- SNAP: adopt the leader's compacted prefix wholesale (Raft §7
@@ -432,20 +390,26 @@ def make_raft_spec(
             term_at(s, majority_idx) == term
         )
 
-        # -- merged field writes (kind masks are mutually exclusive)
+        # ================== merged field writes (disjoint masks) ==========
+        # t_wr (leader client append, timer path) and do_write & at_w (AE
+        # write) are disjoint: is_timer vs kind. A SNAP adopt clears the
+        # window by POINTERS alone (base = log_len = snap_idx + 1 below):
+        # the abandoned slots' stale bytes are unreachable, so the arrays
+        # need no zeroing pass (circular-window invariant).
         log_term_new = jnp.where(
-            do_write & at_w, e_term, jnp.where(adopt, 0, s.log_term)
+            t_wr, s.term, jnp.where(do_write & at_w, e_term, s.log_term)
         )
         log_cmd_new = jnp.where(
-            do_write & at_w, e_cmd, jnp.where(adopt, 0, s.log_cmd)
+            t_wr, new_cmd, jnp.where(do_write & at_w, e_cmd, s.log_cmd)
         )
         log_chain_new = jnp.where(
-            do_write & at_w, write_h,
-            jnp.where(adopt, jnp.uint32(0), s.log_chain),
+            t_wr, append_h,
+            jnp.where(do_write & at_w, write_h, s.log_chain),
         )
+        # log_len_t already folds the timer append (== s.log_len on msgs)
         log_len_new = jnp.where(
             do_write, jnp.where(same, s.log_len, write_at + 1),
-            jnp.where(adopt, snap_idx + 1, s.log_len),
+            jnp.where(adopt, snap_idx + 1, log_len_t),
         )
         commit = jnp.where(
             ae_ok, jnp.maximum(s.commit, jnp.minimum(l_commit, match_ae)),
@@ -455,8 +419,8 @@ def make_raft_spec(
             ),
         )
         # -- reply: RV => VOTE_RESP; AE/SNAP => APPEND_RESP; else nothing.
-        # The reply alternates between the two outbox rows (reply_parity)
-        # so ack bursts to one leader spread over two pool rings — see the
+        # The reply alternates between outbox rows 0/1 (reply_parity) so
+        # ack bursts to one leader spread over two pool rings — see the
         # RaftState.reply_parity comment.
         replies = is_rv | is_ae | is_sn
         state = s._replace(
@@ -467,7 +431,63 @@ def make_raft_spec(
             log_term=log_term_new, log_cmd=log_cmd_new,
             log_chain=log_chain_new, log_len=log_len_new,
             commit=commit, next_idx=next_idx, match_idx=match_idx,
+            next_cmd=s.next_cmd + do_append.astype(jnp.int32),
+            # alternate the reply row: an ack burst of 4 inside one latency
+            # window spreads over two rows (and the node-pooled slot
+            # budget absorbs the rest)
             reply_parity=jnp.where(replies, 1 - s.reply_parity, s.reply_parity),
+        )
+
+        # ================== merged outbox (E = N rows) ====================
+        # timer event: a broadcast (AE/SNAP per peer, or RV); msg event: one
+        # reply on row reply_parity. The two never coexist (one event per
+        # node per step), so the rows are shared — that is what shrinks the
+        # engine's candidate set from N*(max_out+max_out_msg) to N*max_out.
+        ae_payload = jnp.stack(
+            [
+                jnp.full((N,), s.term, jnp.int32),
+                prev_idx,
+                prev_term,
+                e_term_out,
+                e_cmd_out,
+                jnp.full((N,), s.commit, jnp.int32),
+            ],
+            axis=1,
+        )
+        snap_payload = jnp.stack(
+            [
+                jnp.full((N,), s.term, jnp.int32),
+                jnp.full((N,), s.base - 1, jnp.int32),
+                jnp.full((N,), s.base_term, jnp.int32),
+                jnp.full((N,), s.base_hash, jnp.int32),
+                jnp.zeros((N,), jnp.int32),
+                jnp.full((N,), s.commit, jnp.int32),
+            ],
+            axis=1,
+        )
+        # `term` already folds the election bump (start_el => s.term + 1)
+        rv_payload = jnp.broadcast_to(
+            pack(term, my_last_idx, my_last_term, 0, 0, 0),
+            (N, PAYLOAD_WIDTH),
+        )
+        # cooperative buggify: a leader occasionally goes silent for one
+        # tick — no heartbeats, no replication — exercising the "leader
+        # alive but mute" corner that network chaos reaches only via
+        # correlated per-link drops
+        if buggify_rate > 0:
+            from .spec import buggify as _buggify
+
+            mute = is_leader & _buggify(key, 28, buggify_rate)
+        else:
+            mute = jnp.bool_(False)
+        ldr = jnp.broadcast_to(jnp.reshape(is_leader, (1,)), (N,))
+        bcast_kind = jnp.where(
+            ldr, jnp.where(needs_snap, SNAP, APPEND), REQUEST_VOTE
+        ).astype(jnp.int32)
+        bcast_pay = jnp.where(
+            ldr[:, None],
+            jnp.where(needs_snap[:, None], snap_payload, ae_payload),
+            rv_payload,
         )
         r_kind = jnp.where(is_rv, VOTE_RESP, APPEND_RESP)
         r_f1 = jnp.where(
@@ -475,27 +495,62 @@ def make_raft_spec(
             jnp.where(is_ae, ae_ok, ~stale_ldr).astype(jnp.int32),
         )
         r_f2 = jnp.where(is_ae, match_ae, match_sn)
-        at_row = jnp.arange(2) == s.reply_parity  # [2]
+        # SHARED rows: a timer event broadcasts on rows 0..N-1; a message
+        # event replies on row reply_parity. The two never coexist (one
+        # event per node per step), so E = N — and the engine's
+        # node-pooled placement (sends share the node's whole slot
+        # budget) absorbs election-storm bursts that a per-row ring
+        # would drop. (A dedicated-reply-rows variant, E = N + 2, was
+        # measured ~10% slower: candidate-space costs scale with C.)
+        at_row = peers == s.reply_parity  # [N] reply row 0 or 1
         out = Outbox(
-            valid=at_row & replies,
-            dst=jnp.full((2,), src, jnp.int32),
-            kind=jnp.full((2,), r_kind, jnp.int32),
+            valid=jnp.where(
+                is_timer, (peers != nid) & ~mute, at_row & replies
+            ),
+            dst=jnp.where(is_timer, peers, jnp.broadcast_to(src, (N,))),
+            kind=jnp.where(is_timer, bcast_kind, r_kind).astype(jnp.int32),
             payload=jnp.where(
-                at_row[:, None],
-                jnp.reshape(pack(term, r_f1, r_f2, 0, 0, 0),
-                            (1, PAYLOAD_WIDTH)),
-                0,
+                is_timer,
+                bcast_pay,
+                jnp.where(
+                    at_row[:, None],
+                    jnp.reshape(pack(term, r_f1, r_f2, 0, 0, 0),
+                                (1, PAYLOAD_WIDTH)),
+                    0,
+                ),
             ),
         )
 
-        # -- timer: vote grant / valid leader contact reset the election
-        # deadline; a fresh winner fires its heartbeat immediately
+        # -- next timer: timer events always re-arm (heartbeat or election
+        # deadline); on messages a vote grant / valid leader contact resets
+        # the election deadline, a fresh winner fires its heartbeat
+        # immediately, anything else keeps the current deadline (-1)
         reset = grant | ((is_ae | is_sn) & ~stale_ldr)
         timer = jnp.where(
-            won, now,
-            jnp.where(reset, election_deadline(now, key, 24), jnp.int32(-1)),
+            is_timer,
+            jnp.where(
+                is_leader, now + heartbeat_us, election_deadline(now, key, 22)
+            ),
+            jnp.where(
+                won, now,
+                jnp.where(reset, election_deadline(now, key, 24),
+                          jnp.int32(-1)),
+            ),
         )
         return state, out, timer
+
+    # --------------------------------------- derived two-handler wrappers
+    # (for direct calls in tests and the engine's non-fused fallback: a
+    # spec whose on_message is REPLACED must also pass on_event=None)
+
+    def on_message(s: RaftState, nid, src, kind, payload, now, key):
+        return on_event(s, nid, src, kind, payload, now, key)
+
+    def on_timer(s: RaftState, nid, now, key):
+        return on_event(
+            s, nid, jnp.int32(0), jnp.int32(-1),
+            jnp.zeros((PAYLOAD_WIDTH,), jnp.int32), now, key,
+        )
 
     # --------------------------------------------------------------- restart
 
@@ -525,9 +580,13 @@ def make_raft_spec(
         # at m = min(commit_a, commit_b) whenever both nodes retain index m
         h_all = ns.log_chain  # u32 [N, LOG] — the maintained cache
         m = jnp.minimum(ns.commit[:, None], ns.commit[None, :])  # [N,N]
-        # hash of node a's prefix at m (one-hot over window + boundary case)
+        # hash of node a's prefix at m (one-hot over the circular window +
+        # boundary case; the in-window mask keeps wrapped stale slots out)
         rel = m[:, :, None] - ns.base[:, None, None]  # a's window offset
-        win_oh = (ridx[None, None, :] == rel).astype(jnp.uint32)  # [N,N,LOG]
+        phys = jnp.remainder(rel + ns.head[:, None, None], LOG)
+        win_oh = (
+            (ridx[None, None, :] == phys) & (rel >= 0) & (rel < LOG)
+        ).astype(jnp.uint32)  # [N,N,LOG]
         h_win = jnp.einsum("abr,ar->ab", win_oh, h_all)
         at_boundary = m == (ns.base[:, None] - 1)
         h_a = jnp.where(
@@ -596,10 +655,14 @@ def make_raft_spec(
         n_nodes=N,
         payload_width=PAYLOAD_WIDTH,
         max_out=N,
-        max_out_msg=2,
+        # the derived on_message emits the fused handler's N rows, so the
+        # non-fused fallback path (on_event=None specs built from these
+        # wrappers) must size its reply class to N too
+        max_out_msg=N,
         init=init,
         on_message=on_message,
         on_timer=on_timer,
+        on_event=on_event,
         on_restart=on_restart,
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
@@ -632,7 +695,15 @@ def verify_chain_cache(node) -> bool:
     log_cmd = np.asarray(node.log_cmd)
     log_chain = np.asarray(node.log_chain).astype(np.uint32)
     n_valid = np.asarray(node.log_len) - np.asarray(node.base)  # [L,N]
+    head = np.asarray(node.head)  # [L,N] physical slot of index `base`
     LOG = log_term.shape[-1]
+
+    # un-rotate the circular window: relative entry r lives at physical
+    # slot (head + r) % LOG
+    idx = (head[:, :, None] + np.arange(LOG)[None, None, :]) % LOG
+    log_term = np.take_along_axis(log_term, idx, axis=-1)
+    log_cmd = np.take_along_axis(log_cmd, idx, axis=-1)
+    log_chain = np.take_along_axis(log_chain, idx, axis=-1)
 
     h = base_hash
     ok = True

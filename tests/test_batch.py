@@ -40,7 +40,7 @@ def buggy_raft_spec(n_nodes=5):
         role = jnp.where(win, raft_mod.LEADER, state.role)
         return state._replace(role=role), out, jnp.where(win, now, timer)
 
-    return dataclasses.replace(spec, on_message=buggy_on_message)
+    return dataclasses.replace(spec, on_message=buggy_on_message, on_event=None)
 
 
 def test_clean_raft_sweep_no_violations():

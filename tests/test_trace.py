@@ -55,7 +55,7 @@ def split_brain_spec():
         )
         return state._replace(commit=bogus_commit), out, timer
 
-    return dataclasses.replace(spec, on_message=buggy_append_resp)
+    return dataclasses.replace(spec, on_message=buggy_append_resp, on_event=None)
 
 
 @pytest.mark.deep

@@ -87,7 +87,11 @@ def bench_tpu(lanes: int, virtual_secs: float, client_rate: float) -> dict:
     from madsim_tpu.tpu import BatchedSim, make_raft_spec, summarize
     from madsim_tpu.tpu.batch import resolve_mesh
 
-    spec = make_raft_spec(n_nodes=5, client_rate=client_rate)
+    # log_capacity 16: the circular window + compaction + InstallSnapshot
+    # keep unbounded writes flowing through 16 slots (saturation metric
+    # guards the claim — stays 0 at this config); window bytes are a top
+    # handler cost, and 16 measured ~5% faster than 24 with no lost work
+    spec = make_raft_spec(n_nodes=5, client_rate=client_rate, log_capacity=16)
     sim = BatchedSim(spec, raft_bench_config(virtual_secs))
     mesh = resolve_mesh("auto")  # production path: every visible device
     n_devices = int(mesh.devices.size) if mesh is not None else 1
@@ -121,7 +125,7 @@ def bench_step_breakdown(lanes: int, virtual_secs: float,
     from madsim_tpu.tpu import BatchedSim, make_raft_spec
     from madsim_tpu.tpu.spec import Outbox
 
-    spec = make_raft_spec(n_nodes=5, client_rate=client_rate)
+    spec = make_raft_spec(n_nodes=5, client_rate=client_rate, log_capacity=16)
     cfg = raft_bench_config(virtual_secs)
 
     def id_on_message(s, nid, src, kind, payload, now, key):
@@ -206,12 +210,14 @@ def bench_buggify_ab(lanes: int, virtual_secs: float) -> dict:
     out = {}
     for tag, rate in (("off", 0.0), ("on", 0.05)):
         wl = kv_workload(virtual_secs=virtual_secs)
-        # straggler depth 8: a 1-5 s tail at 5% of a 25 ms-tick heartbeat
-        # stream keeps ~6 tails of one send site in flight at once; the
-        # side pool must hold them, not drop them (drops would be
-        # unmodeled loss muddying the A/B)
+        # straggler depth 16: a 1-5 s tail at 5% of a 25 ms-tick heartbeat
+        # stream keeps ~6 tails of one send site in flight at once, and the
+        # r5 fused kv spec nearly HALVED the candidate count (C 55 -> 30),
+        # halving the side pool at a given depth — depth 8 measured 11k
+        # drops post-fusion; the side pool must hold tails, not drop them
+        # (drops would be unmodeled loss muddying the A/B)
         cfg = dataclasses.replace(
-            wl.config, buggify_delay_rate=rate, buggify_depth=8
+            wl.config, buggify_delay_rate=rate, buggify_depth=16
         )
         sim = BatchedSim(wl.spec, cfg)
         state = sim.run(jnp.arange(lanes), max_steps=int(virtual_secs * 1200) + 2000)
@@ -265,6 +271,71 @@ def bench_twopc(lanes: int, virtual_secs: float) -> dict:
     }
 
 
+def bench_roofline(lanes: int, virtual_secs: float, client_rate: float) -> dict:
+    """Roofline accounting for the headline step (VERDICT r4 item 1):
+    bytes touched per step, measured attainable HBM bandwidth, and the
+    achieved fraction — so 'the step is bandwidth-bound' is a number,
+    not an assertion. Uses benches/roofline.py's measured-methodology
+    probes (marginal bandwidth, fusion-aware HLO traffic model)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "benches"))
+    try:
+        import jax.numpy as jnp
+
+        import roofline as rl
+
+        from madsim_tpu.tpu import BatchedSim, make_raft_spec
+
+        spec = make_raft_spec(n_nodes=5, client_rate=client_rate,
+                              log_capacity=16)
+        sim = BatchedSim(spec, raft_bench_config(virtual_secs))
+        state = sim.run_steps(sim.init(jnp.arange(lanes)), 200)
+        bw = rl.measure_copy_bw_gbs()
+        hlo = rl.hlo_hbm_bytes(sim, state)
+        sbytes = rl.state_bytes(state)
+        ms = rl.time_step_ms(sim, state, 300, lanes=lanes)
+        # True HBM traffic is bracketed, not known exactly: the HLO-level
+        # model (every top-level op's operands+results) is an UPPER bound
+        # — adjacent ops reuse buffers that never leave on-chip memory —
+        # while XLA's own buffer assignment (arguments read + outputs
+        # written + temps written then read) is a LOWER bound.
+        lo_bytes = (
+            (hlo["arg_bytes"] or 0) + (hlo["out_bytes"] or 0)
+            + 2 * (hlo["temp_bytes"] or 0)
+        )
+        hi_bytes = hlo["hbm_model_bytes"]
+        return {
+            "roofline_attainable_gbs": round(bw, 1),
+            "roofline_step_ms": round(ms, 3),
+            "roofline_state_bytes": sbytes,
+            "roofline_bytes_per_step_lo": lo_bytes,
+            "roofline_bytes_per_step_hi": hi_bytes,
+            "roofline_achieved_gbs_lo": round(
+                lo_bytes / (ms / 1e3) / 1e9, 1
+            ),
+            "roofline_achieved_gbs_hi": round(
+                min(hi_bytes / (ms / 1e3) / 1e9, bw), 1
+            ),
+            "roofline_pct_of_attainable_lo": round(
+                lo_bytes / (ms / 1e3) / 1e9 / bw * 100, 1
+            ),
+            # the carry floor: the state pytree must be read+written every
+            # step no matter what — the step's hard lower bound on time
+            "roofline_carry_floor_ms": round(
+                2 * sbytes / (bw * 1e9) * 1e3, 3
+            ),
+            "roofline_step_over_floor": round(
+                ms / (2 * sbytes / (bw * 1e9) * 1e3), 2
+            ),
+        }
+    except Exception as e:  # noqa: BLE001 - diagnostics must not kill BENCH
+        return {"roofline_error": str(e)[:200]}
+    finally:
+        sys.path.pop(0)
+
+
 def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
     """The HONEST CPU denominator: a compiled thread-per-seed DES fuzzer
     (native/raft_bench.cpp) running the same protocol + chaos + invariant
@@ -289,13 +360,20 @@ def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) ->
         )
         if r.returncode != 0:
             return None
+    # Denominator-pinning protocol (BASELINE.md "Measurement protocol"):
+    # median of 5 isolated runs. The r4 artifact's single biggest weakness
+    # was this number swinging 419-837 seeds/s with host contention —
+    # pin to one core (taskset, when available), run nothing else
+    # concurrently, and REPORT the spread so the headline ratio carries
+    # its own error bar.
+    cmd = [str(out), str(n_seeds), str(virtual_secs), str(client_rate), "0.1"]
+    taskset = shutil.which("taskset")
+    if taskset:
+        cmd = [taskset, "-c", "0"] + cmd
     rows = []
-    for _ in range(3):  # median of 3, same rep scheme as every other side
+    for _ in range(5):
         try:
-            r = subprocess.run(
-                [str(out), str(n_seeds), str(virtual_secs), str(client_rate), "0.1"],
-                capture_output=True, text=True, timeout=600,
-            )
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
             if r.returncode != 0:
                 break
             rows.append(json.loads(r.stdout.strip().splitlines()[-1]))
@@ -305,7 +383,16 @@ def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) ->
             break
     if not rows:
         return None
-    return sorted(rows, key=lambda x: x["seeds_per_sec"])[(len(rows) - 1) // 2]
+    sps = sorted(x["seeds_per_sec"] for x in rows)
+    med = sorted(rows, key=lambda x: x["seeds_per_sec"])[(len(rows) - 1) // 2]
+    med = dict(med)
+    med["reps"] = len(rows)
+    med["seeds_per_sec_min"] = round(sps[0], 2)
+    med["seeds_per_sec_max"] = round(sps[-1], 2)
+    med["spread_pct"] = round(
+        (sps[-1] - sps[0]) / max(sps[len(sps) // 2], 1e-9) * 100, 1
+    )
+    return med
 
 
 def bench_cpu_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
@@ -358,6 +445,10 @@ def main() -> None:
         {} if args.skip_breakdown
         else bench_step_breakdown(args.lanes, args.virtual_secs, args.client_rate)
     )
+    roofline = (
+        {} if args.skip_breakdown
+        else bench_roofline(args.lanes, args.virtual_secs, args.client_rate)
+    )
 
     # vs_baseline is computed against the STRONGEST CPU execution available:
     # the compiled C++ thread-per-seed DES (the reference's execution model)
@@ -391,6 +482,13 @@ def main() -> None:
         "cpp_baseline_events_per_sec": (
             round(cpp["events_per_sec"], 1) if cpp else None
         ),
+        # the denominator's own error bar (median of 5 pinned runs): the
+        # headline ratio is only as stable as this spread
+        "cpp_baseline_spread_pct": cpp.get("spread_pct") if cpp else None,
+        "cpp_baseline_min_max": (
+            [cpp.get("seeds_per_sec_min"), cpp.get("seeds_per_sec_max")]
+            if cpp else None
+        ),
         "vs_python_host": round(tpu["seeds_per_sec"] / cpu["seeds_per_sec"], 2),
         "violations": tpu["summary"]["violations"],
         "overflow": tpu["summary"]["total_overflow"],
@@ -413,22 +511,24 @@ def main() -> None:
         # heavy-tail buggify A/B (events explored with/without the tail)
         "buggify_ab": buggify,
         **breakdown,
+        **roofline,
         "backend": tpu["backend"],
         "notes": (
-            "r2->r3 seeds/s regression (9616->7787) was honest work: r3's "
-            "compaction kept 3785 previously frozen lanes live and chunked "
-            "dispatch added host syncs. r4 rewrites the pool (per-candidate "
-            "ring + per-dst validity bits, first-free placement), merges "
-            "raft's and kv's switch handlers, fuses the state selects, and "
-            "moves sweeps to the all-device mesh path (xN chips on a pod; "
-            "one chip here). Headline keeps the zero-drop discipline "
-            "(overflow==0 at first-free ring depths 4/2); configs that "
-            "tolerate ~0.003% drops measure ~15-20% faster. Virtual time "
-            "is now unbounded (epoch+offset rebasing; int64 time tensors "
-            "measure 2-3x slower than int32 on v5e reductions and double "
-            "the bytes, so offsets stay int32). The C++ denominator swings with host contention "
-            "(419-837 seeds/s across r4 runs); compare vs_baseline across "
-            "rounds with that in mind."
+            "r5 redesigns, each measured on-chip: (1) fused on_event "
+            "handlers — one handler invocation per node per step instead "
+            "of on_message AND on_timer plus a 3-way state merge (the "
+            "dual-materialization tax measured ~0.9 ms of a 3.1 ms step); "
+            "candidate sends collapse to N*max_out (raft C 35->25, kv "
+            "55->30, paxos/twopc halved). (2) Circular log window: raft "
+            "compaction is pointer arithmetic, no 3-array shift passes. "
+            "(3) Node-pooled slot placement: the i-th valid send takes "
+            "the i-th free slot of its node's whole budget — zero drops "
+            "at the same 10 slots/node where per-row rings dropped ~1e-6 "
+            "in election storms. Headline keeps the zero-drop discipline "
+            "(overflow==0). The C++ denominator is now median-of-5 "
+            "pinned runs with its spread reported "
+            "(cpp_baseline_spread_pct); the roofline_* keys quantify "
+            "bytes/step against measured attainable bandwidth."
         ),
     }
     print(json.dumps(result))

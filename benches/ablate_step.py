@@ -53,14 +53,9 @@ def main() -> None:
     def make(cfg_over=None, spec_over=None):
         spec = make_raft_spec(n_nodes=5, client_rate=0.1)
         if spec_over:
-            # replacing a handler on a fused spec silently keeps the fused
-            # body unless on_event is dropped too
-            if (
-                ("on_message" in spec_over or "on_timer" in spec_over)
-                and "on_event" not in spec_over
-            ):
-                spec_over = {**spec_over, "on_event": None}
-            spec = dataclasses.replace(spec, **spec_over)
+            from madsim_tpu.tpu.spec import replace_handlers
+
+            spec = replace_handlers(spec, **spec_over)
         kw = dict(
             horizon_us=10_000_000,
             msg_capacity=128,

@@ -21,31 +21,47 @@ import json
 import time
 
 
-def measure_copy_bw_gbs(n_mb: int = 256, loops: int = 64, reps: int = 5) -> float:
-    """Attainable HBM bandwidth: a jitted on-device loop of elementwise
-    x+1 over n_mb of int32 (each iteration reads + writes every element
-    => 2x bytes per loop). The loop amortizes tunnel dispatch latency —
-    a single-kernel timing over the remote relay measures dispatch, not
-    bandwidth. Best of reps: the chip is shared, and for a PEAK
-    measurement the best rep is the right statistic (contention only
-    subtracts)."""
+def measure_copy_bw_gbs(n_mb: int = 256, reps: int = 3) -> float:
+    """Attainable HBM bandwidth by the MARGINAL method: time an on-device
+    streaming loop at two loop counts and divide the extra bytes by the
+    extra time. Every pitfall here was hit and fixed in round 5:
+      * a single-kernel timing over the remote tunnel measures dispatch
+        (~100 ms fixed overhead), not bandwidth — hence the loop;
+      * `a + 1` loop bodies get algebraically collapsed by XLA into one
+        pass — hence the xorshift body;
+      * the tunnel relay CACHES identical dispatches — hence a fresh
+        seed input per rep;
+      * block_until_ready has returned before execution on this stack —
+        hence the tiny reduced output that forces a real readback.
+    The marginal rate cancels the fixed per-dispatch cost exactly."""
     import jax
     import jax.numpy as jnp
 
     n = n_mb * (1 << 20) // 4
-    x = jnp.arange(n, dtype=jnp.int32)
+    L1, L2 = 8, 72
 
-    @jax.jit
-    def f(v):
-        return jax.lax.fori_loop(0, loops, lambda i, a: a + 1, v)
+    def make(loops):
+        @jax.jit
+        def f(seed):
+            x = jnp.arange(n, dtype=jnp.uint32) + seed
+            y = jax.lax.fori_loop(0, loops, lambda i, a: a ^ (a << 13), x)
+            return y[::131072].sum()
+        return f
 
-    jax.block_until_ready(f(x))
-    best = float("inf")
-    for _ in range(reps):
+    f1, f2 = make(L1), make(L2)
+    int(f1(jnp.uint32(1)))
+    int(f2(jnp.uint32(1)))
+    best = 0.0
+    for r in range(2, reps + 2):
         t0 = time.perf_counter()
-        jax.block_until_ready(f(x))
-        best = min(best, time.perf_counter() - t0)
-    return (2 * n * 4 * loops) / best / 1e9
+        int(f1(jnp.uint32(r)))
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        int(f2(jnp.uint32(r)))
+        t2 = time.perf_counter() - t0
+        if t2 > t1:
+            best = max(best, 2 * n * 4 * (L2 - L1) / (t2 - t1) / 1e9)
+    return best
 
 
 def hlo_hbm_bytes(sim, state) -> dict:

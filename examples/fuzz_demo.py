@@ -42,9 +42,12 @@ def buggy_raft_spec():
         )
         return state._replace(commit=bogus), out, timer
 
-    # on_event=None: replacing on_message on a fused spec must also drop
-    # the fused handler, or the engine keeps using the original body
-    return dataclasses.replace(spec, on_message=buggy_on_message, on_event=None)
+    # replace_handlers (not bare dataclasses.replace): replacing on_message
+    # on a fused spec must also drop the fused handler, or the engine keeps
+    # running the original body — the helper does that in one place
+    from madsim_tpu.tpu.spec import replace_handlers
+
+    return replace_handlers(spec, on_message=buggy_on_message)
 
 
 def main(n_seeds: int = 2048) -> None:

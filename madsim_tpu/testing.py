@@ -298,12 +298,91 @@ class Builder:
         return results.get(seeds[-1])
 
 
+# the hash seed every isolated run pins (any fixed value works; 0 also
+# disables randomization for subinterpreters)
+HASH_PIN = "0"
+
+
+def _hash_randomized() -> bool:
+    v = os.environ.get("PYTHONHASHSEED", "")
+    return v in ("", "random")
+
+
+def _run_pinned_subprocess(fn: Callable) -> None:
+    """Re-exec ONE test in a fresh interpreter with PYTHONHASHSEED pinned.
+
+    CPython fixes the str-hash seed at interpreter startup and cannot
+    re-seed it at runtime, so cross-PROCESS reproducibility of sims whose
+    user code iterates str-keyed dicts/sets is only achievable by
+    controlling the child's env — the closest Python analog of the
+    reference seeding HashMap's RandomState from the sim seed
+    (rand.rs:176-244). The child loads the test FILE directly (no package
+    import needed) and calls the decorated wrapper; with the hash seed
+    pinned in its env, the wrapper runs in-process there — no recursion.
+    """
+    import subprocess
+    import sys
+
+    path = fn.__code__.co_filename
+    code = (
+        "import importlib.util, sys\n"
+        f"spec = importlib.util.spec_from_file_location('madsim_isolated', {path!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "sys.modules['madsim_isolated'] = m\n"
+        "spec.loader.exec_module(m)\n"
+        f"getattr(m, {fn.__name__!r})()\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = HASH_PIN
+    # hand the parent's import environment to the child: a bare `python -c`
+    # inherits neither pytest's conftest sys.path surgery nor an editable
+    # checkout's root, so `import madsim_tpu` would fail from other cwds
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in sys.path if p] + [env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    timeout = float(os.environ.get("MADSIM_TEST_ISOLATE_TIMEOUT", "600"))
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=timeout,
+    )
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.strip().splitlines()[-15:])
+        raise AssertionError(
+            f"isolated (hash-pinned) run of {fn.__name__} failed "
+            f"(rc={proc.returncode}):\n{tail}"
+        )
+
+
 def madsim_test(fn: Optional[Callable] = None, **builder_kwargs: Any):
-    """Decorator: run an async test through the env-configured seed sweep."""
+    """Decorator: run an async test through the env-configured seed sweep.
+
+    When the calling interpreter has RANDOMIZED str hashing (PYTHONHASHSEED
+    unset), the test re-executes in a fresh interpreter with the hash seed
+    pinned to a fixed value, so `MADSIM_TEST_SEED=N` reproduces the same
+    execution in ANY process with no environment setup by the user — the
+    reference's no-setup repro promise (rand.rs:176-244). Opt out with
+    MADSIM_TEST_NO_ISOLATE=1 (e.g. to debug in-process under pdb; within
+    one process runs are reproducible regardless)."""
 
     def deco(fn: Callable) -> Callable:
         @functools.wraps(fn)
         def wrapper(*args: Any, **kwargs: Any) -> Any:
+            if (
+                _hash_randomized()
+                and not args and not kwargs
+                # module-level functions only: a closure-local test can't
+                # be re-created by loading its file in a child — and the
+                # file must exist on disk (REPL/-c definitions can't)
+                and fn.__qualname__ == fn.__name__
+                and os.path.exists(fn.__code__.co_filename)
+                and os.environ.get("MADSIM_TEST_NO_ISOLATE", "") != "1"
+            ):
+                # fn (not wrapper): the original's code object carries the
+                # test file path; the child's module-level decoration
+                # re-creates the wrapper and runs it in-process there
+                return _run_pinned_subprocess(fn)
             builder = Builder.from_env()
             for k, v in builder_kwargs.items():
                 if not hasattr(builder, k):

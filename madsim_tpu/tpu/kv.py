@@ -178,116 +178,68 @@ def make_kv_spec(
         # stagger first ticks so the initial election isn't a thundering herd
         return state, prng.randint(key, 30, 0, tick_us)
 
-    # ----------------------------------------------------------------- timer
+    # ----------------------------------------------------------- fused event
 
-    def on_timer(s: KvState, nid, now, key):
-        is_primary = s.role == PRIMARY
+    def on_event(s: KvState, nid, src, kind, payload, now, key):
+        """ALL events — the nine message kinds AND the timer tick
+        (kind == -1) — as ONE masked handler (ProtocolSpec.on_event).
+
+        Under vmap, a lax.switch on a traced kind executes EVERY branch and
+        selects — nine full KvState materializations per step, measured at
+        ~a third of the whole kv step; running on_message and on_timer as
+        separate bodies pays the same tax one level up (two candidate
+        states + a 3-way merge). The fused form computes each state field
+        once under mutually exclusive event masks; each kind's logic is the
+        direct transcription of the r3 per-kind handlers (h_hb, h_claim,
+        h_claim_ack, h_wrep, h_wack, h_rprobe, h_rack, h_creq, h_crsp —
+        see git history for the originals side by side)."""
+        f = payload
+        is_timer = kind == -1
+
+        # ====================== timer path (kind == -1) ===================
+        is_primary_t = is_timer & (s.role == PRIMARY)
 
         # -- election: replica missing heartbeats claims a higher epoch;
         #    claimer stuck too long retries with a fresh (higher) epoch
         jitter = prng.randint(key, 31, hb_timeout_lo_us, hb_timeout_hi_us)
-        start_claim = (s.role == REPLICA) & (now - s.last_hb > jitter)
-        retry_claim = (s.role == CLAIMING) & (now - s.claim_t > claim_retry_us)
+        start_claim = is_timer & (s.role == REPLICA) & (now - s.last_hb > jitter)
+        retry_claim = (
+            is_timer & (s.role == CLAIMING) & (now - s.claim_t > claim_retry_us)
+        )
         claim = start_claim | retry_claim
         gen = s.epoch // N + 1
-        new_epoch = jnp.where(claim, gen * N + nid, s.epoch)
-        role = jnp.where(claim, CLAIMING, s.role)
-        claim_acks = jnp.where(claim, jnp.int32(1) << nid, s.claim_acks)
-        claim_t = jnp.where(claim, now, s.claim_t)
+        t_epoch = jnp.where(claim, gen * N + nid, s.epoch)
 
         # -- primary: drop a quorum round that never reached majority
-        pend_expired = is_primary & (s.pend_kind > 0) & (
+        pend_expired = is_primary_t & (s.pend_kind > 0) & (
             now - s.pend_t > pend_timeout_us
         )
-        pend_kind = jnp.where(pend_expired, 0, s.pend_kind)
-        pend_recover = jnp.where(pend_expired, 0, s.pend_recover)
+        t_pend_kind = jnp.where(pend_expired, 0, s.pend_kind)
 
         # -- mandate recovery: re-commit the next merged key under this
         #    epoch (normal write-quorum machinery, one round at a time;
         #    recover_left unchanged on round timeout => same key retries)
-        start_rec = is_primary & (s.recover_left > 0) & (pend_kind == 0)
+        start_rec = is_primary_t & (s.recover_left > 0) & (t_pend_kind == 0)
         rec_key = jnp.clip(K - s.recover_left, 0, K - 1)
         rec_at = (kidx == rec_key).astype(jnp.int32)
         rec_val = (s.kv_val * rec_at).sum()
         rid_rec = s.epoch * REV_STRIDE + s.wcount + 1
 
         # -- client: expire a stuck request, else maybe issue a new one
-        req_expired = (s.creq_kind > 0) & (now - s.creq_t > req_timeout_us)
-        creq_kind = jnp.where(req_expired, 0, s.creq_kind)
-        issue = (creq_kind == 0) & (prng.uniform(key, 32) < client_rate)
-        is_write = prng.uniform(key, 33) < write_frac
-        op_kind = jnp.where(is_write, OP_WRITE, OP_READ)
+        req_expired = is_timer & (s.creq_kind > 0) & (
+            now - s.creq_t > req_timeout_us
+        )
+        t_creq_kind = jnp.where(req_expired, 0, s.creq_kind)
+        issue = is_timer & (t_creq_kind == 0) & (
+            prng.uniform(key, 32) < client_rate
+        )
+        is_write_t = prng.uniform(key, 33) < write_frac
+        op_kind = jnp.where(is_write_t, OP_WRITE, OP_READ)
         op_key = prng.randint(key, 34, 0, K)
-        op_val = jnp.where(is_write, nid * 100_000 + s.ccount, 0)
-        creq_kind = jnp.where(issue, op_kind, creq_kind)
-        creq_key = jnp.where(issue, op_key, s.creq_key)
-        creq_val = jnp.where(issue, op_val, s.creq_val)
-        creq_t = jnp.where(issue, now, s.creq_t)
-        ccount = s.ccount + (issue & is_write).astype(jnp.int32)
+        op_val = jnp.where(is_write_t, nid * 100_000 + s.ccount, 0)
         believed_primary = s.epoch % N
 
-        state = s._replace(
-            role=role, epoch=new_epoch, claim_acks=claim_acks, claim_t=claim_t,
-            pend_kind=jnp.where(start_rec, OP_WRITE, pend_kind),
-            pend_key=jnp.where(start_rec, rec_key, s.pend_key),
-            pend_val=jnp.where(start_rec, rec_val, s.pend_val),
-            pend_rev=jnp.where(start_rec, rid_rec, s.pend_rev),
-            pend_acks=jnp.where(start_rec, jnp.int32(1) << nid, s.pend_acks),
-            pend_recover=jnp.where(start_rec, 1, pend_recover),
-            pend_t=jnp.where(start_rec, now, s.pend_t),
-            wcount=s.wcount + start_rec.astype(jnp.int32),
-            creq_kind=creq_kind, creq_key=creq_key, creq_val=creq_val,
-            creq_t=creq_t, ccount=ccount,
-        )
-
-        # -- outbox: broadcast (CLAIM when claiming, recovery WREP when
-        #    re-committing a mandate — doubling as the heartbeat, since any
-        #    epoch-fresh quorum traffic feeds last_hb — else HB) in the
-        #    first N slots + the client CREQ in slot N
-        bc_kind = jnp.where(claim, CLAIM, jnp.where(start_rec, WREP, HB))
-        bc_valid = (peers != nid) & (is_primary | claim)
-        hb_pay = jnp.zeros((N, P), jnp.int32).at[:, 0].set(new_epoch)
-        rec_pay = (
-            jnp.zeros((P,), jnp.int32)
-            .at[0].set(new_epoch)
-            .at[1].set(rid_rec)
-            .at[2].set(rec_key)
-            .at[3].set(rec_val)
-        )
-        bc_pay = jnp.where(
-            jnp.reshape(start_rec, (1, 1)), rec_pay[None, :], hb_pay
-        )
-        creq_pay = (
-            jnp.zeros((P,), jnp.int32)
-            .at[0].set(state.epoch)
-            .at[1].set(creq_kind)
-            .at[2].set(creq_key)
-            .at[3].set(creq_val)
-            .at[4].set(creq_t)
-        )
-        out = Outbox(
-            valid=jnp.concatenate([bc_valid, jnp.reshape(issue, (1,))]),
-            dst=jnp.concatenate([peers, jnp.reshape(believed_primary, (1,))]),
-            kind=jnp.concatenate(
-                [jnp.full((N,), bc_kind, jnp.int32), jnp.full((1,), CREQ, jnp.int32)]
-            ),
-            payload=jnp.concatenate([bc_pay, creq_pay[None, :]], axis=0),
-        )
-        return state, out, now + tick_us
-
-    # --------------------------------------------------------------- message
-
-    def on_message(s: KvState, nid, src, kind, payload, now, key):
-        """All nine message kinds as ONE masked handler.
-
-        Under vmap, a lax.switch on a traced kind executes EVERY branch and
-        selects — nine full KvState materializations per step, measured at
-        ~a third of the whole kv step. The merged form computes each state
-        field once under mutually exclusive kind masks; each kind's logic
-        is the direct transcription of the r3 per-kind handlers (h_hb,
-        h_claim, h_claim_ack, h_wrep, h_wack, h_rprobe, h_rack, h_creq,
-        h_crsp — see git history for the originals side by side)."""
-        f = payload
+        # ====================== message path (kind >= 0) ==================
         is_hb = kind == HB
         is_claim = kind == CLAIM
         is_cack = kind == CLAIM_ACK
@@ -304,12 +256,17 @@ def make_kv_spec(
 
         # -- epoch adoption: HB/WREP/RPROBE adopt a higher epoch and
         # refresh last_hb on >=; a CLAIM additionally deposes + drops the
-        # open round (the claimer must not inherit it)
+        # open round (the claimer must not inherit it). t_epoch / `claim`
+        # fold the timer path's own claim bump (t_epoch == s.epoch on
+        # message events).
         adopty = is_hb | is_wrep | is_rprobe
         higher = f0 > s.epoch
         accept = is_claim & higher
-        epoch = jnp.where((adopty | is_claim) & higher, f0, s.epoch)
-        role = jnp.where((adopty | is_claim) & higher, REPLICA, s.role)
+        epoch = jnp.where((adopty | is_claim) & higher, f0, t_epoch)
+        role = jnp.where(
+            (adopty | is_claim) & higher, REPLICA,
+            jnp.where(claim, CLAIMING, s.role),
+        )
         last_hb = jnp.where(
             (adopty & (f0 >= s.epoch)) | accept, now, s.last_hb
         )
@@ -318,7 +275,8 @@ def make_kv_spec(
         # key); majority => PRIMARY with a full recovery mandate
         cmine = is_cack & (s.role == CLAIMING) & (f0 == s.epoch)
         claim_acks = jnp.where(
-            cmine, s.claim_acks | (jnp.int32(1) << src), s.claim_acks
+            cmine, s.claim_acks | (jnp.int32(1) << src),
+            jnp.where(claim, jnp.int32(1) << nid, s.claim_acks),
         )
         r_val = f[1 : 1 + K]
         r_rev = f[1 + K : 1 + 2 * K]
@@ -373,12 +331,15 @@ def make_kv_spec(
         at_k = kidx == f[2]  # [K]
         raise_wm = rmatch & at_k & (f[4] > s.wm_rev)
 
-        # -- merged field writes (kind masks are mutually exclusive)
+        # -- merged field writes (event masks are mutually exclusive:
+        # is_timer vs the kind masks; timer-path writes ride the msg
+        # chains' default branches)
         state = s._replace(
             epoch=epoch,
             role=role,
             last_hb=last_hb,
             claim_acks=claim_acks,
+            claim_t=jnp.where(claim, now, s.claim_t),
             kv_val=jnp.where(
                 ca_newer, r_val,
                 jnp.where(wrep_apply, f[3],
@@ -391,16 +352,33 @@ def make_kv_spec(
             ),
             pend_kind=jnp.where(
                 accept | won | commit_w | commit_r, 0,
-                jnp.where(start, f[1], s.pend_kind),
+                jnp.where(
+                    start, f[1],
+                    jnp.where(start_rec, OP_WRITE, t_pend_kind),
+                ),
             ),
-            pend_key=jnp.where(start, f[2], s.pend_key),
-            pend_val=jnp.where(start, f[3], s.pend_val),
-            pend_rev=jnp.where(start, rid, s.pend_rev),
-            pend_acks=jnp.where(start, jnp.int32(1) << nid, pend_acks),
+            pend_key=jnp.where(
+                start, f[2], jnp.where(start_rec, rec_key, s.pend_key)
+            ),
+            pend_val=jnp.where(
+                start, f[3], jnp.where(start_rec, rec_val, s.pend_val)
+            ),
+            pend_rev=jnp.where(
+                start, rid, jnp.where(start_rec, rid_rec, s.pend_rev)
+            ),
+            pend_acks=jnp.where(
+                start | start_rec, jnp.int32(1) << nid, pend_acks
+            ),
             pend_client=jnp.where(start, src, s.pend_client),
             pend_tinv=jnp.where(start, f[4], s.pend_tinv),
-            pend_t=jnp.where(start, now, s.pend_t),
-            pend_recover=jnp.where(accept | commit_w, 0, s.pend_recover),
+            pend_t=jnp.where(start | start_rec, now, s.pend_t),
+            pend_recover=jnp.where(
+                accept | commit_w, 0,
+                jnp.where(
+                    start_rec, 1,
+                    jnp.where(pend_expired, 0, s.pend_recover),
+                ),
+            ),
             recover_left=jnp.where(
                 won, K,
                 jnp.where(
@@ -409,8 +387,18 @@ def make_kv_spec(
                     s.recover_left,
                 ),
             ),
-            wcount=jnp.where(won, 0, s.wcount + start.astype(jnp.int32)),
-            creq_kind=jnp.where(rmatch, 0, s.creq_kind),
+            wcount=jnp.where(
+                won, 0,
+                s.wcount + start.astype(jnp.int32)
+                + start_rec.astype(jnp.int32),
+            ),
+            creq_kind=jnp.where(
+                rmatch, 0, jnp.where(issue, op_kind, t_creq_kind)
+            ),
+            creq_key=jnp.where(issue, op_key, s.creq_key),
+            creq_val=jnp.where(issue, op_val, s.creq_val),
+            creq_t=jnp.where(issue, now, s.creq_t),
+            ccount=s.ccount + (issue & is_write_t).astype(jnp.int32),
             h_kind=jnp.where(at_o, f[1], s.h_kind),
             h_key=jnp.where(at_o, f[2], s.h_key),
             h_val=jnp.where(at_o, f[3], s.h_val),
@@ -469,18 +457,82 @@ def make_kv_spec(
         )
         bc_kind = jnp.where(is_write, WREP, RPROBE)
 
+        # ================== merged outbox (E = N + 1 rows) ================
+        # timer event: rows 0..N-1 broadcast (CLAIM when claiming, recovery
+        # WREP when re-committing a mandate — doubling as the heartbeat,
+        # since any epoch-fresh quorum traffic feeds last_hb — else HB),
+        # row N the client CREQ. Message event: rows 0..N-1 carry the
+        # quorum broadcast (start) or the single reply; row N unused.
+        bc_valid_t = is_timer & (peers != nid) & (is_primary_t | claim)
+        bc_kind_t = jnp.where(claim, CLAIM, jnp.where(start_rec, WREP, HB))
+        hb_pay = jnp.zeros((N, P), jnp.int32).at[:, 0].set(t_epoch)
+        rec_pay = (
+            jnp.zeros((P,), jnp.int32)
+            .at[0].set(t_epoch)
+            .at[1].set(rid_rec)
+            .at[2].set(rec_key)
+            .at[3].set(rec_val)
+        )
+        bc_pay_t = jnp.where(
+            jnp.reshape(start_rec, (1, 1)), rec_pay[None, :], hb_pay
+        )
+        creq_pay = (
+            jnp.zeros((P,), jnp.int32)
+            .at[0].set(t_epoch)
+            .at[1].set(op_kind)
+            .at[2].set(op_key)
+            .at[3].set(op_val)
+            .at[4].set(now)
+        )
+
         at_row = peers == reply_dst
         out = Outbox(
-            valid=jnp.where(start, peers != nid, reply_valid & at_row),
-            dst=jnp.where(start, peers, jnp.full((N,), reply_dst, jnp.int32)),
-            kind=jnp.where(start, bc_kind, reply_kind).astype(jnp.int32)
-            * jnp.ones((N,), jnp.int32),
-            payload=jnp.where(
-                jnp.reshape(start, (1, 1)), bc_pay[None, :],
-                jnp.where(at_row[:, None], reply_pay[None, :], 0),
-            ),
+            valid=jnp.concatenate([
+                jnp.where(
+                    is_timer, bc_valid_t,
+                    jnp.where(start, peers != nid, reply_valid & at_row),
+                ),
+                jnp.reshape(issue, (1,)),
+            ]),
+            dst=jnp.concatenate([
+                jnp.where(
+                    is_timer | start, peers,
+                    jnp.full((N,), reply_dst, jnp.int32),
+                ),
+                jnp.reshape(believed_primary, (1,)),
+            ]),
+            kind=jnp.concatenate([
+                jnp.where(
+                    is_timer, bc_kind_t, jnp.where(start, bc_kind, reply_kind)
+                ).astype(jnp.int32) * jnp.ones((N,), jnp.int32),
+                jnp.full((1,), CREQ, jnp.int32),
+            ]),
+            payload=jnp.concatenate([
+                jnp.where(
+                    jnp.reshape(is_timer, (1, 1)), bc_pay_t,
+                    jnp.where(
+                        jnp.reshape(start, (1, 1)), bc_pay[None, :],
+                        jnp.where(at_row[:, None], reply_pay[None, :], 0),
+                    ),
+                ),
+                creq_pay[None, :],
+            ], axis=0),
         )
-        return state, out, jnp.int32(-1)
+        return state, out, jnp.where(is_timer, now + tick_us, jnp.int32(-1))
+
+    # --------------------------------------- derived two-handler wrappers
+    # (for direct calls in tests and the engine's non-fused fallback: a
+    # spec whose on_message is REPLACED must also pass on_event=None —
+    # use spec.replace_handlers)
+
+    def on_message(s: KvState, nid, src, kind, payload, now, key):
+        return on_event(s, nid, src, kind, payload, now, key)
+
+    def on_timer(s: KvState, nid, now, key):
+        return on_event(
+            s, nid, jnp.int32(0), jnp.int32(-1),
+            jnp.zeros((P,), jnp.int32), now, key,
+        )
 
     # --------------------------------------------------------------- restart
 
@@ -551,10 +603,14 @@ def make_kv_spec(
         n_nodes=N,
         payload_width=P,
         max_out=N + 1,  # broadcast + the client's CREQ
-        max_out_msg=N,  # CREQ fan-out of a write/read round
+        # derived on_message emits the fused handler's N+1 rows, so the
+        # non-fused fallback (on_event=None specs built from the wrappers)
+        # must size its reply class identically
+        max_out_msg=N + 1,
         init=init,
         on_message=on_message,
         on_timer=on_timer,
+        on_event=on_event,
         on_restart=on_restart,
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
@@ -581,7 +637,7 @@ def buggy_local_read_spec(base: ProtocolSpec | None = None, **kw) -> ProtocolSpe
     exactly the bug class the read-index quorum exists to prevent. Only
     partitions make it bite: without them heartbeats keep every store and
     every client's primary belief fresh."""
-    import dataclasses
+    from .spec import replace_handlers
 
     spec = base or make_kv_spec(**kw)
     inner_on_message = spec.on_message
@@ -615,7 +671,7 @@ def buggy_local_read_spec(base: ProtocolSpec | None = None, **kw) -> ProtocolSpe
         )
         return state, out, timer
 
-    return dataclasses.replace(spec, on_message=on_message)
+    return replace_handlers(spec, on_message=on_message)
 
 
 def kv_workload(
@@ -632,13 +688,14 @@ def kv_workload(
 
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
-        # ring depths measured for ZERO overflow at this traffic shape
-        # (headline configs must drop NOTHING the network didn't roll to
-        # drop): reply rows need 3 — a replica acking overlapping quorum
-        # rounds to the same primary bursts 3 sends inside one latency
-        # window — timer broadcasts need 2
-        msg_depth_msg=3,
-        msg_depth_timer=2,
+        # node-pooled slot budget measured for ZERO overflow at this
+        # traffic shape (headline configs must drop NOTHING the network
+        # didn't roll to drop): a replica acking overlapping quorum rounds
+        # bursts ~3 sends inside one latency window on top of its own
+        # broadcasts; depth 2 x (N+1) rows + 2 spare per node covers it
+        # with slack borrowed from quiet rows
+        msg_depth_msg=2,
+        msg_spare_slots=2,
         loss_rate=loss_rate,
         partition_interval_lo_us=400_000 if partitions else 0,
         partition_interval_hi_us=2_000_000 if partitions else 0,

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, majority as majority_of
+from .spec import Outbox, ProtocolSpec, fuse_two_handlers, majority as majority_of
 
 PREPARE, PROMISE, ACCEPT, ACCEPTED, DECIDED = range(5)
 PAYLOAD_WIDTH = 3  # (ballot, value, acc_ballot)
@@ -287,7 +287,7 @@ def make_paxos_spec(
             "mean_decided_nodes": have.sum(axis=-1).astype(jnp.float32),
         }
 
-    return ProtocolSpec(
+    return fuse_two_handlers(ProtocolSpec(
         name=f"paxos{N}",
         n_nodes=N,
         payload_width=PAYLOAD_WIDTH,
@@ -300,22 +300,39 @@ def make_paxos_spec(
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
         msg_kind_names=("PREPARE", "PROMISE", "ACCEPT", "ACCEPTED", "DECIDED"),
-    )
+    ))
 
 
 def paxos_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
                    loss_rate: float = 0.1):
-    """Single-decree consensus under the full chaos battery."""
+    """Single-decree consensus under the full chaos battery. A violating
+    seed gets BOTH microscopes: the device trace and the host twin
+    (workloads/paxos_host.py — the same synod as breakpointable
+    coroutines, continuously verified by the same agreement oracle)."""
     from .batch import BatchWorkload
     from .spec import SimConfig
 
+    def host_repro(seed: int):
+        from ..workloads import paxos_host
+
+        try:
+            out = paxos_host.fuzz_one_seed(
+                seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+                loss_rate=loss_rate,
+            )
+            out["violations"] = 0
+            return out
+        except paxos_host.InvariantViolation as e:
+            return {"violations": 1, "violation": str(e)}
+
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
-        # reply rings need 3: a proposer can broadcast ACCEPT and DECIDED
-        # from the same message rows within one latency window, on top of
-        # an in-flight reply (measured: depth 2 dropped ~1 per 32 lanes)
-        msg_depth_msg=3,
-        msg_depth_timer=2,
+        # node-pooled budget: a proposer can broadcast ACCEPT and DECIDED
+        # from the same rows within one latency window, on top of in-flight
+        # replies (per-row depth 2 dropped ~1 per 32 lanes before node
+        # pooling); depth 2 x N rows + 2 spare covers the burst
+        msg_depth_msg=2,
+        msg_spare_slots=2,
         loss_rate=loss_rate,
         crash_interval_lo_us=400_000,
         crash_interval_hi_us=2_000_000,
@@ -326,4 +343,6 @@ def paxos_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
         partition_heal_lo_us=400_000,
         partition_heal_hi_us=1_500_000,
     )
-    return BatchWorkload(spec=make_paxos_spec(n_nodes), config=cfg)
+    return BatchWorkload(
+        spec=make_paxos_spec(n_nodes), config=cfg, host_repro=host_repro
+    )

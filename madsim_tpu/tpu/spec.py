@@ -117,6 +117,41 @@ class Outbox(NamedTuple):
     payload: Any  # int32 [E, P]
 
 
+def fuse_two_handlers(spec: "ProtocolSpec") -> "ProtocolSpec":
+    """Derive a fused `on_event` from a spec's on_message/on_timer by
+    running both bodies and selecting (kind == -1 => timer).
+
+    This keeps the dual-body cost INSIDE the handler (a hand-merged
+    on_event like raft's/kv's is strictly cheaper for state-heavy specs),
+    but still buys the engine-side wins: one handler invocation + 2-way
+    merge instead of two + 3-way, and the candidate send positions
+    collapse from N*(max_out + max_out_msg) to N*max_out. Right-sized for
+    small-state specs (2PC, Paxos). Requires max_out == max_out_msg so
+    the two outbox shapes line up.
+    """
+    import dataclasses
+
+    if spec.max_out != spec.max_out_msg:
+        raise ValueError(
+            "fuse_two_handlers needs max_out == max_out_msg "
+            f"(got {spec.max_out} != {spec.max_out_msg})"
+        )
+
+    def on_event(s, nid, src, kind, payload, now, key):
+        st_m, out_m, tm_m = spec.on_message(
+            s, nid, src, jnp.maximum(kind, 0), payload, now, key
+        )
+        st_t, out_t, tm_t = spec.on_timer(s, nid, now, key)
+        is_timer = kind == -1
+        return (
+            tree_select(is_timer, st_t, st_m),
+            tree_select(is_timer, out_t, out_m),
+            jnp.where(is_timer, tm_t, tm_m),
+        )
+
+    return dataclasses.replace(spec, on_event=on_event)
+
+
 def replace_handlers(spec: "ProtocolSpec", **overrides) -> "ProtocolSpec":
     """dataclasses.replace for handler overrides that ALSO clears the fused
     on_event body (unless the override provides its own).

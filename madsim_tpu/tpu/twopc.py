@@ -64,7 +64,13 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, empty_outbox, tree_select
+from .spec import (  # noqa: F401
+    Outbox,
+    ProtocolSpec,
+    empty_outbox,
+    fuse_two_handlers,
+    tree_select,
+)
 
 NONE, COMMIT, ABORT = 0, 1, 2
 PREPARE, VOTE, OUTCOME, DREQ = 0, 1, 2, 3
@@ -336,7 +342,7 @@ def make_twopc_spec(
             "in_doubt_lanes": (voted_yes[:, 1:] & ~resolved[:, 1:]).any((-2, -1)),
         }
 
-    return ProtocolSpec(
+    return fuse_two_handlers(ProtocolSpec(
         name=f"twopc{N}",
         n_nodes=N,
         payload_width=PAYLOAD_WIDTH,
@@ -349,7 +355,7 @@ def make_twopc_spec(
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
         msg_kind_names=("PREPARE", "VOTE", "OUTCOME", "DREQ"),
-    )
+    ))
 
 
 def twopc_workload(
@@ -359,11 +365,26 @@ def twopc_workload(
     spec: "ProtocolSpec | None" = None,
 ):
     """The 2PC atomicity fuzz as a BatchWorkload: full chaos battery —
-    loss, coordinator crashes (the blocking case) and partitions. No host
-    twin exists for this protocol, so violating seeds re-run on device
-    via the trace microscope (run_batch's max_traces path)."""
+    loss, coordinator crashes (the blocking case) and partitions. A
+    violating seed gets BOTH microscopes: the device trace (run_batch's
+    max_traces path) and the host twin (workloads/twopc_host.py — the
+    same protocol as breakpointable coroutines, verified by the same
+    atomicity + vote-respect oracle)."""
     from .batch import BatchWorkload
     from .spec import SimConfig
+
+    def host_repro(seed: int):
+        from ..workloads import twopc_host
+
+        try:
+            out = twopc_host.fuzz_one_seed(
+                seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+                loss_rate=loss_rate,
+            )
+            out["violations"] = 0
+            return out
+        except twopc_host.InvariantViolation as e:
+            return {"violations": 1, "violation": str(e)}
 
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
@@ -384,4 +405,5 @@ def twopc_workload(
     return BatchWorkload(
         spec=spec if spec is not None else make_twopc_spec(n_nodes),
         config=cfg,
+        host_repro=host_repro,
     )

@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 import madsim_tpu as ms
+from madsim_tpu.tpu.spec import replace_handlers
 from madsim_tpu.tpu import (
     BatchViolation,
     BatchWorkload,
@@ -40,7 +41,7 @@ def buggy_raft_spec(n_nodes=5):
         role = jnp.where(win, raft_mod.LEADER, state.role)
         return state._replace(role=role), out, jnp.where(win, now, timer)
 
-    return dataclasses.replace(spec, on_message=buggy_on_message, on_event=None)
+    return replace_handlers(spec, on_message=buggy_on_message)
 
 
 def test_clean_raft_sweep_no_violations():

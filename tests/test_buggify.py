@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from madsim_tpu.tpu import BatchedSim, SimConfig, summarize
+from madsim_tpu.tpu.spec import replace_handlers
 from madsim_tpu.tpu import twopc as tp
 
 
@@ -47,7 +48,7 @@ def unilateral_abort_spec(n_nodes=5):
         out = out._replace(valid=out.valid & ~in_doubt)
         return state, out, timer
 
-    return dataclasses.replace(spec, on_timer=on_timer)
+    return replace_handlers(spec, on_timer=on_timer)
 
 
 def quiet_config(**kw):

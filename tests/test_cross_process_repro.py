@@ -1,0 +1,83 @@
+"""Cross-process repro WITHOUT user environment setup (VERDICT r4 #2).
+
+The reference's repro promise: the printed seed reproduces the execution
+in any process, no setup (it seeds HashMap's RandomState from the sim
+seed, rand.rs:176-244). CPython can't re-seed str hashing at runtime, so
+`@madsim_test` closes the hole by RE-EXECUTING the test in a child
+interpreter with PYTHONHASHSEED pinned whenever the caller's interpreter
+has randomized hashing (madsim_tpu/testing.py `_run_pinned_subprocess`).
+
+Proven here end to end: a sim whose RNG draw order depends on str-keyed
+set iteration produces BIT-IDENTICAL event logs in two *independent,
+unpinned* processes.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+# A @madsim_test whose trace depends on str-set iteration order; it PRINTS
+# its event log. Run twice in fresh unpinned interpreters: the decorator's
+# auto-isolation must make the outputs identical.
+DRIVER = """
+import sys
+sys.path.insert(0, {repo!r})
+import madsim_tpu as ms
+from madsim_tpu.testing import madsim_test
+
+
+@madsim_test
+async def test_hash_sensitive_sim():
+    import random
+    keys = {{f"key-{{i}}-{{'x' * (i % 7)}}" for i in range(32)}}
+    out = []
+    for k in keys:  # iteration order depends on the process hash seed
+        await ms.time.sleep((sum(k.encode()) % 97 + 1) / 1000)
+        out.append(random.randrange(2 + sum(k.encode())))
+    print("LOG", out, round(ms.time.current().elapsed(), 9))
+
+
+if __name__ == "__main__":
+    # the guard matters: isolation re-loads this file in a child (as a
+    # module, not __main__) and calls the test by name — an unguarded
+    # module-level call would run the sim twice there
+    test_hash_sensitive_sim()
+"""
+
+
+def _run_unpinned(tmp_path, extra_env=None) -> subprocess.CompletedProcess:
+    # the driver must live in a real FILE: isolation re-creates the test by
+    # loading its source file in the child (a -c string has no file)
+    driver = tmp_path / "hash_sensitive_driver.py"
+    driver.write_text(DRIVER.format(repo=REPO))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONHASHSEED"}
+    env["MADSIM_TEST_SEED"] = "7"
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, str(driver)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+
+
+def _log_line(proc: subprocess.CompletedProcess) -> str:
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("LOG ")]
+    assert len(lines) == 1, proc.stdout
+    return lines[0]
+
+
+def test_two_unpinned_processes_replay_identically(tmp_path):
+    a = _log_line(_run_unpinned(tmp_path))
+    b = _log_line(_run_unpinned(tmp_path))
+    assert a == b, f"cross-process divergence:\n  {a}\n  {b}"
+
+
+def test_opt_out_stays_in_process(tmp_path):
+    """MADSIM_TEST_NO_ISOLATE=1 runs in-process (for pdb); the sim still
+    runs and logs — only the cross-process guarantee is waived."""
+    proc = _run_unpinned(tmp_path, {"MADSIM_TEST_NO_ISOLATE": "1"})
+    assert proc.returncode == 0, proc.stderr
+    assert any(l.startswith("LOG ") for l in proc.stdout.splitlines())

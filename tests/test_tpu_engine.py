@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from madsim_tpu.tpu.spec import replace_handlers
 from madsim_tpu.tpu import (
     BatchedSim,
     SimConfig,
@@ -100,7 +101,7 @@ def test_injected_bug_is_caught():
         role = jnp.where(win, raft_mod.LEADER, state.role)
         return state._replace(role=role), out, jnp.where(win, now, timer)
 
-    buggy = dataclasses.replace(spec, on_message=buggy_on_message, on_event=None)
+    buggy = replace_handlers(spec, on_message=buggy_on_message)
     sim = BatchedSim(
         buggy,
         SimConfig(
@@ -190,7 +191,7 @@ def test_partition_split_brain_bug_caught():
         )
         return state._replace(commit=bogus_commit), out, timer
 
-    buggy = dataclasses.replace(spec, on_message=buggy_append_resp, on_event=None)
+    buggy = replace_handlers(spec, on_message=buggy_append_resp)
 
     # without partitions: the bug is mostly harmless in this horizon
     # with partitions: split-brain commits diverge and the fuzz catches it
@@ -367,7 +368,7 @@ def test_deposed_leader_restamp_bug_caught_on_device():
         log_term = jnp.where(deposed & in_log, state.term, state.log_term)
         return state._replace(log_term=log_term), out, timer
 
-    buggy = dataclasses.replace(spec, on_message=buggy_on_message, on_event=None)
+    buggy = replace_handlers(spec, on_message=buggy_on_message)
     sim = BatchedSim(buggy, partition_config(loss_rate=0.1))
     state = sim.run(jnp.arange(256), max_steps=60_000)
     s = summarize(state)
@@ -599,7 +600,7 @@ def test_unsafe_election_bug_caught_by_leader_completeness():
         )
         return state, out._replace(payload=pay), timer
 
-    buggy = dataclasses.replace(spec, on_message=unsafe_vote, on_event=None)
+    buggy = replace_handlers(spec, on_message=unsafe_vote)
     sim = BatchedSim(buggy, partition_config(loss_rate=0.1))
     state = sim.run(jnp.arange(256), max_steps=60_000)
     assert summarize(state)["violations"] > 0

@@ -16,8 +16,8 @@ from madsim_tpu.tpu.paxos import make_paxos_spec, paxos_workload
 
 def test_paxos_decides_and_agrees_quiet():
     sim = BatchedSim(
-        make_paxos_spec(5), SimConfig(horizon_us=3_000_000, msg_depth_msg=3,
-                                      msg_depth_timer=2)
+        make_paxos_spec(5), SimConfig(horizon_us=3_000_000, msg_depth_msg=2,
+                                      msg_spare_slots=2)
     )
     state = sim.run(jnp.arange(32), max_steps=20_000)
     s = summarize(state, sim.spec)

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from madsim_tpu.tpu import BatchedSim, SimConfig, summarize
+from madsim_tpu.tpu.spec import replace_handlers
 from madsim_tpu.tpu import twopc as tpc
 from madsim_tpu.tpu.twopc import make_twopc_spec
 
@@ -105,7 +106,7 @@ def test_twopc_unilateral_abort_bug_caught():
         )
         return state, out, timer
 
-    buggy = dataclasses.replace(spec, on_timer=impatient_timer)
+    buggy = replace_handlers(spec, on_timer=impatient_timer)
     sim = BatchedSim(buggy, full_chaos())
     state = sim.run(jnp.arange(256), max_steps=60_000)
     assert summarize(state)["violations"] > 0

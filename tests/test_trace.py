@@ -12,6 +12,7 @@ import pytest
 import jax.numpy as jnp
 import numpy as np
 
+from madsim_tpu.tpu.spec import replace_handlers
 from madsim_tpu.tpu import (
     BatchedSim,
     BatchWorkload,
@@ -55,7 +56,7 @@ def split_brain_spec():
         )
         return state._replace(commit=bogus_commit), out, timer
 
-    return dataclasses.replace(spec, on_message=buggy_append_resp, on_event=None)
+    return replace_handlers(spec, on_message=buggy_append_resp)
 
 
 @pytest.mark.deep

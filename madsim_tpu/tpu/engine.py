@@ -265,7 +265,6 @@ class BatchedSim:
         # separate depths — see SimConfig.
         uniform = max(1, cfg.msg_capacity // self._C)
         self._Km = cfg.msg_depth_msg or uniform
-        self._Kt = cfg.msg_depth_timer or uniform
         if self._fused:
             # NODE-POOLED slots: node n owns the SK = E*K (+ spare)
             # contiguous slots [n*SK, (n+1)*SK), shared by ALL its sends —
@@ -283,6 +282,7 @@ class BatchedSim:
             )  # [CK]
             self._segs = None
         else:
+            self._Kt = cfg.msg_depth_timer or uniform
             self._Cm = N * spec.max_out_msg
             self._Ct = N * spec.max_out
             self._Sm = self._Cm * self._Km  # slots of the msg-position segment
@@ -793,7 +793,12 @@ class BatchedSim:
             send_n = send.reshape(L, N, E)
             free = (~valid.any(1)).reshape(L, N, SK)  # [L,Nsrc,SK]
 
-            def prefix_counts(m):  # exclusive prefix count, unrolled
+            def prefix_counts(m):
+                # exclusive prefix count, UNROLLED on purpose: cumsum is a
+                # scan op that breaks XLA's elementwise fusion in this
+                # context (measured for the first-free masks, see
+                # docs/perf_notes.md "dtypes and ops"); the trailing dims
+                # here are tiny statics (E, SK)
                 out = []
                 acc = jnp.zeros(m.shape[:-1], jnp.int32)
                 for k in range(m.shape[-1]):
@@ -1127,6 +1132,15 @@ class BatchedSim:
         dest-major layout includes the message pool); XLA inserts gathers
         for the cross-node routing. The straggler side pool's dim 1 is the
         candidate axis, not the node axis — it stays lane-sharded only.
+
+        WHEN TO USE WHICH (measured, benches/node_sharding.py + the table
+        in docs/perf_notes.md): shard the LANE axis for throughput — on an
+        8-device mesh at N = 8/16/32 the 2-D layouts never beat 1-D by
+        more than ~20% and lose at N = 16; there is no regime where
+        node-sharding is a decisive speed win. Pass `node_axis` only when
+        a single device cannot HOLD the per-node state (very large
+        N x state: a memory-capacity lever, not a speed lever), and keep
+        >= ~16 lanes per device either way.
         """
         P = jax.sharding.PartitionSpec
         N = self.spec.n_nodes

@@ -70,7 +70,15 @@ from .spec import Outbox, ProtocolSpec, majority as majority_of
 REPLICA, CLAIMING, PRIMARY = 0, 1, 2
 HB, CLAIM, CLAIM_ACK, WREP, WACK, RPROBE, RACK, CREQ, CRSP = range(9)
 OP_READ, OP_WRITE = 1, 2
-REV_STRIDE = 1 << 10  # writes per epoch before rev collision (ample)
+# writes-per-epoch headroom before a revision collision. 1 << 15 balances
+# two int32 failure modes (ADVICE r4): a stable primary writing past the
+# stride would mint revisions that a later epoch's early revisions
+# numerically undercut (an acked write silently never applied — needs
+# ~32k writes in ONE primacy, ~4600 stable virtual seconds at the default
+# client rate), while a too-wide stride overflows epoch * REV_STRIDE
+# (epoch <= 65536 here, ~13k generations at N=5 — far past any soak).
+# lane_metrics surfaces rev_stride_pressure_lanes before either can bite.
+REV_STRIDE = 1 << 15
 
 
 class KvState(NamedTuple):
@@ -119,6 +127,23 @@ class KvState(NamedTuple):
     # state, so a crash must not amnesty a violation)
     wm_rev: jnp.ndarray  # i32 [K]                (durable)
     wm_t: jnp.ndarray  # i32 [K]                  (durable)
+    # most recently ACKED op on this node — the incremental-check register.
+    # The r4 oracle compared all M = N*OPS ring ops pairwise every step
+    # (O(M^2) per lane per step: the single biggest kv step cost, and
+    # QUADRATIC in the ring size, which priced horizon-sized rings out).
+    # At most one op acks per node per step, and a pair's later op is
+    # acked while the earlier one is still ring-resident iff the old
+    # pairwise sweep would have seen the pair too — so checking ONLY the
+    # newly acked op against the rings (+ watermarks, + the other nodes'
+    # registers for same-step acks) has identical coverage at O(M) per
+    # acked op. Sticky (not cleared): rechecking an old op is idempotent.
+    # Durable for the same reason as wm_*: oracle memory.
+    la_kind: jnp.ndarray  # i32 0=none             (durable)
+    la_key: jnp.ndarray  # i32                     (durable)
+    la_val: jnp.ndarray  # i32                     (durable)
+    la_rev: jnp.ndarray  # i32                     (durable)
+    la_tinv: jnp.ndarray  # i32                    (durable)
+    la_trsp: jnp.ndarray  # i32                    (durable)
 
 
 def make_kv_spec(
@@ -174,6 +199,7 @@ def make_kv_spec(
             h_len=z,
             wm_rev=jnp.zeros((K,), jnp.int32),
             wm_t=jnp.zeros((K,), jnp.int32),
+            la_kind=z, la_key=z, la_val=z, la_rev=z, la_tinv=z, la_trsp=z,
         )
         # stagger first ticks so the initial election isn't a thundering herd
         return state, prng.randint(key, 30, 0, tick_us)
@@ -408,6 +434,12 @@ def make_kv_spec(
             h_len=s.h_len + rmatch.astype(jnp.int32),
             wm_rev=jnp.where(raise_wm, f[4], s.wm_rev),
             wm_t=jnp.where(raise_wm, now, s.wm_t),
+            la_kind=jnp.where(rmatch, f[1], s.la_kind),
+            la_key=jnp.where(rmatch, f[2], s.la_key),
+            la_val=jnp.where(rmatch, f[3], s.la_val),
+            la_rev=jnp.where(rmatch, f[4], s.la_rev),
+            la_tinv=jnp.where(rmatch, s.creq_t, s.la_tinv),
+            la_trsp=jnp.where(rmatch, now, s.la_trsp),
         )
 
         # -- outbox: at most one reply (row dst) OR one broadcast (CREQ)
@@ -551,45 +583,67 @@ def make_kv_spec(
     # ------------------------------------------------------------ invariants
 
     def check_invariants(ns: KvState, alive, now):
-        # ns leaves are [N, ...] for one lane; pool all recorded client ops
-        kind = ns.h_kind.reshape(-1)  # [M], M = N*OPS
-        key_ = ns.h_key.reshape(-1)
-        val = ns.h_val.reshape(-1)
-        rev = ns.h_rev.reshape(-1)
-        tinv = ns.h_tinv.reshape(-1)
-        trsp = ns.h_trsp.reshape(-1)
+        # ns leaves are [N, ...] for one lane. INCREMENTAL form: only each
+        # node's most-recently-acked op (the la_* register, at most one new
+        # per node per step) is checked — against all ring ops, the
+        # watermarks, and the other registers. Coverage is identical to
+        # the r4 full M x M pairwise sweep (a pair's later op is acked
+        # while the earlier is ring-resident in exactly the same cases)
+        # at O(M) per acked op instead of O(M^2) per step — which is what
+        # makes horizon-sized history rings affordable.
+        la_ok = ns.la_kind > 0  # [N]
+        kind = ns.h_kind  # [N, OPS] ring ops (node-major kept: no reshape)
         valid = kind > 0
 
-        pair = valid[:, None] & valid[None, :]
-        same_key = key_[:, None] == key_[None, :]
-        # real-time rev monotonicity: j invoked after i responded must not
-        # observe a smaller revision (stale read / lost update)
-        after = tinv[None, :] > trsp[:, None]
-        regress = rev[None, :] < rev[:, None]
-        stale = pair & same_key & after & regress
-        # value coherence: same (key, rev) => same value
-        same_rev = rev[:, None] == rev[None, :]
-        diff_val = val[:, None] != val[None, :]
-        incoherent = pair & same_key & same_rev & diff_val
-        # watermark staleness: an op invoked after some node's max-rev
-        # watermark was established must not observe a smaller revision —
-        # the witness op may be ring-evicted, its evidence is not ([M,N,K])
-        wm_rev = ns.wm_rev  # [N,K]
-        wm_t = ns.wm_t
-        key_oh = key_[:, None, None] == kidx[None, None, :]  # [M,1,K]
-        wm_stale = (
-            valid[:, None, None]
-            & key_oh
-            & (wm_t[None, :, :] < tinv[:, None, None])
-            & (wm_rev[None, :, :] > rev[:, None, None])
+        a = la_ok[:, None, None] & valid[None, :, :]  # [Nla, N, OPS]
+        same_key = ns.la_key[:, None, None] == ns.h_key[None, :, :]
+        # real-time rev monotonicity, BOTH directions (same-step acks on
+        # other nodes land in the rings too):
+        #   register op invoked after ring op responded, smaller rev
+        stale_a = (
+            a & same_key
+            & (ns.la_tinv[:, None, None] > ns.h_trsp[None, :, :])
+            & (ns.la_rev[:, None, None] < ns.h_rev[None, :, :])
         )
-        return ~(stale.any() | incoherent.any() | wm_stale.any())
+        #   ring op invoked after register op responded, smaller rev
+        stale_b = (
+            a & same_key
+            & (ns.h_tinv[None, :, :] > ns.la_trsp[:, None, None])
+            & (ns.h_rev[None, :, :] < ns.la_rev[:, None, None])
+        )
+        # value coherence: same (key, rev) => same value
+        incoherent = (
+            a & same_key
+            & (ns.la_rev[:, None, None] == ns.h_rev[None, :, :])
+            & (ns.la_val[:, None, None] != ns.h_val[None, :, :])
+        )
+        # watermark staleness: a register op invoked after some node's
+        # max-rev watermark was established must not observe a smaller
+        # revision — the witness op may be ring-evicted, its evidence
+        # is not ([Nla, N, K])
+        key_oh = ns.la_key[:, None, None] == kidx[None, None, :]
+        wm_stale = (
+            la_ok[:, None, None]
+            & key_oh
+            & (ns.wm_t[None, :, :] < ns.la_tinv[:, None, None])
+            & (ns.wm_rev[None, :, :] > ns.la_rev[:, None, None])
+        )
+        return ~(
+            stale_a.any() | stale_b.any() | incoherent.any()
+            | wm_stale.any()
+        )
 
     # ------------------------------------------------------------ diagnostics
 
     def lane_metrics(node):
         total_ops = node.h_len.sum(axis=-1).astype(jnp.float32)
         return {
+            # wcount nearing the stride means a single primacy is minting
+            # enough revisions to threaten collision after the NEXT
+            # failover — surface it long before it can corrupt
+            "rev_stride_pressure_lanes": (
+                node.wcount > (REV_STRIDE * 3) // 4
+            ).any(axis=-1),
             # informational: lanes whose history ring wrapped. Since r4
             # every acked op still contributes to checking after eviction
             # (its max-rev evidence folds into wm_rev/wm_t at ack time), so
@@ -625,7 +679,7 @@ def make_kv_spec(
         # correlation — the client times out and retries, a liveness blip)
         time_fields=(
             "last_hb", "claim_t", "pend_tinv", "pend_t", "creq_t",
-            "h_tinv", "h_trsp", "wm_t",
+            "h_tinv", "h_trsp", "wm_t", "la_tinv", "la_trsp",
         ),
     )
 
@@ -637,13 +691,16 @@ def buggy_local_read_spec(base: ProtocolSpec | None = None, **kw) -> ProtocolSpe
     exactly the bug class the read-index quorum exists to prevent. Only
     partitions make it bite: without them heartbeats keep every store and
     every client's primary belief fresh."""
-    from .spec import replace_handlers
+    import dataclasses
 
     spec = base or make_kv_spec(**kw)
-    inner_on_message = spec.on_message
+    # wrap the FUSED handler (kind == -1 never matches CREQ, so the bug
+    # body is msg-only by construction); replacing on_message alone would
+    # leave the engine running the original fused body
+    inner_on_event = spec.on_event
 
-    def on_message(s, nid, src, kind, payload, now, key):
-        state, out, timer = inner_on_message(s, nid, src, kind, payload, now, key)
+    def on_event(s, nid, src, kind, payload, now, key):
+        state, out, timer = inner_on_event(s, nid, src, kind, payload, now, key)
         is_read_req = (kind == CREQ) & (payload[1] == OP_READ)
         K = s.kv_val.shape[0]
         at = (jnp.arange(K, dtype=jnp.int32) == payload[2]).astype(jnp.int32)
@@ -671,7 +728,8 @@ def buggy_local_read_spec(base: ProtocolSpec | None = None, **kw) -> ProtocolSpe
         )
         return state, out, timer
 
-    return replace_handlers(spec, on_message=on_message)
+    # on_message shares on_event's signature, so the buggy body serves both
+    return dataclasses.replace(spec, on_event=on_event, on_message=on_event)
 
 
 def kv_workload(
@@ -680,11 +738,37 @@ def kv_workload(
     loss_rate: float = 0.05,
     partitions: bool = True,
     spec: "ProtocolSpec | None" = None,
+    ops_capacity: "int | None" = None,
 ):
     """The replicated-KV linearizability fuzz as a BatchWorkload
-    (BASELINE config #4: etcd-semantics linearizability under partitions)."""
+    (BASELINE config #4: etcd-semantics linearizability under partitions).
+
+    The history ring is sized to the HORIZON by default (~6.4 acked
+    ops/node/sec at the default client rate, with headroom), so nearly
+    every acked op keeps its pairwise evidence until the end of the run
+    and the exact host-side checker (lane_check) sees close-to-complete
+    histories — the r4 ring (24) wrapped on >99% of bench lanes,
+    narrowing the exact check to each node's last 24 ops. Affordable
+    since the device oracle went incremental (O(ring) per acked op, not
+    O(ring^2) per step); watermarks still cover whatever wraps."""
     from .batch import BatchWorkload
     from .spec import SimConfig
+
+    if ops_capacity is None:
+        ops_capacity = max(24, min(128, int(virtual_secs * 6.4)))
+
+    the_spec = (
+        spec if spec is not None
+        else make_kv_spec(n_nodes=n_nodes, ops_capacity=ops_capacity)
+    )
+    # pool knobs depend on the spec's engine path: fused specs place
+    # node-pooled slots (depth + spare), two-handler specs (e.g. a
+    # replace_handlers variant under test) place per-class rings — the
+    # spare knob would be REJECTED there
+    if the_spec.on_event is not None:
+        pool_kw = dict(msg_depth_msg=2, msg_spare_slots=2)
+    else:
+        pool_kw = dict(msg_depth_msg=3, msg_depth_timer=2)
 
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
@@ -693,17 +777,15 @@ def kv_workload(
         # didn't roll to drop): a replica acking overlapping quorum rounds
         # bursts ~3 sends inside one latency window on top of its own
         # broadcasts; depth 2 x (N+1) rows + 2 spare per node covers it
-        # with slack borrowed from quiet rows
-        msg_depth_msg=2,
-        msg_spare_slots=2,
+        # with slack borrowed from quiet rows (see pool_kw above for the
+        # two-handler fallback shape)
+        **pool_kw,
         loss_rate=loss_rate,
         partition_interval_lo_us=400_000 if partitions else 0,
         partition_interval_hi_us=2_000_000 if partitions else 0,
         partition_heal_lo_us=500_000,
         partition_heal_hi_us=2_000_000,
     )
-    the_spec = spec if spec is not None else make_kv_spec(n_nodes=n_nodes)
-
     def lane_check(state, lanes):
         """Per-key Wing-Gong linearizability over the recorded histories
         (the exact oracle; the device invariants are the wide net)."""
@@ -746,4 +828,8 @@ def kv_workload(
         config=cfg,
         host_repro=host_repro,
         lane_check=lane_check,
+        # 64 clean lanes per chunk through the exact checker (r4 sampled 8
+        # — with zero violations in a 1.09B-event hunt the expensive exact
+        # oracle examined ~0.1% of lanes; VERDICT r4 weak #3)
+        lane_check_sample=64,
     )

@@ -95,9 +95,13 @@ def check_key_history(ops: List[Op]) -> Tuple[bool, Optional[List[Op]], int]:
     writes_by_val: Dict[int, Op] = {}
     for o in ops:
         if o.is_write:
-            # duplicate write values would break read->write matching; the
-            # kv spec guarantees uniqueness (nid * 100_000 + counter)
-            assert o.val not in writes_by_val, f"duplicate write value {o.val}"
+            if o.val in writes_by_val:
+                # duplicate write values break read->write matching; the kv
+                # spec guarantees uniqueness (nid * 100_000 + counter), so
+                # a duplicate is itself a finding — report it as a failed
+                # key rather than crash the whole lane_check pass (and
+                # unlike an assert, this survives python -O)
+                return False, [writes_by_val[o.val], o], 0
             writes_by_val[o.val] = o
 
     checked: List[Op] = []

@@ -325,14 +325,22 @@ def paxos_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
         except paxos_host.InvariantViolation as e:
             return {"violations": 1, "violation": str(e)}
 
+    the_spec = make_paxos_spec(n_nodes)
+    # fused specs use node-pooled slots; a two-handler variant (e.g. a
+    # replace_handlers planted-bug spec swapped in by a test) needs
+    # per-class ring depths instead — see SimConfig
+    pool_kw = (
+        dict(msg_depth_msg=2, msg_spare_slots=2)
+        if the_spec.on_event is not None
+        else dict(msg_depth_msg=3, msg_depth_timer=2)
+    )
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),
         # node-pooled budget: a proposer can broadcast ACCEPT and DECIDED
         # from the same rows within one latency window, on top of in-flight
         # replies (per-row depth 2 dropped ~1 per 32 lanes before node
         # pooling); depth 2 x N rows + 2 spare covers the burst
-        msg_depth_msg=2,
-        msg_spare_slots=2,
+        **pool_kw,
         loss_rate=loss_rate,
         crash_interval_lo_us=400_000,
         crash_interval_hi_us=2_000_000,
@@ -344,5 +352,5 @@ def paxos_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
         partition_heal_hi_us=1_500_000,
     )
     return BatchWorkload(
-        spec=make_paxos_spec(n_nodes), config=cfg, host_repro=host_repro
+        spec=the_spec, config=cfg, host_repro=host_repro
     )

@@ -106,10 +106,10 @@ def test_builder_jobs_parallel_speedup():
     # the round-2 weakness: GIL-bound thread jobs gave no speedup. Forked
     # jobs give real per-seed CPU parallelism wherever the machine has it.
     # Calibrate first: throttled/shared sandboxes advertise N vCPUs but
-    # deliver ~1 core erratically — only assert timing where two raw forked
-    # burns reliably overlap (best of 2 trials, solidly parallel).
-    if min(_machine_parallelism(), _machine_parallelism()) > 1.4:
-        pytest.skip("machine can't reliably run 2 CPU-bound processes in parallel")
+    # deliver ~1 core erratically — assert timing only where two raw
+    # forked burns reliably overlap (best of 2 trials, solidly parallel);
+    # elsewhere still assert the fork MECHANISM end to end (workers fork,
+    # every seed runs, results return) so the test never silently skips.
     import time as _time
 
     async def body():
@@ -118,13 +118,18 @@ def test_builder_jobs_parallel_speedup():
             x = (x * 1103515245 + 12345) & 0xFFFFFFFF
         return x
 
+    can_parallel = min(_machine_parallelism(), _machine_parallelism()) <= 1.4
+
     t0 = _time.perf_counter()
-    Builder(seed=0, count=8, jobs=1).run(lambda: body())
+    r_serial = Builder(seed=0, count=8, jobs=1).run(lambda: body())
     serial = _time.perf_counter() - t0
     t0 = _time.perf_counter()
-    Builder(seed=0, count=8, jobs=2).run(lambda: body())
+    r_forked = Builder(seed=0, count=8, jobs=2).run(lambda: body())
     forked = _time.perf_counter() - t0
-    assert forked < serial / 1.3, (serial, forked)
+    # same seeds => same last-seed result, whichever worker ran it
+    assert r_forked == r_serial
+    if can_parallel:
+        assert forked < serial / 1.3, (serial, forked)
 
 
 def test_failure_reports_repro_seed():

@@ -5,6 +5,7 @@ linearizable, and staleness whose witness op was evicted by the history
 ring (SURVEY §7 step 5 / BASELINE config #4)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -110,7 +111,10 @@ def test_watermark_catches_stale_read_after_ring_wrap():
 
     # node 1's ring holds ONLY a stale read: key 0, rev 3, invoked at
     # t=2000 — no other ring entry anywhere (the rev-50 write that makes it
-    # stale was evicted long ago). Pairwise check alone cannot object.
+    # stale was evicted long ago). Pairwise evidence alone cannot object.
+    # The r5 oracle is INCREMENTAL (an op is checked when it ACKS, via the
+    # la_* register the handler writes alongside the ring entry), so the
+    # crafted state models the ack: ring entry + register together.
     node = node._replace(
         h_kind=node.h_kind.at[0, 1, 0].set(OP_READ),
         h_key=node.h_key.at[0, 1, 0].set(0),
@@ -119,6 +123,12 @@ def test_watermark_catches_stale_read_after_ring_wrap():
         h_tinv=node.h_tinv.at[0, 1, 0].set(2_000),
         h_trsp=node.h_trsp.at[0, 1, 0].set(2_100),
         h_len=node.h_len.at[0, 1].set(9),  # wrapped: 9 > OPS=4
+        la_kind=node.la_kind.at[0, 1].set(OP_READ),
+        la_key=node.la_key.at[0, 1].set(0),
+        la_val=node.la_val.at[0, 1].set(7),
+        la_rev=node.la_rev.at[0, 1].set(3),
+        la_tinv=node.la_tinv.at[0, 1].set(2_000),
+        la_trsp=node.la_trsp.at[0, 1].set(2_100),
     )
     assert ok(node)  # without the watermark evidence, nothing to object to
 
@@ -148,3 +158,78 @@ def test_watermark_tracks_acked_ops_in_sweep():
     state = sim.run(jnp.arange(8), max_steps=4000)
     assert int(np.asarray(state.node.wm_rev).max()) > 0
     assert int(np.asarray(state.violated).sum()) == 0
+
+
+def test_future_read_passes_device_oracle_but_not_wing_gong():
+    """The exact checker earns its keep (VERDICT r4 weak #3): a READ that
+    observes a value BEFORE the write producing it even started — with a
+    monotone, unclaimed revision — satisfies every device invariant
+    (monotonicity, coherence, watermarks) yet is not linearizable. Only
+    the Wing-Gong search catches it."""
+    from madsim_tpu.tpu import linearize
+
+    spec = make_kv_spec(n_nodes=3, ops_capacity=4)
+    node = _crafted_kv_state(spec)
+    alive = jnp.ones((3,), jnp.bool_)
+
+    def put(nd, n, i, kind, key, val, rev, tinv, trsp, register=False):
+        nd = nd._replace(
+            h_kind=nd.h_kind.at[0, n, i].set(kind),
+            h_key=nd.h_key.at[0, n, i].set(key),
+            h_val=nd.h_val.at[0, n, i].set(val),
+            h_rev=nd.h_rev.at[0, n, i].set(rev),
+            h_tinv=nd.h_tinv.at[0, n, i].set(tinv),
+            h_trsp=nd.h_trsp.at[0, n, i].set(trsp),
+            h_len=nd.h_len.at[0, n].add(1),
+        )
+        if register:
+            nd = nd._replace(
+                la_kind=nd.la_kind.at[0, n].set(kind),
+                la_key=nd.la_key.at[0, n].set(key),
+                la_val=nd.la_val.at[0, n].set(val),
+                la_rev=nd.la_rev.at[0, n].set(rev),
+                la_tinv=nd.la_tinv.at[0, n].set(tinv),
+                la_trsp=nd.la_trsp.at[0, n].set(trsp),
+            )
+        return nd
+
+    OP_WRITE = 2
+    # node 0: the FUTURE READ — observes val 200001 at [1000, 1100], rev 7
+    node = put(node, 0, 0, OP_READ, 0, 200001, 7, 1_000, 1_100, register=True)
+    # node 2: the witness write of val 200001 happens LATER [5000, 5200],
+    # rev 9 (revs stay monotone in real time; rev 7 is an unclaimed gap)
+    node = put(node, 2, 0, OP_WRITE, 0, 200001, 9, 5_000, 5_200, register=True)
+    node = node._replace(
+        wm_rev=node.wm_rev.at[0, 0, 0].set(7),
+        wm_t=node.wm_t.at[0, 0, 0].set(1_100),
+    )
+
+    # the device-side net passes it...
+    assert bool(spec.check_invariants(
+        jax.tree_util.tree_map(lambda x: x[0], node), alive, jnp.int32(9_000)
+    ))
+    # ...the exact checker does not
+    verdict = linearize.check_lane(node, 0)
+    assert not verdict["linearizable"], verdict
+
+
+@pytest.mark.deep
+def test_exact_checker_over_thousand_clean_lanes():
+    """Deep tier: the exact Wing-Gong oracle over >= 1k clean lanes of a
+    real partitioned sweep — with the horizon-sized ring nearly every
+    acked op is ring-resident, so the exact check covers close to the
+    full history (not the r4 ~0.1% sample)."""
+    from madsim_tpu.tpu import linearize
+
+    wl = kv_workload(virtual_secs=6.0)
+    sim = BatchedSim(wl.spec, wl.config)
+    lanes = 1024
+    state = sim.run(jnp.arange(lanes), max_steps=10_000)
+    assert int(np.asarray(state.violated).sum()) == 0
+    out = linearize.check_lanes(state.node, range(lanes))
+    assert out["violations"] == 0
+    acked = float(np.asarray(state.node.h_len).sum())
+    fraction = out["ops_checked"] / max(acked, 1)
+    # horizon-sized ring: the exact check must cover the great majority
+    # of every acked op, not a sliver
+    assert fraction > 0.9, (out, acked)

@@ -64,7 +64,11 @@ def main() -> None:
                 state, m, lane_axis="seeds",
                 node_axis="nodes" if nn > 1 else None,
             )
-            jax.block_until_ready(sim.run_steps(state, 10))
+            # warmup with the SAME step count: run_steps jits per
+            # (shape, n_steps), so a different warmup count would leave
+            # the timed call's XLA compile inside the timing window
+            state = sim.run_steps(state, SCAN)
+            jax.block_until_ready(state)
             t0 = time.perf_counter()
             jax.block_until_ready(sim.run_steps(state, SCAN))
             row[name + "_step_ms"] = round(

@@ -51,7 +51,7 @@ def measure_copy_bw_gbs(n_mb: int = 256, reps: int = 3) -> float:
     f1, f2 = make(L1), make(L2)
     int(f1(jnp.uint32(1)))
     int(f2(jnp.uint32(1)))
-    best = 0.0
+    rates = []
     for r in range(2, reps + 2):
         t0 = time.perf_counter()
         int(f1(jnp.uint32(r)))
@@ -60,8 +60,16 @@ def measure_copy_bw_gbs(n_mb: int = 256, reps: int = 3) -> float:
         int(f2(jnp.uint32(r)))
         t2 = time.perf_counter() - t0
         if t2 > t1:
-            best = max(best, 2 * n * 4 * (L2 - L1) / (t2 - t1) / 1e9)
-    return best
+            rates.append(2 * n * 4 * (L2 - L1) / (t2 - t1) / 1e9)
+    if not rates:
+        return float("nan")
+    # MEDIAN, not max: contention hitting the short-loop rep inflates the
+    # marginal rate without bound (one bench run recorded an impossible
+    # 2 TB/s); the median of interleaved pairs is robust. Values beyond
+    # the v5e's physical 819 GB/s mean every rep was contaminated —
+    # clamp and let the consumer see the ceiling rather than fiction.
+    med = sorted(rates)[len(rates) // 2]
+    return min(med, 819.0)
 
 
 def hlo_hbm_bytes(sim, state) -> dict:

@@ -761,14 +761,13 @@ def kv_workload(
         spec if spec is not None
         else make_kv_spec(n_nodes=n_nodes, ops_capacity=ops_capacity)
     )
-    # pool knobs depend on the spec's engine path: fused specs place
-    # node-pooled slots (depth + spare), two-handler specs (e.g. a
-    # replace_handlers variant under test) place per-class rings — the
-    # spare knob would be REJECTED there
-    if the_spec.on_event is not None:
-        pool_kw = dict(msg_depth_msg=2, msg_spare_slots=2)
-    else:
-        pool_kw = dict(msg_depth_msg=3, msg_depth_timer=2)
+    from .spec import pool_kw_for
+
+    pool_kw = pool_kw_for(
+        the_spec,
+        fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+        two_handler=dict(msg_depth_msg=3, msg_depth_timer=2),
+    )
 
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),

@@ -325,14 +325,13 @@ def paxos_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
         except paxos_host.InvariantViolation as e:
             return {"violations": 1, "violation": str(e)}
 
+    from .spec import pool_kw_for
+
     the_spec = make_paxos_spec(n_nodes)
-    # fused specs use node-pooled slots; a two-handler variant (e.g. a
-    # replace_handlers planted-bug spec swapped in by a test) needs
-    # per-class ring depths instead — see SimConfig
-    pool_kw = (
-        dict(msg_depth_msg=2, msg_spare_slots=2)
-        if the_spec.on_event is not None
-        else dict(msg_depth_msg=3, msg_depth_timer=2)
+    pool_kw = pool_kw_for(
+        the_spec,
+        fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+        two_handler=dict(msg_depth_msg=3, msg_depth_timer=2),
     )
     cfg = SimConfig(
         horizon_us=int(virtual_secs * 1e6),

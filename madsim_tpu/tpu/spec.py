@@ -152,6 +152,16 @@ def fuse_two_handlers(spec: "ProtocolSpec") -> "ProtocolSpec":
     return dataclasses.replace(spec, on_event=on_event)
 
 
+def pool_kw_for(spec: "ProtocolSpec", fused: dict, two_handler: dict) -> dict:
+    """Pick the pool-sizing SimConfig kwargs matching the spec's engine
+    path: fused (on_event) specs place NODE-POOLED slots (depth + spare),
+    two-handler specs place per-class rings (per-class depths) — and the
+    spare knob is rejected on the latter. Workload factories use this so
+    a `replace_handlers` spec variant keeps working through the stock
+    workload (kv_workload/paxos_workload)."""
+    return dict(fused if spec.on_event is not None else two_handler)
+
+
 def replace_handlers(spec: "ProtocolSpec", **overrides) -> "ProtocolSpec":
     """dataclasses.replace for handler overrides that ALSO clears the fused
     on_event body (unless the override provides its own).

@@ -1140,12 +1140,12 @@ class BatchedSim:
 
         WHEN TO USE WHICH (measured, benches/node_sharding.py + the table
         in docs/perf_notes.md): shard the LANE axis for throughput — on an
-        8-device mesh at N = 8/16/32 the 2-D layouts never beat 1-D by
-        more than ~20% and lose at N = 16; there is no regime where
-        node-sharding is a decisive speed win. Pass `node_axis` only when
-        a single device cannot HOLD the per-node state (very large
-        N x state: a memory-capacity lever, not a speed lever), and keep
-        >= ~16 lanes per device either way.
+        8-device mesh the 2-D layouts LOSE at every N measured (12x
+        slower at N = 8, still behind at N = 32): node sharding pays
+        per-step cross-device gathers for message routing, lane sharding
+        pays nothing. Pass `node_axis` only when a single device cannot
+        HOLD the per-node state (very large N x state: a memory-capacity
+        lever, not a speed lever).
         """
         P = jax.sharding.PartitionSpec
         N = self.spec.n_nodes

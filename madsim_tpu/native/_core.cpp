@@ -15,7 +15,9 @@
 #include <Python.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
+#include <cstring>
 #include <vector>
 
 /* ------------------------------- xoshiro256++ --------------------------- */
@@ -363,10 +365,108 @@ static PyTypeObject QueueType = {
     sizeof(QueueObject),
 };
 
+/* ------------------------- shm ring data plane --------------------------- *
+ *
+ * The native data plane behind real/shm.py's SPSC byte ring (the same-host
+ * analog of the reference's RDMA-class fabrics, std/net/ucx.rs /
+ * std/net/erpc.rs). Layout matches the Python implementation exactly:
+ * byte 0..8 = the reader-owned CONSUMED counter (little-endian u64),
+ * bytes 8.. = the ring of capacity (len - 8). The Python side keeps the
+ * producer's PRODUCED and the reader's EXPECTED cursors; these functions
+ * do the per-frame hot work (counter load/store with real acquire/release
+ * ordering — stronger than the Python path, which leans on the doorbell
+ * socket's FIFO as its barrier — plus the wrap-aware memcpys) in one call
+ * instead of several Python bytecode dispatches and struct pack/unpacks.
+ */
+
+static inline std::atomic<uint64_t>* shm_counter(void* base) {
+  return reinterpret_cast<std::atomic<uint64_t>*>(base);
+}
+
+/* shm_try_write(segment, produced, data) -> None | new logical offset.
+ * Copies data into the ring at logical offset `produced`; None = no room
+ * (caller sends inline — the ring is an optimization, never required). */
+static PyObject* shm_try_write(PyObject*, PyObject* args) {
+  Py_buffer seg, data;
+  unsigned long long produced;
+  if (!PyArg_ParseTuple(args, "w*Ky*", &seg, &produced, &data)) return nullptr;
+  PyObject* result = nullptr;
+  const uint64_t cap = (uint64_t)seg.len - 8;
+  const uint64_t n = (uint64_t)data.len;
+  if ((Py_ssize_t)seg.len <= 8 || n == 0 || n > cap) {
+    result = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    uint64_t consumed =
+        shm_counter(seg.buf)->load(std::memory_order_acquire);
+    uint64_t pending = produced - consumed;
+    // pending > cap means a corrupt/rewound counter (a crashed or hostile
+    // same-UID attacher): the unsigned free-space subtraction would wrap
+    // to ~2^64 and let the copy overwrite unconsumed bytes — refuse, like
+    // the Python fallback's negative-free check, and let the caller send
+    // inline (the ring is an optimization, never a correctness dependency)
+    if (pending > cap || n > cap - pending) {
+      result = Py_None;
+      Py_INCREF(Py_None);
+    } else {
+      uint64_t pos = produced % cap;
+      uint64_t first = n < cap - pos ? n : cap - pos;
+      char* ring = (char*)seg.buf + 8;
+      memcpy(ring + pos, data.buf, first);
+      if (first < n) memcpy(ring, (char*)data.buf + first, n - first);
+      result = PyLong_FromUnsignedLongLong(produced);
+    }
+  }
+  PyBuffer_Release(&seg);
+  PyBuffer_Release(&data);
+  return result;
+}
+
+/* shm_read(segment, off, length, expected) -> bytes.
+ * Copies a descriptor's body out and RELEASES it (consumed := off+length,
+ * store-release). Raises ValueError on any descriptor that isn't the
+ * reader's own cursor — corrupt/replayed descriptors must close the
+ * connection, never index the ring. */
+static PyObject* shm_read(PyObject*, PyObject* args) {
+  Py_buffer seg;
+  unsigned long long off, length, expected;
+  if (!PyArg_ParseTuple(args, "w*KKK", &seg, &off, &length, &expected))
+    return nullptr;
+  const uint64_t cap = (uint64_t)seg.len - 8;
+  if ((Py_ssize_t)seg.len <= 8 || length == 0 || length > cap ||
+      off != expected) {
+    PyBuffer_Release(&seg);
+    return PyErr_Format(PyExc_ValueError,
+                        "bad shm descriptor: off=%llu len=%llu", off, length);
+  }
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)length);
+  if (!out) {
+    PyBuffer_Release(&seg);
+    return nullptr;
+  }
+  char* dst = PyBytes_AS_STRING(out);
+  const char* ring = (const char*)seg.buf + 8;
+  uint64_t pos = off % cap;
+  uint64_t first = length < cap - pos ? length : cap - pos;
+  memcpy(dst, ring + pos, first);
+  if (first < length) memcpy(dst + first, ring, length - first);
+  shm_counter(seg.buf)->store(off + length, std::memory_order_release);
+  PyBuffer_Release(&seg);
+  return out;
+}
+
+static PyMethodDef core_functions[] = {
+    {"shm_try_write", shm_try_write, METH_VARARGS,
+     "copy a frame body into the SPSC ring; None when no room"},
+    {"shm_read", shm_read, METH_VARARGS,
+     "copy a frame body out of the SPSC ring and release it"},
+    {nullptr, nullptr, 0, nullptr}};
+
 /* ------------------------------- module --------------------------------- */
 
 static PyModuleDef core_module = {PyModuleDef_HEAD_INIT, "_core",
-                                  "native executor core", -1, nullptr};
+                                  "native executor core", -1,
+                                  core_functions};
 
 PyMODINIT_FUNC PyInit__core(void) {
   RngType.tp_new = PyType_GenericNew;

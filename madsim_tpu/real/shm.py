@@ -33,7 +33,25 @@ from typing import Optional, Tuple
 
 _U64 = struct.Struct("<Q")
 HEADER = 8  # consumed counter
-DEFAULT_RING = 1 << 20  # 1 MiB per direction
+# 4 MiB per direction: a ring must hold at least TWO max-size bodies to
+# double-buffer (producer writes body k+1 while the reader drains body k);
+# 1 MiB stalled a stream of 1 MiB payloads on flow control every frame
+# (measured in benches/rpc_bench.py). net.py's MADSIM_SHM_RING overrides.
+DEFAULT_RING = 4 << 20
+
+# the NATIVE data plane (madsim_tpu/native/_core.cpp shm_try_write /
+# shm_read): the per-frame hot work — counter load/store with real
+# acquire/release ordering plus the wrap-aware copies — in one C call
+# instead of several bytecode dispatches and struct pack/unpacks. Same
+# segment layout; either side of a connection may run without it (the
+# pure-Python path below is the always-available fallback and the
+# on-the-wire format is identical).
+try:
+    from ..native import _core as _native
+    _shm_try_write = getattr(_native, "shm_try_write", None)
+    _shm_read = getattr(_native, "shm_read", None)
+except Exception:  # pragma: no cover - native core is optional by design
+    _shm_try_write = _shm_read = None
 
 
 class ShmRing:
@@ -94,6 +112,12 @@ class ShmRing:
         if self._closed:
             return None
         n = len(data)
+        if _shm_try_write is not None:
+            off = _shm_try_write(self._shm.buf, self._produced, data)
+            if off is None:
+                return None
+            self._produced = off + n
+            return off, n
         if n == 0 or n > self._cap:
             return None
         free = self._cap - (self._produced - self._consumed())
@@ -121,10 +145,13 @@ class ShmRing:
         (socket FIFO == ring order), so the only legal offset is the
         reader's own cursor; anything else is a corrupt/replayed
         descriptor."""
-        if (
-            self._closed or length <= 0 or length > self._cap
-            or off != self._expected
-        ):
+        if self._closed:
+            raise ValueError(f"bad shm descriptor: off={off} len={length}")
+        if _shm_read is not None:
+            out = _shm_read(self._shm.buf, off, length, self._expected)
+            self._expected = off + length
+            return out
+        if length <= 0 or length > self._cap or off != self._expected:
             raise ValueError(f"bad shm descriptor: off={off} len={length}")
         self._expected = off + length
         pos = off % self._cap

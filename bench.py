@@ -376,6 +376,23 @@ def bench_paxos(lanes: int, virtual_secs: float) -> dict:
     }
 
 
+def bench_chain(lanes: int, virtual_secs: float) -> dict:
+    """Fifth device protocol: chain replication under loss + crash chaos
+    (hop-by-hop acks, retransmission, tail reads)."""
+    from madsim_tpu.tpu import BatchedSim, chain_workload, summarize
+
+    wl = chain_workload(virtual_secs=virtual_secs)
+    sim = BatchedSim(wl.spec, wl.config)
+    max_steps = int(virtual_secs * 2400) + 2000
+
+    wall, state = _timed_median_of_3(sim, lanes, max_steps)
+    return {
+        "wall_s": wall,
+        "seeds_per_sec": lanes / wall,
+        "summary": summarize(state, sim.spec),
+    }
+
+
 def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
     """The HONEST CPU denominator: a compiled thread-per-seed DES fuzzer
     (native/raft_bench.cpp) running the same protocol + chaos + invariant
@@ -481,6 +498,7 @@ def main() -> None:
     kv = bench_kv(args.lanes // 4, args.virtual_secs)
     twopc = bench_twopc(args.lanes // 4, args.virtual_secs)
     paxos = bench_paxos(args.lanes // 4, args.virtual_secs)
+    chain = bench_chain(args.lanes // 4, args.virtual_secs)
     buggify = bench_buggify_ab(args.lanes // 16, args.virtual_secs)
     breakdown = (
         {} if args.skip_breakdown
@@ -561,6 +579,14 @@ def main() -> None:
         "paxos_overflow": paxos["summary"]["total_overflow"],
         "paxos_all_decided_lanes": paxos["summary"].get(
             "all_decided_lanes", 0
+        ),
+        # fifth device protocol (chain replication, loss + crash chaos)
+        "chain_seeds_per_sec": round(chain["seeds_per_sec"], 2),
+        "chain_lanes": args.lanes // 4,
+        "chain_violations": chain["summary"]["violations"],
+        "chain_overflow": chain["summary"]["total_overflow"],
+        "chain_mean_committed_vers": round(
+            chain["summary"].get("mean_committed_vers", 0.0), 1
         ),
         # heavy-tail buggify A/B (events explored with/without the tail)
         "buggify_ab": buggify,

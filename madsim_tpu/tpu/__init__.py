@@ -29,6 +29,7 @@ from .spec import (  # noqa: F401
     empty_outbox,
     replace_handlers,
 )
+from .chain import ChainState, chain_workload, make_chain_spec  # noqa: F401
 from .paxos import PaxosState, make_paxos_spec, paxos_workload  # noqa: F401
 from .twopc import TpcState, make_twopc_spec, twopc_workload  # noqa: F401
 from .trace import TraceEvent, extract_trace, format_trace, trace_seed  # noqa: F401

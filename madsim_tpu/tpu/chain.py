@@ -1,0 +1,409 @@
+"""Chain Replication — the fifth device fuzz protocol.
+
+A fifth *shape* (raft: symmetric replicated log; kv: primary/backup quorum
+rounds; twopc: asymmetric one-shot commit; paxos: ballot duels): a FIXED
+LINEAR TOPOLOGY 0 (head) -> N-1 (tail) where writes enter at the head,
+propagate hop by hop with per-hop acks and retransmission, commit when
+they reach the tail, and linearizable reads are served AT THE TAIL only
+(van Renesse & Schneider, OSDI'04). Written with `fuse_two_handlers` per
+docs/authoring_protocol_specs.md — the guide's "the next protocol is an
+afternoon" claim, exercised a second time.
+
+Protocol:
+
+  * Every node is also a client (like tpu/kv.py): writes go to the HEAD
+    (WREQ), reads to the TAIL (RREQ); one outstanding client op per node
+    with timeout + retry.
+  * The head assigns a per-key monotone version (vnext, durable) and
+    APPLIES + forwards (FWD) down the chain. Each node holds ONE
+    outstanding forward slot, retransmitting on its tick until the
+    DOWNSTREAM hop-ack (HACK) clears it; a node accepts a FWD only when
+    its own slot is free (upstream retransmission covers the refusal).
+    Apply-if-newer makes redelivery idempotent.
+  * The tail applies, hop-acks, and sends the commit ack (CACK) straight
+    to the writing client. Only tail-applied writes are ever acked —
+    that is the whole linearizability argument.
+  * Crash/restart: the store, the head's version counter, and the oracle
+    memory are durable; the forward slot and client state are volatile.
+    A mid-chain crash may therefore LOSE an uncommitted write (its hop
+    was acked upstream but not yet forwarded) — safe, because it was
+    never tail-acked; the client times out and retries with a FRESH
+    version. Liveness, not safety.
+
+Device invariants (per lane, per step):
+  * Chain monotonicity: versions never increase downstream —
+    kv_ver[i][k] >= kv_ver[i+1][k] for every adjacent pair (writes flow
+    strictly head->tail; durable stores preserve this across restarts).
+  * Version coherence: two nodes holding the same (key, version>0) hold
+    the same value (head-assigned versions are per-key unique).
+  * Client-observed monotonicity (the kv-style incremental oracle): each
+    node's most recently ACKED client op (la_* register) is checked
+    against per-(node,key) acked watermarks — an op invoked after a
+    higher version was observable is stale.
+
+The canonical injected bug (`buggy_blind_apply=True`): a replica missing
+the apply-if-newer guard applies REDELIVERED forwards unconditionally. A
+hop-ack lost to the network makes the upstream retransmit; when the
+duplicate arrives late — after newer versions flowed through — the blind
+replica rolls its store BACK, and the chain-monotonicity invariant fires
+(its downstream neighbor now holds a newer version than it does). Only
+message loss + latency jitter make it fire: the redelivery must overtake
+a newer write. (`buggy_read_at_head=True` also exists — the dirty-read
+bug — but it is deliberately NOT device-catchable: head-assigned
+versions are globally monotone, so observing an uncommitted version
+violates nothing the per-step oracle can see; catching it takes the
+recorded-history Wing-Gong class of check, which is the kv workload's
+job. The spec keeps the knob as documentation of that boundary.)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .spec import Outbox, ProtocolSpec, fuse_two_handlers
+
+FWD, HACK, WREQ, RREQ, RRSP, CACK = range(6)
+OP_READ, OP_WRITE = 1, 2
+PAYLOAD_WIDTH = 5  # (key, val, ver, writer, echo_t)
+
+
+class ChainState(NamedTuple):
+    # replicated store
+    kv_val: jnp.ndarray  # i32 [K]               (durable)
+    kv_ver: jnp.ndarray  # i32 [K]               (durable)
+    vnext: jnp.ndarray  # i32 [K] head's next version per key (durable)
+    # the ONE outstanding downstream forward (volatile: a crash may lose
+    # an uncommitted write — safe, it was never tail-acked)
+    fw_valid: jnp.ndarray  # i32 0|1
+    fw_key: jnp.ndarray  # i32
+    fw_val: jnp.ndarray  # i32
+    fw_ver: jnp.ndarray  # i32
+    fw_writer: jnp.ndarray  # i32
+    fw_echo: jnp.ndarray  # i32 the writer's invocation-time echo (rides
+    # the whole chain so the tail's CACK can match the client's request)
+    fw_t: jnp.ndarray  # i32 last (re)transmit time    (volatile)
+    # client side (volatile)
+    creq_kind: jnp.ndarray  # i32 0=none
+    creq_key: jnp.ndarray  # i32
+    creq_t: jnp.ndarray  # i32
+    ccount: jnp.ndarray  # i32                   (durable)
+    # oracle memory (durable — a crash must not amnesty a violation):
+    # per-key max version this node ever observed in an ACKED client op,
+    # with the time it became observable; plus the kv-style most-recently
+    # acked op register for incremental checking
+    wm_ver: jnp.ndarray  # i32 [K]
+    wm_t: jnp.ndarray  # i32 [K]
+    la_kind: jnp.ndarray  # i32 0=none
+    la_key: jnp.ndarray  # i32
+    la_ver: jnp.ndarray  # i32
+    la_tinv: jnp.ndarray  # i32
+
+
+def make_chain_spec(
+    n_nodes: int = 5,
+    n_keys: int = 4,
+    tick_us: int = 20_000,
+    retx_us: int = 60_000,
+    req_timeout_us: int = 300_000,
+    client_rate: float = 0.6,
+    write_frac: float = 0.5,
+    buggy_read_at_head: bool = False,
+    buggy_blind_apply: bool = False,
+) -> ProtocolSpec:
+    N, K = n_nodes, n_keys
+    assert N >= 3
+    peers = jnp.arange(N, dtype=jnp.int32)
+    kidx = jnp.arange(K, dtype=jnp.int32)
+    HEAD, TAIL = 0, N - 1
+
+    # ------------------------------------------------------------------ init
+
+    def init(key, nid):
+        z = jnp.int32(0)
+        state = ChainState(
+            kv_val=jnp.zeros((K,), jnp.int32),
+            kv_ver=jnp.zeros((K,), jnp.int32),
+            vnext=jnp.ones((K,), jnp.int32),
+            fw_valid=z, fw_key=z, fw_val=z, fw_ver=z, fw_writer=z,
+            fw_echo=z, fw_t=z,
+            creq_kind=z, creq_key=z, creq_t=z,
+            ccount=jnp.int32(1),
+            wm_ver=jnp.zeros((K,), jnp.int32),
+            wm_t=jnp.zeros((K,), jnp.int32),
+            la_kind=z, la_key=z, la_ver=z, la_tinv=z,
+        )
+        return state, prng.randint(key, 50, 0, tick_us)
+
+    # ----------------------------------------------------------------- timer
+
+    def on_timer(s: ChainState, nid, now, key):
+        is_tail = nid == TAIL
+        # retransmit the pending forward to the next hop
+        retx = (s.fw_valid > 0) & ~is_tail & (now - s.fw_t > retx_us)
+        # client: expire a stuck request, maybe issue a new one
+        req_expired = (s.creq_kind > 0) & (now - s.creq_t > req_timeout_us)
+        creq_kind = jnp.where(req_expired, 0, s.creq_kind)
+        issue = (creq_kind == 0) & (prng.uniform(key, 51) < client_rate)
+        is_write = prng.uniform(key, 52) < write_frac
+        op_kind = jnp.where(is_write, OP_WRITE, OP_READ)
+        op_key = prng.randint(key, 53, 0, K)
+        op_val = jnp.where(is_write, nid * 100_000 + s.ccount, 0)
+        read_target = HEAD if buggy_read_at_head else TAIL
+
+        state = s._replace(
+            fw_t=jnp.where(retx, now, s.fw_t),
+            creq_kind=jnp.where(issue, op_kind, creq_kind),
+            creq_key=jnp.where(issue, op_key, s.creq_key),
+            creq_t=jnp.where(issue, now, s.creq_t),
+            ccount=s.ccount + (issue & is_write).astype(jnp.int32),
+        )
+        # row 0: the retransmitted FWD; row 1: the client op
+        fwd_pay = jnp.stack([s.fw_key, s.fw_val, s.fw_ver, s.fw_writer,
+                             s.fw_echo])
+        req_pay = jnp.stack([op_key, op_val, jnp.int32(0), nid, now])
+        out = Outbox(
+            valid=jnp.stack([retx, issue]),
+            dst=jnp.stack([
+                jnp.minimum(nid + 1, N - 1),
+                jnp.where(issue & is_write, HEAD, read_target).astype(
+                    jnp.int32
+                ),
+            ]),
+            kind=jnp.stack([
+                jnp.int32(FWD),
+                jnp.where(issue & is_write, WREQ, RREQ).astype(jnp.int32),
+            ]),
+            payload=jnp.stack([fwd_pay, req_pay]),
+        )
+        return state, out, now + tick_us
+
+    # --------------------------------------------------------------- message
+
+    def on_message(s: ChainState, nid, src, kind, payload, now, key):
+        f = payload
+        is_fwd = kind == FWD
+        is_hack = kind == HACK
+        is_wreq = kind == WREQ
+        is_rreq = kind == RREQ
+        is_rrsp = kind == RRSP
+        is_cack = kind == CACK
+        is_head = nid == HEAD
+        is_tail = nid == TAIL
+        at_k = kidx == f[0]  # [K]
+
+        # -- WREQ (head only): assign a fresh per-key version, apply,
+        # take the forward slot (drop when busy: client retries)
+        w_ok = is_wreq & is_head & (s.fw_valid == 0) & (f[1] != 0)
+        new_ver = (s.vnext * at_k.astype(jnp.int32)).sum()
+        w_apply = w_ok & at_k
+
+        # -- FWD: accept iff my slot is free (or I'm the tail, which
+        # never forwards); apply-if-newer makes redelivery idempotent
+        f_ok = is_fwd & (is_tail | (s.fw_valid == 0))
+        if buggy_blind_apply:
+            # the planted bug: no apply-if-newer guard — a delayed
+            # duplicate rolls the store back
+            f_apply = f_ok & at_k
+        else:
+            f_apply = f_ok & at_k & (f[2] > s.kv_ver)
+
+        # -- HACK from downstream: clear the matching forward
+        h_clear = is_hack & (s.fw_valid > 0) & (f[2] == s.fw_ver) & (
+            f[0] == s.fw_key
+        )
+
+        # -- CACK / RRSP at the client: record the acked op. A read's
+        # version comes from the responder (f[2]); match on the echoed
+        # invocation time so a stale retransmitted ack can't match a
+        # newer request.
+        mine = (is_cack | is_rrsp) & (s.creq_kind > 0) & (f[4] == s.creq_t)
+        raise_wm = mine & at_k & (f[2] > s.wm_ver)
+
+        take_fw = w_ok | (f_ok & ~is_tail & is_fwd)
+        state = s._replace(
+            kv_val=jnp.where(
+                w_apply, f[1], jnp.where(f_apply, f[1], s.kv_val)
+            ),
+            kv_ver=jnp.where(
+                w_apply, new_ver, jnp.where(f_apply, f[2], s.kv_ver)
+            ),
+            vnext=jnp.where(w_apply, s.vnext + 1, s.vnext),
+            fw_valid=jnp.where(take_fw, 1, jnp.where(h_clear, 0, s.fw_valid)),
+            fw_key=jnp.where(take_fw, f[0], s.fw_key),
+            fw_val=jnp.where(take_fw, f[1], s.fw_val),
+            fw_ver=jnp.where(w_ok, new_ver, jnp.where(take_fw, f[2], s.fw_ver)),
+            fw_writer=jnp.where(take_fw, f[3], s.fw_writer),
+            fw_echo=jnp.where(take_fw, f[4], s.fw_echo),
+            fw_t=jnp.where(take_fw, now, s.fw_t),
+            creq_kind=jnp.where(mine, 0, s.creq_kind),
+            wm_ver=jnp.where(raise_wm, f[2], s.wm_ver),
+            wm_t=jnp.where(raise_wm, now, s.wm_t),
+            la_kind=jnp.where(mine, jnp.where(is_cack, OP_WRITE, OP_READ),
+                              s.la_kind),
+            la_key=jnp.where(mine, f[0], s.la_key),
+            la_ver=jnp.where(mine, f[2], s.la_ver),
+            la_tinv=jnp.where(mine, s.creq_t, s.la_tinv),
+        )
+
+        # -- outbox (2 rows). Row 0: the new FWD downstream (head WREQ or
+        # a middle node relaying) OR the read response. Row 1: the hop-ack
+        # upstream OR the tail's commit ack to the writer.
+        fwd_ver = jnp.where(w_ok, new_ver, f[2])
+        serve_read = is_rreq & (is_tail | jnp.bool_(buggy_read_at_head))
+        r_val = (s.kv_val * at_k.astype(jnp.int32)).sum()
+        r_ver = (s.kv_ver * at_k.astype(jnp.int32)).sum()
+        row0_fwd = (w_ok | (f_ok & is_fwd)) & ~is_tail
+        row0_valid = row0_fwd | serve_read
+        row0_dst = jnp.where(
+            serve_read, src, jnp.minimum(nid + 1, N - 1)
+        ).astype(jnp.int32)
+        row0_kind = jnp.where(serve_read, RRSP, FWD).astype(jnp.int32)
+        row0_pay = jnp.where(
+            serve_read,
+            jnp.stack([f[0], r_val, r_ver, f[3], f[4]]),
+            jnp.stack([f[0], f[1], fwd_ver, f[3], f[4]]),
+        )
+        # hop-ack to upstream when a FWD was accepted; commit ack when
+        # the tail accepted (redelivered FWDs re-ack: idempotent at the
+        # client thanks to the echoed-creq_t match)
+        row1_hack = f_ok & is_fwd
+        row1_cack = f_ok & is_fwd & is_tail
+        row1_valid = row1_hack | row1_cack
+        # the tail emits CACK in row 1 and its HACK rides row 0? No — the
+        # tail never forwards, so row 0 is free for its HACK; middle nodes
+        # use row 0 for the relay FWD and row 1 for the HACK.
+        row0_valid = row0_valid | (row1_hack & is_tail)
+        row0_dst = jnp.where(
+            row1_hack & is_tail & ~serve_read,
+            jnp.maximum(nid - 1, 0), row0_dst,
+        ).astype(jnp.int32)
+        row0_kind = jnp.where(
+            row1_hack & is_tail & ~serve_read, HACK, row0_kind
+        ).astype(jnp.int32)
+        row0_pay = jnp.where(
+            (row1_hack & is_tail & ~serve_read),
+            jnp.stack([f[0], jnp.int32(0), f[2], jnp.int32(0), jnp.int32(0)]),
+            row0_pay,
+        )
+        row1_dst = jnp.where(
+            row1_cack, f[3], jnp.maximum(nid - 1, 0)
+        ).astype(jnp.int32)
+        row1_kind = jnp.where(row1_cack, CACK, HACK).astype(jnp.int32)
+        row1_pay = jnp.where(
+            row1_cack,
+            jnp.stack([f[0], f[1], f[2], f[3], f[4]]),
+            jnp.stack([f[0], jnp.int32(0), f[2], jnp.int32(0), jnp.int32(0)]),
+        )
+        out = Outbox(
+            valid=jnp.stack([row0_valid, jnp.where(is_tail, row1_cack,
+                                                   row1_valid)]),
+            dst=jnp.stack([row0_dst, row1_dst]),
+            kind=jnp.stack([row0_kind, row1_kind]),
+            payload=jnp.stack([row0_pay, row1_pay]),
+        )
+        return state, out, jnp.int32(-1)
+
+    # --------------------------------------------------------------- restart
+
+    def on_restart(s: ChainState, nid, now, key):
+        z = jnp.int32(0)
+        state = s._replace(
+            fw_valid=z, creq_kind=z,
+        )
+        return state, now + prng.randint(key, 54, 0, tick_us)
+
+    # ------------------------------------------------------------ invariants
+
+    def check_invariants(ns: ChainState, alive, now):
+        # ns leaves are [N, ...] for one lane
+        # 1. chain monotonicity: versions never increase downstream
+        mono = ~(ns.kv_ver[:-1] < ns.kv_ver[1:]).any()
+        # 2. version coherence: same (key, ver>0) => same value
+        same_ver = (
+            (ns.kv_ver[:, None, :] == ns.kv_ver[None, :, :])
+            & (ns.kv_ver[:, None, :] > 0)
+        )
+        diff_val = ns.kv_val[:, None, :] != ns.kv_val[None, :, :]
+        coherent = ~(same_ver & diff_val).any()
+        # 3. client-observed monotonicity (incremental register vs
+        # watermarks, the kv pattern): an op invoked after some node's
+        # higher-version watermark was established is stale
+        la_ok = ns.la_kind > 0  # [N]
+        key_oh = ns.la_key[:, None, None] == kidx[None, None, :]  # [N,1,K]
+        wm_stale = (
+            la_ok[:, None, None]
+            & key_oh
+            & (ns.wm_t[None, :, :] < ns.la_tinv[:, None, None])
+            & (ns.wm_ver[None, :, :] > ns.la_ver[:, None, None])
+        )
+        return mono & coherent & ~wm_stale.any()
+
+    # ------------------------------------------------------------ diagnostics
+
+    def lane_metrics(node):
+        return {
+            "mean_committed_vers": node.kv_ver[:, -1].sum(-1).astype(
+                jnp.float32
+            ),
+            "mean_acked_like": node.ccount.sum(-1).astype(jnp.float32),
+        }
+
+    return fuse_two_handlers(ProtocolSpec(
+        name=f"chain{N}",
+        n_nodes=N,
+        payload_width=PAYLOAD_WIDTH,
+        max_out=2,
+        max_out_msg=2,
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+        lane_metrics=lane_metrics,
+        msg_kind_names=("FWD", "HACK", "WREQ", "RREQ", "RRSP", "CACK"),
+        time_fields=("fw_t", "fw_echo", "creq_t", "wm_t", "la_tinv"),
+    ))
+
+
+def chain_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
+                   loss_rate: float = 0.1):
+    """Chain replication under loss + crash/restart chaos (partitions are
+    omitted: a partitioned fixed chain simply stalls — every hop is a
+    cut point — so partitions only measure timeout plumbing here). A
+    violating seed gets both microscopes: the device trace and the host
+    twin (workloads/chain_host.py), verified by the same oracle."""
+    from .batch import BatchWorkload
+    from .spec import SimConfig, pool_kw_for
+
+    spec = make_chain_spec(n_nodes)
+
+    def host_repro(seed: int):
+        from ..workloads import chain_host
+
+        try:
+            out = chain_host.fuzz_one_seed(
+                seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+                loss_rate=loss_rate,
+            )
+            out["violations"] = 0
+            return out
+        except chain_host.InvariantViolation as e:
+            return {"violations": 1, "violation": str(e)}
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+        loss_rate=loss_rate,
+        crash_interval_lo_us=400_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=1_000_000,
+    )
+    return BatchWorkload(spec=spec, config=cfg, host_repro=host_repro)

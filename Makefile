@@ -5,13 +5,16 @@
 
 PY ?= python
 
-.PHONY: test deep test-all real native bench dryrun demo clean
+.PHONY: test deep test-all chaos-smoke real native bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
 
 deep:            ## deep device sweeps (~10 min; CI nightly)
 	$(PY) -m pytest tests/ -q -m deep
+
+chaos-smoke:     ## fast nemesis smoke: 64-lane fault plans on both backends
+	$(PY) -m pytest tests/ -q -m "chaos and not slow"
 
 test-all: test deep
 

@@ -1,0 +1,293 @@
+"""Minimal TOML reader — stdlib-`tomllib` stand-in for Python < 3.11.
+
+The repo targets 3.11+ (`pyproject.toml`), but the supported floor in
+practice is whatever interpreter the test container ships; on 3.10 the
+stdlib has no `tomllib` and every module importing it fails at collection
+time. This vendors the subset the repo actually parses — `Config.parse`
+(net chaos knobs), `MADSIM_TEST_CONFIG` SimConfig overrides, and the etcd
+snapshot format — rather than adding a dependency the container may not
+have.
+
+Supported: `[table]` / `[[array-of-table]]` headers (dotted, quoted),
+`key = value` with bare or quoted keys (dotted), basic/literal strings,
+integers (underscores, sign, 0x/0o/0b), floats (exponent, inf/nan),
+booleans, arrays (nested, multi-line), and inline tables. Not supported
+(nothing in-repo emits them): dates/times, multi-line strings.
+
+Import it the way the stdlib doc suggests importing tomli:
+
+    try:
+        import tomllib
+    except ImportError:
+        from madsim_tpu import _toml as tomllib
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+
+class TOMLDecodeError(ValueError):
+    pass
+
+
+def load(fp) -> Dict[str, Any]:
+    data = fp.read()
+    if isinstance(data, bytes):
+        data = data.decode("utf-8")
+    return loads(data)
+
+
+def loads(text: str) -> Dict[str, Any]:
+    if not isinstance(text, str):
+        raise TypeError(f"loads() expects str, got {type(text).__name__}")
+    root: Dict[str, Any] = {}
+    current = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = _strip_comment(lines[i])
+        i += 1
+        if not line:
+            continue
+        if line.startswith("[["):
+            if not line.endswith("]]"):
+                raise TOMLDecodeError(f"malformed table-array header: {line!r}")
+            keys = _parse_dotted_key(line[2:-2].strip())
+            parent = _descend(root, keys[:-1])
+            arr = parent.setdefault(keys[-1], [])
+            if not isinstance(arr, list):
+                raise TOMLDecodeError(f"{'.'.join(keys)} is not a table array")
+            current = {}
+            arr.append(current)
+        elif line.startswith("["):
+            if not line.endswith("]"):
+                raise TOMLDecodeError(f"malformed table header: {line!r}")
+            keys = _parse_dotted_key(line[1:-1].strip())
+            parent = _descend(root, keys[:-1])
+            current = parent.setdefault(keys[-1], {})
+            if not isinstance(current, dict):
+                raise TOMLDecodeError(f"{'.'.join(keys)} is not a table")
+        else:
+            if "=" not in line:
+                raise TOMLDecodeError(f"expected 'key = value', got {line!r}")
+            key_part, _, rest = _split_key_value(line)
+            # a value may continue across lines (multi-line arrays)
+            while True:
+                try:
+                    value, tail = _parse_value(rest.strip())
+                except _NeedMoreInput:
+                    if i >= len(lines):
+                        raise TOMLDecodeError(f"unterminated value for {key_part!r}")
+                    rest = rest + "\n" + _strip_comment(lines[i])
+                    i += 1
+                    continue
+                break
+            if tail.strip():
+                raise TOMLDecodeError(f"trailing garbage after value: {tail!r}")
+            keys = _parse_dotted_key(key_part.strip())
+            target = _descend(current, keys[:-1])
+            if keys[-1] in target:
+                raise TOMLDecodeError(f"duplicate key: {'.'.join(keys)}")
+            target[keys[-1]] = value
+    return root
+
+
+class _NeedMoreInput(Exception):
+    """An array/inline value ran off the end of the current line."""
+
+
+def _strip_comment(line: str) -> str:
+    out = []
+    in_str: str = ""
+    j = 0
+    while j < len(line):
+        ch = line[j]
+        if in_str:
+            if ch == "\\" and in_str == '"':
+                out.append(line[j : j + 2])
+                j += 2
+                continue
+            if ch == in_str:
+                in_str = ""
+        elif ch in ('"', "'"):
+            in_str = ch
+        elif ch == "#":
+            break
+        out.append(ch)
+        j += 1
+    return "".join(out).strip()
+
+
+def _split_key_value(line: str) -> Tuple[str, str, str]:
+    """Split at the first '=' outside a quoted key."""
+    in_str = ""
+    for j, ch in enumerate(line):
+        if in_str:
+            if ch == in_str:
+                in_str = ""
+        elif ch in ('"', "'"):
+            in_str = ch
+        elif ch == "=":
+            return line[:j], "=", line[j + 1 :]
+    raise TOMLDecodeError(f"expected 'key = value', got {line!r}")
+
+
+def _parse_dotted_key(s: str) -> List[str]:
+    keys: List[str] = []
+    j, n = 0, len(s)
+    while j < n:
+        ch = s[j]
+        if ch in ('"', "'"):
+            end = s.find(ch, j + 1)
+            if end < 0:
+                raise TOMLDecodeError(f"unterminated quoted key in {s!r}")
+            keys.append(s[j + 1 : end])
+            j = end + 1
+        else:
+            end = j
+            while end < n and s[end] not in ".":
+                end += 1
+            part = s[j:end].strip()
+            if not part:
+                raise TOMLDecodeError(f"empty key component in {s!r}")
+            keys.append(part)
+            j = end
+        while j < n and s[j] in " \t":
+            j += 1
+        if j < n:
+            if s[j] != ".":
+                raise TOMLDecodeError(f"malformed key {s!r}")
+            j += 1
+            while j < n and s[j] in " \t":
+                j += 1
+    if not keys:
+        raise TOMLDecodeError("empty key")
+    return keys
+
+
+def _descend(table: Dict[str, Any], keys: List[str]) -> Dict[str, Any]:
+    for k in keys:
+        nxt = table.setdefault(k, {})
+        if isinstance(nxt, list):  # [[x]] then [x.y]: descend into last entry
+            nxt = nxt[-1]
+        if not isinstance(nxt, dict):
+            raise TOMLDecodeError(f"{k} is not a table")
+        table = nxt
+    return table
+
+
+def _parse_value(s: str) -> Tuple[Any, str]:
+    """Parse one value at the head of `s`; return (value, remaining_text)."""
+    if not s:
+        raise _NeedMoreInput()
+    ch = s[0]
+    if ch == '"' or ch == "'":
+        return _parse_string(s)
+    if ch == "[":
+        return _parse_array(s)
+    if ch == "{":
+        return _parse_inline_table(s)
+    # bare scalar: ends at , ] } or whitespace-then-end
+    end = 0
+    while end < len(s) and s[end] not in ",]}":
+        end += 1
+    token, rest = s[:end].strip(), s[end:]
+    if not token:
+        raise TOMLDecodeError(f"empty value before {rest!r}")
+    return _parse_scalar(token), rest
+
+
+def _parse_string(s: str) -> Tuple[str, str]:
+    quote = s[0]
+    if quote == "'":
+        end = s.find("'", 1)
+        if end < 0:
+            raise TOMLDecodeError(f"unterminated literal string: {s!r}")
+        return s[1:end], s[end + 1 :]
+    out = []
+    j = 1
+    while j < len(s):
+        ch = s[j]
+        if ch == "\\":
+            if j + 1 >= len(s):
+                raise TOMLDecodeError(f"dangling escape in {s!r}")
+            esc = s[j + 1]
+            mapped = {
+                "n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\",
+                "b": "\b", "f": "\f",
+            }.get(esc)
+            if mapped is not None:
+                out.append(mapped)
+                j += 2
+                continue
+            if esc == "u" and j + 6 <= len(s):
+                out.append(chr(int(s[j + 2 : j + 6], 16)))
+                j += 6
+                continue
+            raise TOMLDecodeError(f"unsupported escape \\{esc}")
+        if ch == '"':
+            return "".join(out), s[j + 1 :]
+        out.append(ch)
+        j += 1
+    raise TOMLDecodeError(f"unterminated string: {s!r}")
+
+
+def _parse_array(s: str) -> Tuple[List[Any], str]:
+    items: List[Any] = []
+    rest = s[1:]
+    while True:
+        rest = rest.lstrip(" \t\n")
+        if not rest:
+            raise _NeedMoreInput()
+        if rest[0] == "]":
+            return items, rest[1:]
+        value, rest = _parse_value(rest)
+        items.append(value)
+        rest = rest.lstrip(" \t\n")
+        if not rest:
+            raise _NeedMoreInput()
+        if rest[0] == ",":
+            rest = rest[1:]
+        elif rest[0] != "]":
+            raise TOMLDecodeError(f"expected ',' or ']' in array, got {rest!r}")
+
+
+def _parse_inline_table(s: str) -> Tuple[Dict[str, Any], str]:
+    table: Dict[str, Any] = {}
+    rest = s[1:]
+    while True:
+        rest = rest.lstrip(" \t")
+        if not rest:
+            raise _NeedMoreInput()
+        if rest[0] == "}":
+            return table, rest[1:]
+        key_part, _, rest = _split_key_value(rest)
+        value, rest = _parse_value(rest.strip())
+        table[_parse_dotted_key(key_part.strip())[-1]] = value
+        rest = rest.lstrip(" \t")
+        if rest and rest[0] == ",":
+            rest = rest[1:]
+
+
+def _parse_scalar(token: str):
+    if token == "true":
+        return True
+    if token == "false":
+        return False
+    num = token.replace("_", "")
+    try:
+        if num.lower().startswith(("0x", "-0x", "+0x")):
+            return int(num, 16)
+        if num.lower().startswith(("0o", "-0o", "+0o")):
+            return int(num, 8)
+        if num.lower().startswith(("0b", "-0b", "+0b")):
+            return int(num, 2)
+        return int(num)
+    except ValueError:
+        pass
+    try:
+        return float(num)
+    except ValueError:
+        pass
+    raise TOMLDecodeError(f"unsupported TOML value: {token!r}")

@@ -1,5 +1,8 @@
 """Runtime metrics: task/node censuses for leak hunting
-(reference madsim/src/sim/runtime/metrics.rs:6-40, task/mod.rs:142-160).
+(reference madsim/src/sim/runtime/metrics.rs:6-40, task/mod.rs:142-160),
+plus the host half of the chaos-coverage report: per-fault-kind nemesis
+fire counts and named buggify fire counts (`chaos_fires`), mirroring the
+device-side counters in `BatchResult.summary`.
 """
 
 from __future__ import annotations
@@ -11,8 +14,9 @@ if TYPE_CHECKING:
 
 
 class RuntimeMetrics:
-    def __init__(self, executor: "Executor") -> None:
+    def __init__(self, executor: "Executor", handle=None) -> None:
         self._executor = executor
+        self._handle = handle
 
     def num_nodes(self) -> int:
         return len(self._executor.nodes)
@@ -37,3 +41,34 @@ class RuntimeMetrics:
     def num_tasks_of(self, node_id: int) -> int:
         node = self._executor.nodes.get(node_id)
         return len(node.info.tasks) if node else 0
+
+    # -- chaos coverage (the nemesis / buggify fire registries) --
+
+    def chaos_fires(self) -> Dict[str, int]:
+        """Per-fault-kind fire counts for this run.
+
+        Merges the NemesisDriver's schedule-event counts (crash/restart/
+        partition/...), the NetSim message-coin counts (loss/dup/reorder),
+        and named buggify points (as `buggify:<name>`). A clause or fault
+        point listed in the plan but absent here (or zero) is a DEAD
+        clause — it never exercised anything this run."""
+        out: Dict[str, int] = {}
+        handle = self._handle
+        if handle is None:
+            return out
+        driver = getattr(handle, "nemesis", None)
+        if driver is not None:
+            out.update(driver.fire_counts())
+        else:
+            try:
+                from ..net.netsim import NetSim
+
+                net = handle.simulators.get(NetSim)
+            except ImportError:
+                net = None
+            if net is not None:
+                for kind, n in net.network.config.nemesis_fires.items():
+                    out[kind] = out.get(kind, 0) + n
+        for name, n in handle.rng.buggify_fires.items():
+            out[f"buggify:{name}"] = out.get(f"buggify:{name}", 0) + n
+        return out

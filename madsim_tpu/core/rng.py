@@ -103,8 +103,13 @@ class GlobalRng:
         # the runtime so log entries are time-annotated (reference
         # rand.rs:90-103 hashes the task + time context).
         self.time_hash_fn: Optional[Callable[[], int]] = None
-        # buggify state (reference sim/buggify.rs keeps it beside the RNG)
+        # buggify state (reference sim/buggify.rs keeps it beside the RNG):
+        # the enable flag plus the two-level bookkeeping — per-run named
+        # activation cache and the per-name fire-count registry feeding
+        # the chaos-coverage report (core/buggify.py)
         self.buggify_enabled = False
+        self.buggify_active: dict = {}
+        self.buggify_fires: dict = {}
 
     # ---- record / replay (determinism check) ----
 

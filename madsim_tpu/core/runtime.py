@@ -44,6 +44,8 @@ class Handle:
         self.executor = executor
         self.config = config
         self.simulators: Dict[Type[Simulator], Simulator] = {}
+        # set by nemesis.NemesisDriver; read by RuntimeMetrics.chaos_fires
+        self.nemesis = None
 
     @staticmethod
     def current() -> "Handle":
@@ -54,7 +56,7 @@ class Handle:
         return self.rng.seed
 
     def metrics(self) -> RuntimeMetrics:
-        return RuntimeMetrics(self.executor)
+        return RuntimeMetrics(self.executor, handle=self)
 
     # -- node supervision --
 

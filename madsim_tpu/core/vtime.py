@@ -144,6 +144,14 @@ class TimeHandle:
         from ..native import AVAILABLE as _native_ok
 
         self.timer = NativeTimer() if _native_ok else Timer()
+        # nemesis per-node clock skew: node_id -> rate (1.0 = no skew),
+        # installed by NemesisDriver. RELATIVE waits made by a skewed
+        # node's tasks (sleep / add_timer_ns deadlines) stretch or shrink
+        # by the rate — the node's local clock runs fast or slow while the
+        # simulation clock stays the single global truth. Absolute-deadline
+        # timers (add_timer_at_ns — network deliveries, backoff retries)
+        # are wire/simulator time and are never skewed.
+        self.node_skew: Optional[dict] = None
 
     # ---- reads ----
 
@@ -182,8 +190,22 @@ class TimeHandle:
     def add_timer(self, delay_seconds: float, callback: Callable[[], None]) -> TimerEntry:
         return self.add_timer_ns(to_nanos(delay_seconds), callback)
 
+    def skew_delay_ns(self, delay_ns: int) -> int:
+        """Scale a relative delay by the current task's node clock skew."""
+        if not self.node_skew:
+            return delay_ns
+        from . import context
+
+        task = context.try_current_task()
+        if task is None:
+            return delay_ns
+        rate = self.node_skew.get(task.node.id)
+        if rate is None:
+            return delay_ns
+        return int(delay_ns * rate)
+
     def add_timer_ns(self, delay_ns: int, callback: Callable[[], None]) -> TimerEntry:
-        deadline = self.clock.elapsed_ns + max(0, delay_ns)
+        deadline = self.clock.elapsed_ns + self.skew_delay_ns(max(0, delay_ns))
         return self.timer.add(deadline, callback)
 
     def add_timer_at_ns(self, deadline_ns: int, callback: Callable[[], None]) -> TimerEntry:
@@ -258,7 +280,7 @@ def sleep(seconds: float):
 
         return asyncio.sleep(seconds)
     t = _current_time()
-    return Sleep(t.now_ns() + to_nanos(seconds), t)
+    return Sleep(t.now_ns() + t.skew_delay_ns(to_nanos(seconds)), t)
 
 
 def sleep_until(deadline_seconds: float) -> Sleep:

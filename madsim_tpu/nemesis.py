@@ -1,0 +1,681 @@
+"""Nemesis: declarative, seed-deterministic fault plans for BOTH backends.
+
+The paper's value proposition is one `u64` seed => one bit-exact execution
+*including injected chaos*. Before this module the chaos surface was uneven:
+the TPU engine rolled loss/latency/crash/partition from hard-coded SimConfig
+knobs, the host path had its own ad-hoc set (NetSim clog/partition plus the
+39-line buggify), and neither injected duplication, reordering windows, or
+clock skew at all. A `FaultPlan` is the single vocabulary: a composition of
+named fault clauses that compiles down to
+
+  * host-runtime drivers (`NemesisDriver`) hooking `NetSim` / `Executor`,
+  * SimConfig knobs + `[L,...]` chaos state threaded through the batched
+    TPU engine (`madsim_tpu.tpu.nemesis.compile_plan`),
+
+so the *same plan object* drives both backends and twin tests can assert
+they agree.
+
+Determinism contract — the two-level split that makes cross-backend
+agreement possible at all:
+
+  * SCHEDULE-level clauses (crash/restart, crash-with-wipe, partition,
+    asymmetric link clog, latency-spike windows, per-node clock skew) fire
+    at virtual times that are PURE functions of (seed, clause, occurrence
+    index) — never of the simulation trajectory. Both backends derive them
+    from the same murmur3 hash chain (`tpu/prng.py`; mirrored bit-exactly
+    in pure Python here), so `plan.schedule(seed, ...)` IS the event
+    stream either backend will execute. Jepsen calls this a nemesis
+    schedule; FoundationDB calls the ingredients buggify knobs.
+  * MESSAGE-level clauses (loss, duplication, bounded reordering) flip a
+    coin per message. Message streams differ across backends by design
+    (the determinism contract is per-backend, SURVEY.md §7), so these
+    match in *rate* — statistically comparable fire counts for the same
+    traffic, counted identically (the clause's own coin, not ambient
+    loss) — never event-for-event.
+
+Every clause firing is counted (`FIRE_KINDS`): per-fault-kind fire counts
+surface in `BatchResult.summary` (device) and `RuntimeMetrics.chaos_fires`
+(host), giving the suite a chaos-coverage report — a seed batch with an
+enabled clause that never fired is a dead clause, and dead clauses are how
+fuzzers silently stop finding bugs.
+
+All times are integer virtual MICROSECONDS (the TPU engine's native unit);
+the host driver converts to ns internally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+# --------------------------------------------------------------------------
+# murmur3 hash-chain mirror (tpu/prng.py, in pure Python ints)
+# --------------------------------------------------------------------------
+
+_M32 = 0xFFFFFFFF
+_GOLDEN = 0x9E3779B9
+_KEY0 = 0x2545F491
+
+
+def mix32(x: int) -> int:
+    """murmur3 fmix32 — bit-exact mirror of tpu/prng.mix."""
+    x &= _M32
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & _M32
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & _M32
+    x ^= x >> 16
+    return x
+
+
+def fold32(key: int, word: int) -> int:
+    return mix32(key ^ ((word * _GOLDEN) & _M32))
+
+
+def key_from_seed(seed: int) -> int:
+    """The engine's per-lane base key (prng.key_from on the u32 seed).
+
+    Nemesis schedules key on the LOW 32 BITS of the seed — the same
+    truncation `BatchedSim.init` applies when it casts seeds to uint32.
+    """
+    return fold32(_KEY0, seed & _M32)
+
+
+def bits32(key: int, site: int, index: int = 0) -> int:
+    """Raw u32 draw — mirror of prng.bits(key, site, index)."""
+    return mix32(fold32(fold32(key, site), index & _M32))
+
+
+def randint32(key: int, site: int, lo: int, hi: int, index: int = 0) -> int:
+    """Mirror of prng.randint: lo + bits % max(hi - lo, 1)."""
+    span = max(hi - lo, 1)
+    return lo + bits32(key, site, index) % span
+
+
+# Schedule-level probability coins use an INTEGER threshold (bits % 1e6 <
+# rate * 1e6) rather than the engine's float32 uniform: integer arithmetic
+# mirrors trivially across Python / numpy / XLA, at the cost of quantizing
+# schedule probabilities to 1e-6 — irrelevant for fault rates.
+COIN_DENOM = 1_000_000
+
+
+def coin32(key: int, site: int, rate: float, index: int = 0) -> bool:
+    return bits32(key, site, index) % COIN_DENOM < int(round(rate * COIN_DENOM))
+
+
+# --------------------------------------------------------------------------
+# draw sites (shared with tpu/engine.py — a site is a namespace, keep unique)
+# --------------------------------------------------------------------------
+
+NEM_SITE_CRASH_IV = 201      # up-interval before crash event k
+NEM_SITE_CRASH_DOWN = 202    # down duration of crash event k
+NEM_SITE_CRASH_VICTIM = 203  # victim node of crash event k
+NEM_SITE_CRASH_WIPE = 204    # wipe coin of crash event k
+NEM_SITE_PART_IV = 211       # healthy interval before split k
+NEM_SITE_PART_HEAL = 212     # partition duration of split k
+NEM_SITE_PART_SIDE = 213     # per-node side bit; index = k * 64 + node
+NEM_SITE_CLOG_IV = 221
+NEM_SITE_CLOG_HEAL = 222
+NEM_SITE_CLOG_SRC = 223
+NEM_SITE_CLOG_DST = 224      # drawn in [0, N-1), shifted past src
+NEM_SITE_SPIKE_IV = 231
+NEM_SITE_SPIKE_DUR = 232
+NEM_SITE_SKEW = 241          # per-node skew ppm; index = node
+
+# per-message coin sites on the engine's per-step net_key stream
+# (backend-local; the host uses its GlobalRng instead)
+NET_SITE_DUP = 5
+NET_SITE_REORDER = 6
+NET_SITE_REORDER_EXTRA = 7
+NET_SITE_NEM_LOSS = 8
+
+# --------------------------------------------------------------------------
+# fire-count vocabulary (engine fires tensor + host registries use indices)
+# --------------------------------------------------------------------------
+
+FIRE_KINDS: Tuple[str, ...] = (
+    "crash", "restart", "wipe", "partition", "heal", "clog", "spike",
+    "loss", "dup", "reorder", "skew",
+)
+FIRE_INDEX: Dict[str, int] = {k: i for i, k in enumerate(FIRE_KINDS)}
+
+
+# --------------------------------------------------------------------------
+# clauses
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Crash:
+    """Crash/restart cycles: a random node goes down for a random duration.
+
+    `wipe_rate` upgrades a fraction of crashes to crash-with-state-wipe:
+    the node restarts from `init` state instead of `on_restart` recovery
+    (the disk-gone bug class — what survives `power_fail` when nothing
+    does)."""
+
+    interval_lo_us: int = 1_000_000
+    interval_hi_us: int = 5_000_000
+    down_lo_us: int = 500_000
+    down_hi_us: int = 3_000_000
+    wipe_rate: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """Random bipartitions: links crossing the cut go down both ways."""
+
+    interval_lo_us: int = 1_000_000
+    interval_hi_us: int = 5_000_000
+    heal_lo_us: int = 500_000
+    heal_hi_us: int = 3_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkClog:
+    """ASYMMETRIC single-link clog: src->dst drops, dst->src still flows —
+    the half-open link class that symmetric partitions never produce."""
+
+    interval_lo_us: int = 1_000_000
+    interval_hi_us: int = 5_000_000
+    heal_lo_us: int = 500_000
+    heal_hi_us: int = 3_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencySpike:
+    """Windows during which every message pays `extra_us` additional
+    latency (congestion episodes, GC pauses on the wire)."""
+
+    interval_lo_us: int = 1_000_000
+    interval_hi_us: int = 5_000_000
+    duration_lo_us: int = 200_000
+    duration_hi_us: int = 1_000_000
+    extra_us: int = 100_000
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgLoss:
+    """Per-message loss on top of the base network loss rate."""
+
+    rate: float = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class Duplicate:
+    """Per-message duplication: the copy takes an independent latency roll
+    (and may itself be lost) — at-least-once delivery chaos."""
+
+    rate: float = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class Reorder:
+    """Bounded reordering: a fraction of messages pay an extra uniform
+    delay in [0, window_us], letting later sends overtake them while the
+    engine's conservative lookahead bound (latency only LENGTHENS) holds."""
+
+    rate: float = 0.1
+    window_us: int = 50_000
+
+
+@dataclasses.dataclass(frozen=True)
+class ClockSkew:
+    """Per-node clock rate skew: node n's relative timer delays are scaled
+    by 1 + ppm(n) * 1e-6 with ppm(n) drawn once per (seed, node) from
+    [-max_ppm, +max_ppm]. Skewed election timeouts and heartbeat periods
+    are how real clusters discover their timing assumptions."""
+
+    max_ppm: int = 50_000  # 5% — aggressive, this is a fuzzer
+
+
+Clause = Any  # one of the dataclasses above
+
+_CLAUSE_TYPES: Tuple[type, ...] = (
+    Crash, Partition, LinkClog, LatencySpike, MsgLoss, Duplicate, Reorder,
+    ClockSkew,
+)
+
+
+def _check_interval(name: str, lo: int, hi: int) -> None:
+    if lo < 0 or hi < lo:
+        raise ValueError(f"{name}: interval [{lo}, {hi}] must satisfy 0 <= lo <= hi")
+    if hi == 0:
+        raise ValueError(f"{name}: interval hi must be > 0 (clause would never fire)")
+
+
+def _check_rate(name: str, rate: float) -> None:
+    if not (0.0 <= rate < 1.0):
+        raise ValueError(f"{name} must be in [0, 1), got {rate}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A named, validated composition of fault clauses.
+
+    One clause instance per type (a plan is a configuration, not a list of
+    episodes — episodes come from the seed). Compose:
+
+        plan = FaultPlan(name="raft-storm", clauses=(
+            Crash(interval_lo_us=500_000, interval_hi_us=2_000_000),
+            Partition(),
+            Duplicate(rate=0.05),
+            Reorder(rate=0.1, window_us=50_000),
+            ClockSkew(max_ppm=20_000),
+        ))
+
+    then `plan.schedule(seed, horizon_us, n_nodes)` for the pure event
+    stream, `madsim_tpu.tpu.nemesis.compile_plan(plan, base_config)` for
+    the device face, `NemesisDriver(plan, ...)` for the host face.
+    """
+
+    clauses: Tuple[Clause, ...] = ()
+    name: str = "nemesis"
+
+    def __post_init__(self) -> None:
+        seen: set = set()
+        for c in self.clauses:
+            if not isinstance(c, _CLAUSE_TYPES):
+                raise TypeError(f"unknown fault clause: {c!r}")
+            if type(c) in seen:
+                raise ValueError(
+                    f"duplicate {type(c).__name__} clause — one instance per kind"
+                )
+            seen.add(type(c))
+        for c in self.clauses:
+            n = type(c).__name__
+            if isinstance(c, Crash):
+                _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
+                _check_interval(f"{n}.down", c.down_lo_us, c.down_hi_us)
+                _check_rate(f"{n}.wipe_rate", c.wipe_rate)
+            elif isinstance(c, (Partition, LinkClog)):
+                _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
+                _check_interval(f"{n}.heal", c.heal_lo_us, c.heal_hi_us)
+            elif isinstance(c, LatencySpike):
+                _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
+                _check_interval(f"{n}.duration", c.duration_lo_us, c.duration_hi_us)
+                if c.extra_us <= 0:
+                    raise ValueError(f"{n}.extra_us must be > 0, got {c.extra_us}")
+            elif isinstance(c, (MsgLoss, Duplicate, Reorder)):
+                _check_rate(f"{n}.rate", c.rate)
+                if isinstance(c, Reorder) and c.window_us <= 0:
+                    raise ValueError(
+                        f"{n}.window_us must be > 0, got {c.window_us}"
+                    )
+            elif isinstance(c, ClockSkew):
+                # same bound (and message shape) as the engine's
+                # nem_skew_max_ppm check: the timer rate 1 + ppm*1e-6 must
+                # stay positive, or a skewed node's relative sleeps go
+                # negative and its loops spin without advancing time
+                if not (0 < c.max_ppm < 1_000_000):
+                    raise ValueError(
+                        f"{n}.max_ppm must be in (0, 1e6) (the timer rate "
+                        f"1 + ppm*1e-6 must stay positive), got {c.max_ppm}"
+                    )
+
+    def get(self, cls: Type[Clause]) -> Optional[Clause]:
+        for c in self.clauses:
+            if isinstance(c, cls):
+                return c
+        return None
+
+    @property
+    def enabled_kinds(self) -> Tuple[str, ...]:
+        """The FIRE_KINDS this plan can produce (for coverage reporting)."""
+        kinds: List[str] = []
+        if self.get(Crash) is not None:
+            kinds += ["crash", "restart"]
+            if self.get(Crash).wipe_rate > 0:
+                kinds.append("wipe")
+        if self.get(Partition) is not None:
+            kinds += ["partition", "heal"]
+        if self.get(LinkClog) is not None:
+            kinds.append("clog")
+        if self.get(LatencySpike) is not None:
+            kinds.append("spike")
+        if self.get(MsgLoss) is not None:
+            kinds.append("loss")
+        if self.get(Duplicate) is not None:
+            kinds.append("dup")
+        if self.get(Reorder) is not None:
+            kinds.append("reorder")
+        if self.get(ClockSkew) is not None:
+            kinds.append("skew")
+        return tuple(kinds)
+
+    # -- the pure schedule (what both backends must execute) --
+
+    def schedule(
+        self, seed: int, horizon_us: int, n_nodes: int,
+        max_events: int = 100_000,
+    ) -> List["NemesisEvent"]:
+        return plan_schedule(self, seed, horizon_us, n_nodes, max_events)
+
+    def skew_ppm(self, seed: int, n_nodes: int) -> List[int]:
+        """Per-node clock-skew ppm for this (plan, seed) — [0]*N if disabled."""
+        skew = self.get(ClockSkew)
+        if skew is None:
+            return [0] * n_nodes
+        key = key_from_seed(seed)
+        return [
+            randint32(key, NEM_SITE_SKEW, -skew.max_ppm, skew.max_ppm + 1, index=n)
+            for n in range(n_nodes)
+        ]
+
+    def to_net_config(self, base=None):
+        """The host NetConfig with this plan's message-level knobs applied."""
+        from .core.config import NetConfig
+
+        net = dataclasses.replace(base) if base is not None else NetConfig()
+        loss = self.get(MsgLoss)
+        dup = self.get(Duplicate)
+        ro = self.get(Reorder)
+        if loss is not None:
+            net.packet_extra_loss_rate = loss.rate
+        if dup is not None:
+            net.packet_duplicate_rate = dup.rate
+        if ro is not None:
+            net.packet_reorder_rate = ro.rate
+            net.packet_reorder_window = ro.window_us / 1e6
+        return net
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class NemesisEvent:
+    """One schedule-level fault event. Sorted by (time, kind, node)."""
+
+    t_us: int
+    kind: str  # crash|restart|split|heal|clog|unclog|spike_on|spike_off|skew
+    node: int = -1  # crash victim / clog src / skew node
+    dst: int = -1  # clog dst
+    side_mask: int = 0  # split: bitmask of nodes on side A
+    wipe: bool = False  # crash/restart: state-wipe variant
+    ppm: int = 0  # skew
+    extra_us: int = 0  # spike_on
+
+    def __str__(self) -> str:
+        t = self.t_us / 1e6
+        if self.kind in ("crash", "restart"):
+            w = " (wipe)" if self.wipe else ""
+            return f"[{t:9.6f}s] {self.kind} node{self.node}{w}"
+        if self.kind == "split":
+            return f"[{t:9.6f}s] split side_mask={self.side_mask:#x}"
+        if self.kind in ("clog", "unclog"):
+            return f"[{t:9.6f}s] {self.kind} link {self.node}->{self.dst}"
+        if self.kind == "skew":
+            return f"[{t:9.6f}s] skew node{self.node} {self.ppm:+d} ppm"
+        if self.kind == "spike_on":
+            return f"[{t:9.6f}s] latency spike +{self.extra_us}us"
+        return f"[{t:9.6f}s] {self.kind}"
+
+
+def plan_schedule(
+    plan: FaultPlan, seed: int, horizon_us: int, n_nodes: int,
+    max_events: int = 100_000,
+) -> List[NemesisEvent]:
+    """The plan's full fault-event stream for one seed — pure function.
+
+    This is the ground truth both backends execute: the TPU engine derives
+    the same times/victims/sides in-jit from the same hash chain, and the
+    host `NemesisDriver` literally replays this list. Event times are
+    ABSOLUTE virtual us (the engine's epoch+offset arithmetic telescopes
+    to the same sums).
+    """
+    key = key_from_seed(seed)
+    events: List[NemesisEvent] = []
+
+    for n, ppm in enumerate(plan.skew_ppm(seed, n_nodes)):
+        if ppm != 0:
+            events.append(NemesisEvent(t_us=0, kind="skew", node=n, ppm=ppm))
+
+    crash = plan.get(Crash)
+    if crash is not None:
+        t, k = 0, 0
+        while len(events) < max_events:
+            t += randint32(key, NEM_SITE_CRASH_IV, crash.interval_lo_us,
+                           crash.interval_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            victim = randint32(key, NEM_SITE_CRASH_VICTIM, 0, n_nodes, index=k)
+            wipe = crash.wipe_rate > 0 and coin32(
+                key, NEM_SITE_CRASH_WIPE, crash.wipe_rate, index=k
+            )
+            events.append(NemesisEvent(t, "crash", node=victim, wipe=wipe))
+            t += randint32(key, NEM_SITE_CRASH_DOWN, crash.down_lo_us,
+                           crash.down_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(NemesisEvent(t, "restart", node=victim, wipe=wipe))
+            k += 1
+
+    part = plan.get(Partition)
+    if part is not None:
+        t, k = 0, 0
+        while len(events) < max_events:
+            t += randint32(key, NEM_SITE_PART_IV, part.interval_lo_us,
+                           part.interval_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            mask = 0
+            for n in range(n_nodes):
+                if bits32(key, NEM_SITE_PART_SIDE, index=k * 64 + n) & 1:
+                    mask |= 1 << n
+            events.append(NemesisEvent(t, "split", side_mask=mask))
+            t += randint32(key, NEM_SITE_PART_HEAL, part.heal_lo_us,
+                           part.heal_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(NemesisEvent(t, "heal", side_mask=mask))
+            k += 1
+
+    clog = plan.get(LinkClog)
+    if clog is not None:
+        t, k = 0, 0
+        while len(events) < max_events:
+            t += randint32(key, NEM_SITE_CLOG_IV, clog.interval_lo_us,
+                           clog.interval_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            src = randint32(key, NEM_SITE_CLOG_SRC, 0, n_nodes, index=k)
+            d = randint32(key, NEM_SITE_CLOG_DST, 0, n_nodes - 1, index=k)
+            dst = d + (1 if d >= src else 0)
+            events.append(NemesisEvent(t, "clog", node=src, dst=dst))
+            t += randint32(key, NEM_SITE_CLOG_HEAL, clog.heal_lo_us,
+                           clog.heal_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(NemesisEvent(t, "unclog", node=src, dst=dst))
+            k += 1
+
+    spike = plan.get(LatencySpike)
+    if spike is not None:
+        t, k = 0, 0
+        while len(events) < max_events:
+            t += randint32(key, NEM_SITE_SPIKE_IV, spike.interval_lo_us,
+                           spike.interval_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(NemesisEvent(t, "spike_on", extra_us=spike.extra_us))
+            t += randint32(key, NEM_SITE_SPIKE_DUR, spike.duration_lo_us,
+                           spike.duration_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(NemesisEvent(t, "spike_off"))
+            k += 1
+
+    events.sort()
+    return events
+
+
+# --------------------------------------------------------------------------
+# host driver
+# --------------------------------------------------------------------------
+
+
+class NemesisDriver:
+    """Replays a plan's schedule on the host runtime (the Jepsen nemesis).
+
+    Schedule-level clauses apply through `Handle` (kill/restart) and
+    `NetSim` (partition / clog_link / latency-spike windows); message-level
+    clauses are pushed into `NetConfig` so `NetSim.send` rolls them per
+    message from the global RNG. Applied events are recorded in
+    `self.applied` (the host half of a twin comparison) and counted in
+    `self.fired` per FIRE_KINDS.
+
+        rt = ms.Runtime(seed=7)
+        ...create nodes...
+        driver = nemesis.NemesisDriver(
+            plan, handle, node_ids=[n.id for n in nodes],
+            horizon_us=10_000_000,
+        )
+        driver.install()          # spawns the driver task
+        rt.block_on(workload())
+        driver.fired              # {"crash": 3, "partition": 2, ...}
+
+    `on_wipe(protocol_node_index)` runs before a wiped node's restart so
+    the workload can discard that node's durable state (the host runtime
+    keeps durability at the application level)."""
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        handle,
+        node_ids: Sequence[int],
+        horizon_us: int,
+        seed: Optional[int] = None,
+        on_wipe: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        self.plan = plan
+        self.handle = handle
+        self.node_ids = list(node_ids)
+        self.on_wipe = on_wipe
+        self.seed = handle.seed if seed is None else seed
+        self.schedule = plan.schedule(self.seed, horizon_us, len(self.node_ids))
+        self.applied: List[NemesisEvent] = []
+        self.fired: Dict[str, int] = {}
+        self._installed = False
+        # open-window tracking: NetSim's Network keeps ONE clogged_link
+        # set, so an overlapping partition heal would silently lift an
+        # active nemesis clog (and an unclog would punch a hole in an open
+        # partition). The engine keeps the two independent ([L,N,N]
+        # link_ok vs its own clog state); the driver restores the same
+        # semantics by re-asserting whichever window is still open.
+        self._open_clog: Optional[Tuple[int, int]] = None
+        self._open_split_mask: Optional[int] = None
+        # the handle exposes the driver so RuntimeMetrics can report fires
+        handle.nemesis = self
+
+    def _count(self, kind: str, n: int = 1) -> None:
+        self.fired[kind] = self.fired.get(kind, 0) + n
+
+    def _netsim(self):
+        from .net.netsim import NetSim
+
+        return self.handle.simulators.get(NetSim)
+
+    def install(self) -> None:
+        """Apply message-level knobs + clock skew, spawn the schedule task."""
+        if self._installed:
+            raise RuntimeError("NemesisDriver.install() called twice")
+        self._installed = True
+        net = self._netsim()
+        if net is not None and (
+            self.plan.get(MsgLoss) or self.plan.get(Duplicate)
+            or self.plan.get(Reorder)
+        ):
+            net.update_config(self.plan.to_net_config(net.network.config))
+        skew = self.plan.skew_ppm(self.seed, len(self.node_ids))
+        if any(skew):
+            self.handle.time.node_skew = {
+                nid: 1.0 + ppm * 1e-6
+                for nid, ppm in zip(self.node_ids, skew)
+                if ppm != 0
+            }
+            self._count("skew", sum(1 for p in skew if p != 0))
+        from .core.task import Spawner  # noqa: F401  (doc pointer)
+        from . import spawn
+
+        spawn(self._run(), name=f"nemesis:{self.plan.name}")
+
+    async def _run(self) -> None:
+        from .core.vtime import Sleep
+
+        time = self.handle.time
+        for ev in self.schedule:
+            if ev.kind == "skew":
+                continue  # applied at install time
+            deadline_ns = ev.t_us * 1_000
+            if deadline_ns > time.now_ns():
+                await Sleep(deadline_ns, time)
+            self._apply(ev)
+
+    def _apply(self, ev: NemesisEvent) -> None:
+        net = self._netsim()
+        if ev.kind == "crash":
+            self.handle.kill(self.node_ids[ev.node])
+            self._count("crash")
+            if ev.wipe:
+                self._count("wipe")
+        elif ev.kind == "restart":
+            if ev.wipe and self.on_wipe is not None:
+                self.on_wipe(ev.node)
+            self.handle.restart(self.node_ids[ev.node])
+            self._count("restart")
+        elif ev.kind == "split":
+            a, b = self._sides(ev.side_mask)
+            self._open_split_mask = ev.side_mask
+            if net is not None:
+                net.partition(a, b)
+            self._count("partition")
+        elif ev.kind == "heal":
+            a, b = self._sides(ev.side_mask)
+            self._open_split_mask = None
+            if net is not None:
+                net.heal_partition(a, b)
+                if self._open_clog is not None:
+                    # heal_partition unclogs every cross-group pair; an
+                    # active clog window must survive it (idempotent re-add)
+                    net.clog_link(*self._open_clog)
+            self._count("heal")
+        elif ev.kind == "clog":
+            self._open_clog = (self.node_ids[ev.node], self.node_ids[ev.dst])
+            if net is not None:
+                net.clog_link(*self._open_clog)
+            self._count("clog")
+        elif ev.kind == "unclog":
+            pair = (self.node_ids[ev.node], self.node_ids[ev.dst])
+            self._open_clog = None
+            if net is not None and not self._crosses_open_split(ev.node, ev.dst):
+                # if the pair crosses an open partition, the clogged_link
+                # entry is doing the partition's work too — leave it for
+                # the heal to remove
+                net.unclog_link(*pair)
+        elif ev.kind == "spike_on":
+            if net is not None:
+                net.network.config.spike_extra_latency = ev.extra_us / 1e6
+            self._count("spike")
+        elif ev.kind == "spike_off":
+            if net is not None:
+                net.network.config.spike_extra_latency = 0.0
+        self.applied.append(ev)
+
+    def _crosses_open_split(self, a_idx: int, b_idx: int) -> bool:
+        mask = self._open_split_mask
+        if mask is None:
+            return False
+        return bool(mask >> a_idx & 1) != bool(mask >> b_idx & 1)
+
+    def _sides(self, mask: int) -> Tuple[List[int], List[int]]:
+        a = [nid for i, nid in enumerate(self.node_ids) if mask >> i & 1]
+        b = [nid for i, nid in enumerate(self.node_ids) if not mask >> i & 1]
+        return a, b
+
+    def fire_counts(self) -> Dict[str, int]:
+        """Host-side chaos fire counts: schedule events + NetSim message
+        coins (loss/dup/reorder ride the network config's counters)."""
+        out = dict(self.fired)
+        net = self._netsim()
+        if net is not None:
+            for kind, n in net.network.config.nemesis_fires.items():
+                out[kind] = out.get(kind, 0) + n
+        return out

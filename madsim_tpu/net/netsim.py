@@ -233,25 +233,67 @@ class NetSim(Simulator):
         protocol: str,
         msg: Payload,
     ) -> None:
-        """Datagram send: silently dropped on clog/loss (net/mod.rs:298-333)."""
+        """Datagram send: silently dropped on clog/loss (net/mod.rs:298-333).
+
+        Nemesis message-level clauses (FaultPlan → NetConfig knobs):
+        duplication re-delivers the datagram once more with an independent
+        latency roll, and bounded reordering adds a uniform extra delay in
+        [0, reorder_window] so later sends can overtake. Both apply to
+        datagrams only — `connect1` channels are reliable ORDERED, the TCP
+        face — mirroring the TPU engine's per-candidate dup/reorder rolls.
+        """
         await self.rand_delay()
         hook = self._hooks_req.get(node)
         if hook is not None and not hook(msg):
             return
         dst = self._ipvs_rewrite(dst, protocol)
+        cfg = self.network.config
+        # the dup coin flips BEFORE the original's loss roll (mirroring the
+        # engine, which coins every candidate): the copy's fate — its own
+        # loss roll, its own latency — is independent of the original's
+        dup = cfg.packet_duplicate_rate > 0.0 and self.rng.gen_bool(
+            cfg.packet_duplicate_rate
+        )
+        if dup:
+            cfg.count_fire("dup")
         result = self.network.try_send(node, dst, protocol)
-        if result is None:
-            return
-        src_ip, dst_node, socket, latency_ns = result
-        rsp_hook = self._hooks_rsp.get(dst_node)
-        src = (src_ip, port)
+        if result is None and not dup:
+            return  # dropped, and no copy can survive it
+        dst_node = (
+            result[1]
+            if result is not None
+            else self.network.resolve_dest_node(node, dst, protocol)
+        )
+        rsp_hook = self._hooks_rsp.get(dst_node) if dst_node is not None else None
 
-        def deliver() -> None:
+        def deliver_from(src_ip: str, socket) -> None:
+            src = (src_ip, port)
             if rsp_hook is not None and not rsp_hook(msg):
                 return
             socket.deliver(src, dst, msg)
 
-        self.time.add_timer_ns(latency_ns, deliver)
+        def schedule(latency_ns: int, src_ip: str, socket) -> None:
+            if cfg.packet_reorder_rate > 0.0 and cfg.packet_reorder_window > 0.0:
+                if self.rng.gen_bool(cfg.packet_reorder_rate):
+                    cfg.count_fire("reorder")
+                    latency_ns += self.rng.randrange(
+                        0, max(round(cfg.packet_reorder_window * 1e9), 1)
+                    )
+            # absolute-deadline timers: network latency is wire time, never
+            # subject to the sender's nemesis clock skew (vtime.sleep-side)
+            self.time.add_timer_at_ns(
+                self.time.now_ns() + latency_ns,
+                lambda: deliver_from(src_ip, socket),
+            )
+
+        if result is not None:
+            src_ip, _, socket, latency_ns = result
+            schedule(latency_ns, src_ip, socket)
+        if dup:
+            copy = self.network.try_send(node, dst, protocol)
+            if copy is not None:
+                src_ip2, _, socket2, latency2 = copy
+                schedule(latency2, src_ip2, socket2)
 
     async def connect1(
         self,

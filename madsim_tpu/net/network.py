@@ -175,17 +175,30 @@ class Network:
     # -- the rolls --
 
     def test_link(self, src: NodeId, dst: NodeId) -> Optional[int]:
-        """Latency in ns, or None on clog/loss (reference network.rs:261-269)."""
+        """Latency in ns, or None on clog/loss (reference network.rs:261-269).
+
+        Nemesis message-level clauses ride here too: the extra loss coin
+        (FaultPlan MsgLoss, counted per fire) and the latency-spike window
+        (additive extra latency while a NemesisDriver holds a spike open).
+        """
         if self.link_clogged(src, dst):
             return None
         if self.config.packet_loss_rate > 0.0 and self.rng.gen_bool(
             self.config.packet_loss_rate
         ):
             return None
+        if self.config.packet_extra_loss_rate > 0.0 and self.rng.gen_bool(
+            self.config.packet_extra_loss_rate
+        ):
+            self.config.count_fire("loss")
+            return None
         self.stat.msg_count += 1
         lo = round(self.config.send_latency_min * 1e9)
         hi = round(self.config.send_latency_max * 1e9)
-        return self.rng.randrange(lo, max(hi, lo + 1))
+        latency = self.rng.randrange(lo, max(hi, lo + 1))
+        if self.config.spike_extra_latency > 0.0:
+            latency += round(self.config.spike_extra_latency * 1e9)
+        return latency
 
     def resolve_dest_node(
         self, node: NodeId, dst: SocketAddr, protocol: Protocol_

@@ -17,7 +17,10 @@ from __future__ import annotations
 import base64
 import dataclasses
 import enum
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: vendored reader
+    from ... import _toml as tomllib
 from typing import Dict, List, Optional, Tuple, Union
 
 from ...core import context

@@ -29,6 +29,12 @@ from .spec import (  # noqa: F401
     empty_outbox,
     replace_handlers,
 )
+from .nemesis import (  # noqa: F401
+    assert_device_matches_schedule,
+    compile_plan,
+    coverage_report,
+    device_chaos_events,
+)
 from .chain import ChainState, chain_workload, make_chain_spec  # noqa: F401
 from .paxos import PaxosState, make_paxos_spec, paxos_workload  # noqa: F401
 from .twopc import TpcState, make_twopc_spec, twopc_workload  # noqa: F401

@@ -120,6 +120,20 @@ class BatchResult:
         return int(self.violated.sum())
 
     @property
+    def chaos_fires(self) -> Dict[str, int]:
+        """Per-fault-kind fire counts over the whole batch (the device
+        half of the chaos-coverage report; see madsim_tpu/nemesis.py)."""
+        return {
+            k[len("fires_"):]: v
+            for k, v in self.summary.items()
+            if k.startswith("fires_")
+        }
+
+    def chaos_report(self) -> str:
+        """The rendered chaos-coverage line ('' when no chaos enabled)."""
+        return self.summary.get("chaos_coverage", "")
+
+    @property
     def violating_seeds(self) -> List[int]:
         return [int(s) for s in self.seeds[self.violated]]
 
@@ -241,6 +255,12 @@ def run_batch(
     # those against the global seeds array mislabels lanes on chunked runs)
     totals["violation_lanes"] = np.nonzero(violated)[0].tolist()[:32]
     totals["n_devices"] = n_dev
+    # chaos-coverage report: every enabled fault clause should fire
+    # somewhere in a batch this size; a zero is a dead clause
+    from .nemesis import coverage_report, enabled_fire_kinds
+
+    if enabled_fire_kinds(sim.config):
+        totals["chaos_coverage"] = coverage_report(totals, sim.config)
     result = BatchResult(
         seeds=seeds_arr,
         violated=violated,
@@ -315,7 +335,10 @@ def batch_test(
                     float(env["MADSIM_TEST_TIME_LIMIT"]) * 1e6
                 )
             if "MADSIM_TEST_CONFIG" in env:
-                import tomllib
+                try:
+                    import tomllib
+                except ImportError:  # Python < 3.11: vendored reader
+                    from .. import _toml as tomllib
 
                 with open(env["MADSIM_TEST_CONFIG"], "rb") as f:
                     doc = tomllib.load(f)

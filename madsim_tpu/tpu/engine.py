@@ -72,6 +72,29 @@ import jax.numpy as jnp
 
 from . import prng
 from .spec import INF_GUARD, INF_US, Outbox, ProtocolSpec, REBASE_US, SimConfig
+from ..nemesis import (
+    COIN_DENOM,
+    FIRE_INDEX,
+    FIRE_KINDS,
+    NEM_SITE_CLOG_DST,
+    NEM_SITE_CLOG_HEAL,
+    NEM_SITE_CLOG_IV,
+    NEM_SITE_CLOG_SRC,
+    NEM_SITE_CRASH_DOWN,
+    NEM_SITE_CRASH_IV,
+    NEM_SITE_CRASH_VICTIM,
+    NEM_SITE_CRASH_WIPE,
+    NEM_SITE_PART_HEAL,
+    NEM_SITE_PART_IV,
+    NEM_SITE_PART_SIDE,
+    NEM_SITE_SKEW,
+    NEM_SITE_SPIKE_DUR,
+    NEM_SITE_SPIKE_IV,
+    NET_SITE_DUP,
+    NET_SITE_NEM_LOSS,
+    NET_SITE_REORDER,
+    NET_SITE_REORDER_EXTRA,
+)
 
 
 class MsgPool(NamedTuple):
@@ -108,6 +131,36 @@ class StragPool(NamedTuple):
     payload: Any  # i32 [L,B,P]
 
 
+class NemesisState(NamedTuple):
+    """Per-lane nemesis bookkeeping (present iff a schedule-level clause
+    is enabled; see SimConfig `nem_*` knobs and madsim_tpu/nemesis.py).
+
+    The occurrence counters (`*_k`) are the whole trick: every nemesis
+    draw — event time delta, crash victim, partition side, clog pair —
+    is indexed by (lane base key, clause site, k), a pure function of the
+    SEED, never of the trajectory clock. That is what makes the fault
+    schedule identical on the host twin and replayable as
+    `FaultPlan.schedule(seed, ...)` without running the engine at all.
+    The crash clause shares `SimState.chaos_at`/`crashed` and the
+    partition clause shares `part_at`/`partitioned`/`link_ok` with the
+    legacy trajectory-coupled knobs (one machinery, two time sources);
+    clog and spike windows carry their own next-toggle offsets here.
+    """
+
+    crash_k: Any  # i32 [L] crash/restart cycle counter
+    wipe: Any  # bool [L] current down node restarts with wiped state
+    part_k: Any  # i32 [L] split/heal cycle counter
+    clog_at: Any  # i32 [L] next clog toggle (offset us; INF_US disabled)
+    clogged: Any  # bool [L] a directed link is currently clogged
+    clog_src: Any  # i32 [L]
+    clog_dst: Any  # i32 [L]
+    clog_k: Any  # i32 [L]
+    spike_at: Any  # i32 [L] next latency-spike toggle
+    spiking: Any  # bool [L]
+    spike_k: Any  # i32 [L]
+    skew: Any  # f32 [L,N] per-node timer rate (1.0 = none) | None
+
+
 class TraceRecord(NamedTuple):
     """One step's observable events, for per-lane violation traces.
 
@@ -135,12 +188,19 @@ class TraceRecord(NamedTuple):
     side_mask: Any  # i32 [L] bitmask of nodes on side A after a split
     violation: Any  # bool [L] invariant first violated this step
     deadlock: Any  # bool [L]
+    clog_src: Any  # i32 [L] link clogged src this step, -1 = none
+    clog_dst: Any  # i32 [L]
+    unclog: Any  # bool [L] link unclogged this step
+    spike_on: Any  # bool [L] latency spike opened this step
+    spike_off: Any  # bool [L]
 
 
 class SimState(NamedTuple):
     clock: Any  # i32 [L] (offset us; see epoch)
     epoch: Any  # i32 [L] rebase count (abs = epoch * REBASE_US + clock)
     key: Any  # u32 [L] (hash-chain, prng.py)
+    key0: Any  # u32 [L] the lane's BASE key (constant; nemesis draws
+    #           index off it so fault schedules are trajectory-free)
     done: Any  # bool [L]
     violated: Any  # bool [L]
     violation_at: Any  # i32 [L] (offset; INF_US = none)
@@ -149,6 +209,10 @@ class SimState(NamedTuple):
     steps: Any  # i32 [L]
     events: Any  # i32 [L]
     overflow: Any  # i32 [L] (messages dropped: pool full)
+    dead_drops: Any  # i32 [L] (messages dropped: destination node down —
+    #            distinct from `overflow` so graceful-degradation
+    #            assertions can tell pool pressure from crash fallout)
+    fires: Any  # i32 [L, len(FIRE_KINDS)] per-fault-kind chaos fire counts
     alive: Any  # bool [L,N]
     crashed: Any  # i32 [L] (node id currently down, -1 = none)
     chaos_at: Any  # i32 [L] (next crash/restart event)
@@ -159,6 +223,7 @@ class SimState(NamedTuple):
     node: Any  # protocol pytree, leaves [L,N,...]
     msgs: MsgPool
     strag: Any  # StragPool | None (None unless buggify_delay_rate > 0)
+    nem: Any  # NemesisState | None (None unless a nemesis clause is on)
 
 
 def _first_free(free: jnp.ndarray, K: int) -> jnp.ndarray:
@@ -226,6 +291,66 @@ class BatchedSim:
                 "the two-handler path places per-candidate rings; use "
                 "msg_depth_msg/msg_depth_timer there"
             )
+        # nemesis knobs: validate here with the same messages as the host
+        # config layer, and reject legacy+nemesis combos for the same
+        # machinery (the two time sources would fight over chaos_at)
+        for name in (
+            "nem_loss_rate", "nem_dup_rate", "nem_reorder_rate",
+            "nem_crash_wipe_rate",
+        ):
+            v = getattr(cfg, name)
+            if not (0.0 <= v < 1.0):
+                raise ValueError(f"{name} must be in [0, 1), got {v}")
+        if cfg.nem_crash_enabled and cfg.chaos_enabled:
+            raise ValueError(
+                "nem_crash_* and crash_interval_* cannot both be enabled — "
+                "one crash machinery, one time source (use the FaultPlan)"
+            )
+        if cfg.nem_partition_enabled and cfg.partition_enabled:
+            raise ValueError(
+                "nem_partition_* and partition_interval_* cannot both be "
+                "enabled — one partition machinery, one time source"
+            )
+        for prefix, pairs in (
+            ("nem_crash", (("interval", True), ("down", False))),
+            ("nem_partition", (("interval", True), ("heal", False))),
+            ("nem_clog", (("interval", True), ("heal", False))),
+            ("nem_spike", (("interval", True), ("duration", False))),
+        ):
+            if getattr(cfg, f"{prefix}_interval_hi_us") <= 0:
+                continue  # clause disabled
+            for part, _is_iv in pairs:
+                lo = getattr(cfg, f"{prefix}_{part}_lo_us")
+                hi = getattr(cfg, f"{prefix}_{part}_hi_us")
+                if lo < 0 or hi < lo or hi <= 0:
+                    raise ValueError(
+                        f"{prefix}_{part} range [{lo}, {hi}] must satisfy "
+                        "0 <= lo <= hi and hi > 0"
+                    )
+        if cfg.nem_reorder_rate > 0 and cfg.nem_reorder_window_us <= 0:
+            raise ValueError(
+                "nem_reorder_rate needs nem_reorder_window_us > 0, got "
+                f"{cfg.nem_reorder_window_us}"
+            )
+        if cfg.nem_spike_enabled and cfg.nem_spike_extra_us <= 0:
+            raise ValueError(
+                f"nem_spike_extra_us must be > 0, got {cfg.nem_spike_extra_us}"
+            )
+        if not (0 <= cfg.nem_skew_max_ppm < 1_000_000):
+            raise ValueError(
+                "nem_skew_max_ppm must be in [0, 1e6) (the timer rate "
+                f"1 + ppm*1e-6 must stay positive), got {cfg.nem_skew_max_ppm}"
+            )
+        # all latency lengtheners must keep deliver offsets far below the
+        # sentinel guard (rebase arithmetic headroom)
+        if (
+            cfg.latency_hi_us + cfg.nem_spike_extra_us
+            + cfg.nem_reorder_window_us
+        ) >= int(INF_GUARD) // 4:
+            raise ValueError(
+                "latency_hi + nem_spike_extra + nem_reorder_window must stay "
+                f"below {int(INF_GUARD) // 4} us"
+            )
         if spec.on_event is not None and cfg.msg_depth_timer is not None and (
             cfg.msg_depth_timer != cfg.msg_depth_msg
         ):
@@ -257,6 +382,19 @@ class BatchedSim:
                     _np.arange(N * spec.max_out) // spec.max_out,
                 ]
             )
+        # nemesis duplication doubles the candidate axis: position 2c is
+        # the original send, 2c+1 its (coin-gated) duplicate with an
+        # independent latency/loss roll. Interleaving (repeat, not tile)
+        # keeps each node's candidate block contiguous, so the fused pack's
+        # [L, N, E] reshape and the two-handler segment split both survive
+        # unchanged with E and the segment bounds doubled. Pool sizing
+        # scales with the doubled axis — paid only when the clause is on.
+        self._dup = cfg.nem_dup_rate > 0
+        self._Cb = self._C  # base (pre-duplication) candidate count
+        if self._dup:
+            self._C *= 2
+            self._src_of_c = _np.repeat(self._src_of_c, 2)
+        _mult = 2 if self._dup else 1
         # Main pool: candidate position c owns K consecutive ring slots;
         # msg_capacity is the TOTAL ring-slot budget per lane (C * K ~
         # msg_capacity, the r3 semantics — per-destination state is just
@@ -275,7 +413,8 @@ class BatchedSim:
             # storms that per-row rings drop, at 2 extra slots instead of
             # a whole extra depth level (+E slots).
             self._Kt = self._Km
-            self._SK = spec.max_out * self._Km + cfg.msg_spare_slots
+            self._E_pack = spec.max_out * _mult  # candidate rows per node
+            self._SK = self._E_pack * self._Km + cfg.msg_spare_slots
             self._CK = N * self._SK
             self._src_of_slot = jnp.asarray(
                 _np.repeat(_np.arange(N), self._SK), jnp.int32
@@ -283,8 +422,8 @@ class BatchedSim:
             self._segs = None
         else:
             self._Kt = cfg.msg_depth_timer or uniform
-            self._Cm = N * spec.max_out_msg
-            self._Ct = N * spec.max_out
+            self._Cm = N * spec.max_out_msg * _mult
+            self._Ct = N * spec.max_out * _mult
             self._Sm = self._Cm * self._Km  # slots of the msg-position segment
             self._CK = self._Sm + self._Ct * self._Kt
             self._src_of_slot = jnp.asarray(
@@ -315,6 +454,13 @@ class BatchedSim:
         else:
             self._K4 = 0
             self._B = 0
+        # nemesis per-lane bookkeeping exists iff a schedule-level clause
+        # (or skew) is on; message-level coins (loss/dup/reorder) need none
+        self._nem_state = (
+            cfg.nem_crash_enabled or cfg.nem_partition_enabled
+            or cfg.nem_clog_enabled or cfg.nem_spike_enabled
+            or cfg.nem_skew_enabled
+        )
         # scalar-style handlers -> [L,N] batched. `now` is per-(lane,node):
         # under the lookahead window, nodes in one step process events at
         # different virtual times.
@@ -355,19 +501,81 @@ class BatchedSim:
         key = prng.key_from(seeds)  # u32 [L]
         node_keys = prng.fold(key[:, None], jnp.arange(N, dtype=jnp.uint32))
         node_state, timer = self._v_init(node_keys, jnp.arange(N, dtype=jnp.int32))
+        timer = jnp.asarray(timer, jnp.int32)
 
-        if cfg.chaos_enabled:
+        # per-node clock skew (nemesis): timer rate drawn once per
+        # (seed, node) — the same formula FaultPlan.skew_ppm mirrors
+        fires = jnp.zeros((L, len(FIRE_KINDS)), jnp.int32)
+        skew = None
+        if cfg.nem_skew_enabled:
+            ppm = prng.randint(
+                key[:, None], NEM_SITE_SKEW, -cfg.nem_skew_max_ppm,
+                cfg.nem_skew_max_ppm + 1,
+                index=jnp.arange(N, dtype=jnp.uint32)[None, :],
+            )  # [L,N]
+            skew = jnp.float32(1.0) + ppm.astype(jnp.float32) * jnp.float32(1e-6)
+            fires = fires.at[:, FIRE_INDEX["skew"]].set(
+                (ppm != 0).sum(axis=1, dtype=jnp.int32)
+            )
+            # initial timers are armed at local t=0: scale the delay
+            sk_ok = (timer >= 0) & (timer < INF_GUARD)
+            timer = jnp.where(
+                sk_ok, (timer.astype(jnp.float32) * skew).astype(jnp.int32),
+                timer,
+            )
+
+        if cfg.nem_crash_enabled:
+            # occurrence-indexed: the first crash interval is draw k=0 of
+            # the pure schedule (key here IS the lane base key)
+            chaos_at = prng.randint(
+                key, NEM_SITE_CRASH_IV, cfg.nem_crash_interval_lo_us,
+                cfg.nem_crash_interval_hi_us, index=0,
+            )
+        elif cfg.chaos_enabled:
             chaos_at = prng.randint(
                 key, 11, cfg.crash_interval_lo_us, cfg.crash_interval_hi_us
             )
         else:
             chaos_at = jnp.full((L,), INF_US, jnp.int32)
-        if cfg.partition_enabled:
+        if cfg.nem_partition_enabled:
+            part_at = prng.randint(
+                key, NEM_SITE_PART_IV, cfg.nem_partition_interval_lo_us,
+                cfg.nem_partition_interval_hi_us, index=0,
+            )
+        elif cfg.partition_enabled:
             part_at = prng.randint(
                 key, 12, cfg.partition_interval_lo_us, cfg.partition_interval_hi_us
             )
         else:
             part_at = jnp.full((L,), INF_US, jnp.int32)
+
+        if self._nem_state:
+            zi = jnp.zeros((L,), jnp.int32)
+            zb = jnp.zeros((L,), jnp.bool_)
+            nem = NemesisState(
+                crash_k=zi, wipe=zb, part_k=zi,
+                clog_at=(
+                    prng.randint(
+                        key, NEM_SITE_CLOG_IV, cfg.nem_clog_interval_lo_us,
+                        cfg.nem_clog_interval_hi_us, index=0,
+                    )
+                    if cfg.nem_clog_enabled
+                    else jnp.full((L,), INF_US, jnp.int32)
+                ),
+                clogged=zb, clog_src=zi, clog_dst=zi, clog_k=zi,
+                spike_at=(
+                    prng.randint(
+                        key, NEM_SITE_SPIKE_IV, cfg.nem_spike_interval_lo_us,
+                        cfg.nem_spike_interval_hi_us, index=0,
+                    )
+                    if cfg.nem_spike_enabled
+                    else jnp.full((L,), INF_US, jnp.int32)
+                ),
+                spiking=zb, spike_k=zi,
+                skew=skew,
+            )
+        else:
+            nem = None
 
         if self._B:
             strag = StragPool(
@@ -384,6 +592,7 @@ class BatchedSim:
             clock=jnp.zeros((L,), jnp.int32),
             epoch=jnp.zeros((L,), jnp.int32),
             key=key,
+            key0=key,
             done=jnp.zeros((L,), jnp.bool_),
             violated=jnp.zeros((L,), jnp.bool_),
             violation_at=jnp.full((L,), INF_US, jnp.int32),
@@ -392,13 +601,15 @@ class BatchedSim:
             steps=jnp.zeros((L,), jnp.int32),
             events=jnp.zeros((L,), jnp.int32),
             overflow=jnp.zeros((L,), jnp.int32),
+            dead_drops=jnp.zeros((L,), jnp.int32),
+            fires=fires,
             alive=jnp.ones((L, N), jnp.bool_),
             crashed=jnp.full((L,), -1, jnp.int32),
             chaos_at=chaos_at,
             link_ok=jnp.ones((L, N, N), jnp.bool_),
             partitioned=jnp.zeros((L,), jnp.bool_),
             part_at=part_at,
-            timer=jnp.asarray(timer, jnp.int32),
+            timer=timer,
             node=node_state,
             msgs=MsgPool(
                 valid=jnp.zeros((L, N, CK), jnp.bool_),
@@ -407,6 +618,7 @@ class BatchedSim:
                 payload=jnp.zeros((L, CK, spec.payload_width), jnp.int32),
             ),
             strag=strag,
+            nem=nem,
         )
 
     # ------------------------------------------------------------------ step
@@ -445,6 +657,13 @@ class BatchedSim:
                         state.chaos_at),
             state.part_at,
         )
+        # nemesis clog/spike toggles are events too: lanes must advance to
+        # them even when the protocol is quiet (chaos_at/part_at already
+        # carry the crash and partition clauses, legacy or nemesis)
+        if cfg.nem_clog_enabled:
+            t_next = jnp.minimum(t_next, state.nem.clog_at)
+        if cfg.nem_spike_enabled:
+            t_next = jnp.minimum(t_next, state.nem.spike_at)
 
         deadlocked = (~state.done) & (t_next >= INF_US)
         active = (~state.done) & (t_next < INF_US)
@@ -461,8 +680,16 @@ class BatchedSim:
         # LENGTHENS latencies, so latency_lo remains the lookahead bound.
         lo_w = max(0, cfg.latency_lo_us - 1) if cfg.lookahead else 0
         w_end = jnp.minimum(t_next, INF_US - lo_w - 1) + lo_w
-        if lo_w and (cfg.chaos_enabled or cfg.partition_enabled):
-            chaos_in_w = jnp.minimum(state.chaos_at, state.part_at) <= w_end
+        if lo_w and (
+            cfg.any_crash_enabled or cfg.any_partition_enabled
+            or cfg.nem_clog_enabled or cfg.nem_spike_enabled
+        ):
+            next_chaos = jnp.minimum(state.chaos_at, state.part_at)
+            if cfg.nem_clog_enabled:
+                next_chaos = jnp.minimum(next_chaos, state.nem.clog_at)
+            if cfg.nem_spike_enabled:
+                next_chaos = jnp.minimum(next_chaos, state.nem.spike_at)
+            chaos_in_w = next_chaos <= w_end
             w_end = jnp.where(chaos_in_w, t_next, w_end)
 
         # -- 2. advance per-lane keys (cheap hash chain, see prng.py) ------
@@ -560,25 +787,53 @@ class BatchedSim:
         # queues and timers are masked out of the event pick), so its event
         # masks are false. One tree pass merges all three outcomes instead
         # of three full-state passes.
-        if cfg.chaos_enabled:
+        any_crash = cfg.any_crash_enabled
+        if any_crash:
             chaos_due = active & (state.chaos_at <= t_next)
             is_restart_evt = state.crashed >= 0
             do_crash = chaos_due & ~is_restart_evt
             do_restart = chaos_due & is_restart_evt
-            victim = prng.randint(ckey, 1, 0, N)
+            if cfg.nem_crash_enabled:
+                # nemesis: victim is draw k of the pure schedule — a
+                # function of the SEED, not of when the crash fires
+                victim = prng.randint(
+                    state.key0, NEM_SITE_CRASH_VICTIM, 0, N,
+                    index=state.nem.crash_k,
+                )
+            else:
+                victim = prng.randint(ckey, 1, 0, N)
             crash_mask = do_crash[:, None] & (node_ids == victim[:, None])
             restart_node = jnp.clip(state.crashed, 0, N - 1)
             restart_mask = do_restart[:, None] & (node_ids == restart_node[:, None])
         else:
             restart_mask = None
 
-        if cfg.chaos_enabled:
+        if any_crash:
             # `now` for a restarting node is the chaos instant t_next (the
             # window collapses to it on chaos steps), never an earlier
             # clock — a restart timer must not be armed in the past
             ns_r, timer_r = self._v_on_restart(
                 state.node, node_ids, t_next, rkeys
             )
+            if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
+                # crash-with-state-wipe: the marked node restarts from
+                # `init` (durable state gone too), its declared absolute
+                # time fields and first timer shifted to the restart
+                # instant. The wipe flag was drawn at crash time and rides
+                # state.nem.wipe through the down window.
+                ns_w, timer_w = self._v_init(rkeys, narange)
+                timer_w = jnp.asarray(timer_w, jnp.int32)
+                w_ok = (timer_w >= 0) & (timer_w < INF_GUARD)
+                timer_w = jnp.where(w_ok, timer_w + t_next[:, None], timer_w)
+                if spec.time_fields:
+                    ns_w = ns_w._replace(**{
+                        f: getattr(ns_w, f)
+                        + t_next.reshape((L,) + (1,) * (getattr(ns_w, f).ndim - 1))
+                        for f in spec.time_fields
+                    })
+                wipe_mask = restart_mask & state.nem.wipe[:, None]
+                ns_r = _tree_where(wipe_mask, ns_w, ns_r)
+                timer_r = jnp.where(wipe_mask, timer_w, timer_r)
 
         if self._fused:
             # ONE handler invocation per node per step: kind == -1 encodes
@@ -599,7 +854,7 @@ class BatchedSim:
                     out = jnp.where(rk, r, out)
                 return out
 
-            if cfg.chaos_enabled:
+            if any_crash:
                 node = jax.tree_util.tree_map(merge, state.node, ns_e, ns_r)
             else:
                 node = jax.tree_util.tree_map(
@@ -623,7 +878,7 @@ class BatchedSim:
                     out = jnp.where(rk, r, out)
                 return out
 
-            if cfg.chaos_enabled:
+            if any_crash:
                 node = jax.tree_util.tree_map(
                     merge, state.node, ns_m, ns_t, ns_r
                 )
@@ -634,11 +889,36 @@ class BatchedSim:
                 )
         # message handlers return a negative timer to keep the current
         # deadline; timer handlers return a negative value to disarm
+        if cfg.nem_skew_enabled:
+            # per-node clock skew: a handler's ABSOLUTE deadline encodes a
+            # relative delay from its own event time — stretch/shrink that
+            # delay by the node's rate (sentinels and keep/disarm negatives
+            # pass through untouched). f32 is exact for the delay
+            # magnitudes that matter and bit-stable per backend.
+            skewrate = state.nem.skew  # f32 [L,N]
+
+            def skew_deadline(deadline, now):
+                d = deadline - now
+                stretched = now + (d.astype(jnp.float32) * skewrate).astype(
+                    jnp.int32
+                )
+                ok = (deadline >= 0) & (deadline < INF_GUARD) & (d > 0)
+                return jnp.where(ok, stretched, deadline)
+
+            if self._fused:
+                timer_m = timer_t = skew_deadline(timer_e, t_evt)
+            else:
+                timer_m = skew_deadline(timer_m, t_evt)
+                timer_t = skew_deadline(timer_t, t_evt)
+            if any_crash:
+                timer_r = skew_deadline(
+                    timer_r, jnp.broadcast_to(t_next[:, None], (L, N))
+                )
         timer = jnp.where(has_msg & (timer_m >= 0), timer_m, state.timer)
         timer = jnp.where(
             due_t, jnp.where(timer_t >= 0, timer_t, INF_US), timer
         )
-        if cfg.chaos_enabled:
+        if any_crash:
             timer = jnp.where(restart_mask, timer_r, timer)
         # consume the delivered slot (reusing the extraction one-hots)
         consumed_main = has_msg & ~strag_win  # [L,N]
@@ -659,24 +939,55 @@ class BatchedSim:
         crashed, chaos_at = state.crashed, state.chaos_at
         tr_crash = jnp.full((L,), -1, jnp.int32)
         tr_restart = jnp.full((L,), -1, jnp.int32)
-        if cfg.chaos_enabled:
+        nem_crash_k, nem_wipe = None, None
+        if any_crash:
             alive = (alive & ~crash_mask) | restart_mask
-            restart_delay = prng.randint(
-                ckey, 2, cfg.restart_delay_lo_us, cfg.restart_delay_hi_us
-            )
-            next_crash = prng.randint(
-                ckey, 3, cfg.crash_interval_lo_us, cfg.crash_interval_hi_us
-            )
+            if cfg.nem_crash_enabled:
+                # schedule arithmetic: next toggle = PREVIOUS toggle time
+                # plus an occurrence-indexed delta — never `clock + delta`,
+                # which would couple the schedule to the trajectory
+                ck_n = state.nem.crash_k
+                restart_delay = prng.randint(
+                    state.key0, NEM_SITE_CRASH_DOWN, cfg.nem_crash_down_lo_us,
+                    cfg.nem_crash_down_hi_us, index=ck_n,
+                )
+                next_crash = prng.randint(
+                    state.key0, NEM_SITE_CRASH_IV, cfg.nem_crash_interval_lo_us,
+                    cfg.nem_crash_interval_hi_us, index=ck_n + 1,
+                )
+                chaos_at = jnp.where(
+                    do_crash,
+                    state.chaos_at + restart_delay,
+                    jnp.where(
+                        do_restart, state.chaos_at + next_crash, state.chaos_at
+                    ),
+                )
+                nem_crash_k = ck_n + do_restart.astype(jnp.int32)
+                wipe_coin = (
+                    prng.bits(state.key0, NEM_SITE_CRASH_WIPE, index=ck_n)
+                    % jnp.uint32(COIN_DENOM)
+                ) < jnp.uint32(round(cfg.nem_crash_wipe_rate * COIN_DENOM))
+                nem_wipe = jnp.where(
+                    do_crash, wipe_coin,
+                    jnp.where(do_restart, False, state.nem.wipe),
+                )
+            else:
+                restart_delay = prng.randint(
+                    ckey, 2, cfg.restart_delay_lo_us, cfg.restart_delay_hi_us
+                )
+                next_crash = prng.randint(
+                    ckey, 3, cfg.crash_interval_lo_us, cfg.crash_interval_hi_us
+                )
+                chaos_at = jnp.where(
+                    do_crash,
+                    clock + restart_delay,
+                    jnp.where(do_restart, clock + next_crash, state.chaos_at),
+                )
             crashed = jnp.where(
                 do_crash, victim, jnp.where(do_restart, -1, state.crashed)
             )
             tr_crash = jnp.where(do_crash, victim, -1)
             tr_restart = jnp.where(do_restart, restart_node, -1)
-            chaos_at = jnp.where(
-                do_crash,
-                clock + restart_delay,
-                jnp.where(do_restart, clock + next_crash, state.chaos_at),
-            )
             # in-flight messages to a crashed node are lost (reset_node closes
             # sockets, network.rs:142-147): its pool slice simply empties
             valid = valid & ~crash_mask[:, :, None]
@@ -692,18 +1003,61 @@ class BatchedSim:
         tr_split = jnp.zeros((L,), jnp.bool_)
         tr_heal = jnp.zeros((L,), jnp.bool_)
         tr_side = jnp.zeros((L,), jnp.int32)
-        if cfg.partition_enabled:
+        nem_part_k = None
+        if cfg.any_partition_enabled:
             part_due = active & (state.part_at <= t_next)
             do_split = part_due & ~state.partitioned
             do_heal = part_due & state.partitioned
-            pkey = prng.fold(key, 106)
-            # each node draws a side; links crossing the cut go down both ways
-            side = (
-                prng.uniform(
-                    pkey[:, None], 7, index=jnp.arange(N, dtype=jnp.uint32)[None, :]
+            if cfg.nem_partition_enabled:
+                pk_n = state.nem.part_k
+                # per-node side bit at occurrence k: index = k * 64 + node
+                # (pure in the seed; FaultPlan.schedule draws the same bit)
+                side = (
+                    prng.bits(
+                        state.key0[:, None], NEM_SITE_PART_SIDE,
+                        index=pk_n[:, None].astype(jnp.uint32) * 64
+                        + jnp.arange(N, dtype=jnp.uint32)[None, :],
+                    )
+                    & 1
+                ) == 1  # [L,N]
+                heal_delay = prng.randint(
+                    state.key0, NEM_SITE_PART_HEAL, cfg.nem_partition_heal_lo_us,
+                    cfg.nem_partition_heal_hi_us, index=pk_n,
                 )
-                < 0.5
-            )  # [L,N]
+                next_split = prng.randint(
+                    state.key0, NEM_SITE_PART_IV,
+                    cfg.nem_partition_interval_lo_us,
+                    cfg.nem_partition_interval_hi_us, index=pk_n + 1,
+                )
+                part_at = jnp.where(
+                    do_split,
+                    state.part_at + heal_delay,
+                    jnp.where(do_heal, state.part_at + next_split, state.part_at),
+                )
+                nem_part_k = pk_n + do_heal.astype(jnp.int32)
+            else:
+                pkey = prng.fold(key, 106)
+                # each node draws a side; links crossing the cut go down
+                # both ways
+                side = (
+                    prng.uniform(
+                        pkey[:, None], 7,
+                        index=jnp.arange(N, dtype=jnp.uint32)[None, :],
+                    )
+                    < 0.5
+                )  # [L,N]
+                heal_delay = prng.randint(
+                    pkey, 8, cfg.partition_heal_lo_us, cfg.partition_heal_hi_us
+                )
+                next_split = prng.randint(
+                    pkey, 9, cfg.partition_interval_lo_us,
+                    cfg.partition_interval_hi_us,
+                )
+                part_at = jnp.where(
+                    do_split,
+                    clock + heal_delay,
+                    jnp.where(do_heal, clock + next_split, state.part_at),
+                )
             same_side = side[:, :, None] == side[:, None, :]  # [L,N,N]
             link_ok = jnp.where(
                 do_split[:, None, None],
@@ -711,21 +1065,74 @@ class BatchedSim:
                 jnp.where(do_heal[:, None, None], True, state.link_ok),
             )
             partitioned = (state.partitioned | do_split) & ~do_heal
-            heal_delay = prng.randint(
-                pkey, 8, cfg.partition_heal_lo_us, cfg.partition_heal_hi_us
-            )
-            next_split = prng.randint(
-                pkey, 9, cfg.partition_interval_lo_us, cfg.partition_interval_hi_us
-            )
-            part_at = jnp.where(
-                do_split,
-                clock + heal_delay,
-                jnp.where(do_heal, clock + next_split, state.part_at),
-            )
             tr_split, tr_heal = do_split, do_heal
             tr_side = (
                 side.astype(jnp.int32) * (1 << jnp.arange(N, dtype=jnp.int32))
             ).sum(-1)
+
+        # -- 5c. nemesis link-clog + latency-spike windows ------------------
+        # (toggle machinery like crash/partition, schedule-timed; the clog
+        # is ASYMMETRIC — src->dst only — unlike the bipartition masks)
+        tr_clog_src = jnp.full((L,), -1, jnp.int32)
+        tr_clog_dst = jnp.full((L,), -1, jnp.int32)
+        tr_unclog = jnp.zeros((L,), jnp.bool_)
+        clogged = clog_src = clog_dst = None
+        nem_clog_at = nem_clog_k = None
+        if cfg.nem_clog_enabled:
+            nst = state.nem
+            clog_due = active & (nst.clog_at <= t_next)
+            do_clog = clog_due & ~nst.clogged
+            do_unclog = clog_due & nst.clogged
+            kk = nst.clog_k
+            src_d = prng.randint(state.key0, NEM_SITE_CLOG_SRC, 0, N, index=kk)
+            dst_d = prng.randint(
+                state.key0, NEM_SITE_CLOG_DST, 0, N - 1, index=kk
+            )
+            dst_d = dst_d + (dst_d >= src_d).astype(jnp.int32)  # skip src
+            clog_src = jnp.where(do_clog, src_d, nst.clog_src)
+            clog_dst = jnp.where(do_clog, dst_d, nst.clog_dst)
+            clogged = (nst.clogged | do_clog) & ~do_unclog
+            heal_d = prng.randint(
+                state.key0, NEM_SITE_CLOG_HEAL, cfg.nem_clog_heal_lo_us,
+                cfg.nem_clog_heal_hi_us, index=kk,
+            )
+            next_d = prng.randint(
+                state.key0, NEM_SITE_CLOG_IV, cfg.nem_clog_interval_lo_us,
+                cfg.nem_clog_interval_hi_us, index=kk + 1,
+            )
+            nem_clog_at = jnp.where(
+                do_clog, nst.clog_at + heal_d,
+                jnp.where(do_unclog, nst.clog_at + next_d, nst.clog_at),
+            )
+            nem_clog_k = kk + do_unclog.astype(jnp.int32)
+            tr_clog_src = jnp.where(do_clog, src_d, -1)
+            tr_clog_dst = jnp.where(do_clog, dst_d, -1)
+            tr_unclog = do_unclog
+        tr_spike_on = jnp.zeros((L,), jnp.bool_)
+        tr_spike_off = jnp.zeros((L,), jnp.bool_)
+        spiking = None
+        nem_spike_at = nem_spike_k = None
+        if cfg.nem_spike_enabled:
+            nst = state.nem
+            spike_due = active & (nst.spike_at <= t_next)
+            do_spike = spike_due & ~nst.spiking
+            do_unspike = spike_due & nst.spiking
+            sk = nst.spike_k
+            spiking = (nst.spiking | do_spike) & ~do_unspike
+            dur_d = prng.randint(
+                state.key0, NEM_SITE_SPIKE_DUR, cfg.nem_spike_duration_lo_us,
+                cfg.nem_spike_duration_hi_us, index=sk,
+            )
+            next_d = prng.randint(
+                state.key0, NEM_SITE_SPIKE_IV, cfg.nem_spike_interval_lo_us,
+                cfg.nem_spike_interval_hi_us, index=sk + 1,
+            )
+            nem_spike_at = jnp.where(
+                do_spike, nst.spike_at + dur_d,
+                jnp.where(do_unspike, nst.spike_at + next_d, nst.spike_at),
+            )
+            nem_spike_k = sk + do_unspike.astype(jnp.int32)
+            tr_spike_on, tr_spike_off = do_spike, do_unspike
 
         # -- 6. collect outboxes, roll the network, pack into pool ---------
         def flat(out: Outbox, emitting, e):  # [L,N,e,...] -> [L, N*e, ...]
@@ -745,14 +1152,36 @@ class BatchedSim:
             E_m, E_t = spec.max_out_msg, spec.max_out
             mv, md, mk, mp = flat(out_m, has_msg, E_m)
             tv, td, tk, tp = flat(out_t, due_t, E_t)
-            cand_valid = jnp.concatenate([mv, tv], axis=1)  # [L,C]
+            cand_valid = jnp.concatenate([mv, tv], axis=1)  # [L,Cb]
             cand_dst = jnp.clip(jnp.concatenate([md, td], axis=1), 0, N - 1)
             cand_kind = jnp.concatenate([mk, tk], axis=1)
             cand_pay = jnp.concatenate([mp, tp], axis=1)
 
+        net_key = prng.fold(key, 105)[:, None]
+        if self._dup:
+            # nemesis duplication: interleave a coin-gated copy of every
+            # candidate (position 2c+1 mirrors 2c); the copy rolls its own
+            # loss/latency below, so it can arrive reordered or die alone
+            bidx = jnp.arange(self._Cb, dtype=jnp.uint32)[None, :]
+            dcoin = prng.bernoulli(
+                net_key, NET_SITE_DUP, cfg.nem_dup_rate, index=bidx
+            )
+            dup_fires = (cand_valid & dcoin).sum(axis=1, dtype=jnp.int32)
+
+            def il(x):
+                if x.ndim == 2:
+                    return jnp.stack([x, x], axis=2).reshape(L, C)
+                return jnp.stack([x, x], axis=2).reshape(L, C, P)
+
+            cand_valid = jnp.stack(
+                [cand_valid, cand_valid & dcoin], axis=2
+            ).reshape(L, C)
+            cand_dst, cand_kind, cand_pay = il(cand_dst), il(cand_kind), il(cand_pay)
+        else:
+            dup_fires = jnp.zeros((L,), jnp.int32)
+
         # network rolls: loss + latency (+ buggify heavy-tail coin)
         cidx = jnp.arange(C, dtype=jnp.uint32)[None, :]
-        net_key = prng.fold(key, 105)[:, None]
         u = prng.uniform(net_key, 1, index=cidx)
         lat = prng.randint(
             net_key, 2, cfg.latency_lo_us,
@@ -760,14 +1189,61 @@ class BatchedSim:
         )
         cand_dst_oh = cand_dst[:, :, None] == narange[None, None, :]  # [L,C,N]
         keep = cand_valid & (u >= cfg.loss_rate)
-        # sends to currently-dead nodes are dropped (clogged-node semantics)
-        keep = keep & (cand_dst_oh & alive[:, None, :]).any(-1)
-        if cfg.partition_enabled:
+        # sends to currently-dead nodes are dropped (clogged-node
+        # semantics) and counted in their OWN lane counter: pool-overflow
+        # drops mean back-pressure, dead-node drops mean crash fallout,
+        # and graceful-degradation assertions need to tell them apart
+        alive_dst = (cand_dst_oh & alive[:, None, :]).any(-1)
+        dead_dropped = (keep & ~alive_dst).sum(axis=1, dtype=jnp.int32)
+        keep = keep & alive_dst
+        if cfg.any_partition_enabled:
             # link test at send time (test_link, network.rs:261-269): the
             # candidate's source node is static per position, so the link row
             # is a constant-index gather, then matched against the dst one-hot
             src_rows = link_ok[:, self._src_of_c, :]  # [L,C,N]
             keep = keep & (cand_dst_oh & src_rows).any(-1)
+        if cfg.nem_clog_enabled:
+            # asymmetric clog: drop candidates whose (static source,
+            # dynamic dst) match the lane's clogged directed link
+            src_const = jnp.asarray(self._src_of_c, jnp.int32)  # [C]
+            clog_hit = (
+                clogged[:, None]
+                & (src_const[None, :] == clog_src[:, None])
+                & (cand_dst == clog_dst[:, None])
+            )
+            keep = keep & ~clog_hit
+        if cfg.nem_loss_rate > 0:
+            # nemesis extra loss coin, rolled LAST — only on messages that
+            # survived base loss, dead destinations, partitions and clogs.
+            # fires_loss therefore counts the clause's own coin on traffic
+            # that would otherwise have been delivered, which is what the
+            # host NetSim counts too (its clog check precedes the coin);
+            # the coverage report reads the same on both backends
+            u2 = prng.uniform(net_key, NET_SITE_NEM_LOSS, index=cidx)
+            nem_lost = keep & (u2 < cfg.nem_loss_rate)
+            loss_drops = nem_lost.sum(axis=1, dtype=jnp.int32)
+            keep = keep & ~nem_lost
+        else:
+            loss_drops = jnp.zeros((L,), jnp.int32)
+        if cfg.nem_reorder_rate > 0:
+            # bounded reordering: an extra uniform delay in [0, window] —
+            # latency only LENGTHENS, so the conservative lookahead bound
+            # (latency_lo) is untouched while later sends overtake
+            rcoin = keep & prng.bernoulli(
+                net_key, NET_SITE_REORDER, cfg.nem_reorder_rate, index=cidx
+            )
+            extra = prng.randint(
+                net_key, NET_SITE_REORDER_EXTRA, 0,
+                cfg.nem_reorder_window_us + 1, index=cidx,
+            )
+            lat = jnp.where(rcoin, lat + extra, lat)
+            reorder_fires = rcoin.sum(axis=1, dtype=jnp.int32)
+        else:
+            reorder_fires = jnp.zeros((L,), jnp.int32)
+        if cfg.nem_spike_enabled:
+            lat = jnp.where(
+                spiking[:, None], lat + jnp.int32(cfg.nem_spike_extra_us), lat
+            )
         if self._B:
             # the rand_delay buggify tail (net/mod.rs:287-295): a surviving
             # message occasionally takes seconds instead of milliseconds
@@ -794,7 +1270,7 @@ class BatchedSim:
             # bursts that cluster on one outbox row borrow slack from quiet
             # rows. A send ranks past the free count => DROPPED (counted):
             # overwriting a pending slot would corrupt a message in flight.
-            E, SK = spec.max_out, self._SK
+            E, SK = self._E_pack, self._SK  # E doubles under duplication
             send_n = send.reshape(L, N, E)
             free = (~valid.any(1)).reshape(L, N, SK)  # [L,Nsrc,SK]
 
@@ -943,6 +1419,34 @@ class BatchedSim:
         else:
             new_strag = None
 
+        # -- 6b. chaos fire counts (the coverage report's raw data) --------
+        # every enabled clause must show nonzero fires over a seed batch;
+        # an enabled clause with zero fires is dead chaos (nemesis.py)
+        zl = jnp.zeros((L,), jnp.int32)
+        cols = [zl] * len(FIRE_KINDS)
+
+        def _count(kind, arr):
+            cols[FIRE_INDEX[kind]] = cols[FIRE_INDEX[kind]] + (
+                arr.astype(jnp.int32) if arr.dtype == jnp.bool_ else arr
+            )
+
+        if any_crash:
+            _count("crash", do_crash)
+            _count("restart", do_restart)
+            if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
+                _count("wipe", do_crash & wipe_coin)
+        if cfg.any_partition_enabled:
+            _count("partition", do_split)
+            _count("heal", do_heal)
+        if cfg.nem_clog_enabled:
+            _count("clog", do_clog)
+        if cfg.nem_spike_enabled:
+            _count("spike", do_spike)
+        _count("loss", loss_drops)
+        _count("dup", dup_fires)
+        _count("reorder", reorder_fires)
+        fires = state.fires + jnp.stack(cols, axis=1)
+
         # -- 7. invariants + lane lifecycle --------------------------------
         ok = self._v_check(node, alive, clock)
         new_violation = active & ~ok & ~state.violated
@@ -973,6 +1477,30 @@ class BatchedSim:
         chaos_at = rb(chaos_at, shift)
         part_at = rb(part_at, shift)
         new_deliver = rb(new_deliver, shift)
+        if state.nem is not None:
+            nst = state.nem
+            new_nem = NemesisState(
+                crash_k=nem_crash_k if nem_crash_k is not None else nst.crash_k,
+                wipe=nem_wipe if nem_wipe is not None else nst.wipe,
+                part_k=nem_part_k if nem_part_k is not None else nst.part_k,
+                clog_at=rb(
+                    nem_clog_at if nem_clog_at is not None else nst.clog_at,
+                    shift,
+                ),
+                clogged=clogged if clogged is not None else nst.clogged,
+                clog_src=clog_src if clog_src is not None else nst.clog_src,
+                clog_dst=clog_dst if clog_dst is not None else nst.clog_dst,
+                clog_k=nem_clog_k if nem_clog_k is not None else nst.clog_k,
+                spike_at=rb(
+                    nem_spike_at if nem_spike_at is not None else nst.spike_at,
+                    shift,
+                ),
+                spiking=spiking if spiking is not None else nst.spiking,
+                spike_k=nem_spike_k if nem_spike_k is not None else nst.spike_k,
+                skew=nst.skew,
+            )
+        else:
+            new_nem = None
         if self._B:
             new_strag = new_strag._replace(
                 deliver=rb(new_strag.deliver, shift)
@@ -988,6 +1516,7 @@ class BatchedSim:
             clock=clock,
             epoch=epoch,
             key=key,
+            key0=state.key0,
             done=done,
             violated=violated,
             violation_at=violation_at,
@@ -998,6 +1527,8 @@ class BatchedSim:
             + has_msg.sum(axis=1, dtype=jnp.int32)
             + due_t.sum(axis=1, dtype=jnp.int32),
             overflow=overflow,
+            dead_drops=state.dead_drops + dead_dropped,
+            fires=fires,
             alive=alive,
             crashed=crashed,
             chaos_at=chaos_at,
@@ -1013,6 +1544,7 @@ class BatchedSim:
                 payload=new_payload,
             ),
             strag=new_strag,
+            nem=new_nem,
         )
         record = TraceRecord(
             clock=clock,
@@ -1032,6 +1564,11 @@ class BatchedSim:
             side_mask=tr_side,
             violation=new_violation,
             deadlock=deadlocked,
+            clog_src=tr_clog_src,
+            clog_dst=tr_clog_dst,
+            unclog=tr_unclog,
+            spike_on=tr_spike_on,
+            spike_off=tr_spike_off,
         )
         return new_state, record
 
@@ -1205,9 +1742,14 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
         "deadlocked": int(np.asarray(state.deadlocked).sum()),
         "total_events": int(np.asarray(state.events).sum()),
         "total_overflow": int(np.asarray(state.overflow).sum()),
+        "total_dead_drops": int(np.asarray(state.dead_drops).sum()),
         "mean_steps": float(np.asarray(state.steps).mean()),
         "mean_virtual_secs": float(abs_time_us(state).mean()) / 1e6,
     }
+    # per-fault-kind chaos fire counts (the coverage report's raw data)
+    fires = np.asarray(state.fires)
+    for i, name in enumerate(FIRE_KINDS):
+        out[f"fires_{name}"] = int(fires[:, i].sum())
     if spec is not None and spec.lane_metrics is not None:
         for name, arr in spec.lane_metrics(state.node).items():
             a = np.asarray(arr)

@@ -1,0 +1,266 @@
+"""Nemesis, tensorized: FaultPlan -> batched-engine knobs + device streams.
+
+The host half (`madsim_tpu.nemesis`) owns the clause vocabulary, the pure
+murmur3 schedule, and the host driver. This module is the device face:
+
+  * `compile_plan(plan, base)` lowers a FaultPlan onto the `nem_*`
+    SimConfig knobs that `BatchedSim` threads through `SimState`/step —
+    the SAME plan object that drives a host runtime drives a 100k-lane
+    sweep;
+  * `device_chaos_events(sim, seed)` re-runs one seed traced and returns
+    its schedule-level chaos events, normalized for comparison against
+    `plan.schedule(seed, ...)` (the twin-test contract: the engine's fault
+    stream IS the pure schedule);
+  * `coverage_report(summary, config)` renders the chaos-coverage line
+    from a batch summary's per-kind fire counts, flagging enabled clauses
+    that never fired (dead chaos = a fuzzer quietly not fuzzing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..nemesis import (
+    Clause,
+    ClockSkew,
+    Crash,
+    Duplicate,
+    FaultPlan,
+    FIRE_KINDS,
+    LatencySpike,
+    LinkClog,
+    MsgLoss,
+    NemesisEvent,
+    Partition,
+    Reorder,
+)
+from .spec import SimConfig
+
+
+def compile_plan(plan: FaultPlan, base: Optional[SimConfig] = None) -> SimConfig:
+    """Lower a FaultPlan onto the engine's `nem_*` knobs.
+
+    A plan is the single source of fault truth for a run: when it provides
+    a Crash or Partition clause, the base config's legacy trajectory-coupled
+    counterpart (`crash_interval_*` / `partition_interval_*`) is CLEARED —
+    workload factories ship chaos-on defaults, and stacking both time
+    sources on one machinery is rejected by BatchedSim anyway.
+    """
+    cfg = base or SimConfig()
+    kw: Dict[str, Any] = {}
+    crash = plan.get(Crash)
+    if crash is not None:
+        kw.update(
+            crash_interval_lo_us=0,
+            crash_interval_hi_us=0,
+            nem_crash_interval_lo_us=crash.interval_lo_us,
+            nem_crash_interval_hi_us=crash.interval_hi_us,
+            nem_crash_down_lo_us=crash.down_lo_us,
+            nem_crash_down_hi_us=crash.down_hi_us,
+            nem_crash_wipe_rate=crash.wipe_rate,
+        )
+    part = plan.get(Partition)
+    if part is not None:
+        kw.update(
+            partition_interval_lo_us=0,
+            partition_interval_hi_us=0,
+            nem_partition_interval_lo_us=part.interval_lo_us,
+            nem_partition_interval_hi_us=part.interval_hi_us,
+            nem_partition_heal_lo_us=part.heal_lo_us,
+            nem_partition_heal_hi_us=part.heal_hi_us,
+        )
+    clog = plan.get(LinkClog)
+    if clog is not None:
+        kw.update(
+            nem_clog_interval_lo_us=clog.interval_lo_us,
+            nem_clog_interval_hi_us=clog.interval_hi_us,
+            nem_clog_heal_lo_us=clog.heal_lo_us,
+            nem_clog_heal_hi_us=clog.heal_hi_us,
+        )
+    spike = plan.get(LatencySpike)
+    if spike is not None:
+        kw.update(
+            nem_spike_interval_lo_us=spike.interval_lo_us,
+            nem_spike_interval_hi_us=spike.interval_hi_us,
+            nem_spike_duration_lo_us=spike.duration_lo_us,
+            nem_spike_duration_hi_us=spike.duration_hi_us,
+            nem_spike_extra_us=spike.extra_us,
+        )
+    loss = plan.get(MsgLoss)
+    if loss is not None:
+        kw.update(nem_loss_rate=loss.rate)
+    dup = plan.get(Duplicate)
+    if dup is not None:
+        kw.update(nem_dup_rate=dup.rate)
+    ro = plan.get(Reorder)
+    if ro is not None:
+        kw.update(nem_reorder_rate=ro.rate, nem_reorder_window_us=ro.window_us)
+    skew = plan.get(ClockSkew)
+    if skew is not None:
+        kw.update(nem_skew_max_ppm=skew.max_ppm)
+    return dataclasses.replace(cfg, **kw)
+
+
+# normalized comparison tuples: (t_us, kind, a, b) — wipe flags, skew ppm
+# and spike magnitudes are schedule-side detail the trace doesn't carry
+_CHAOS_KINDS = (
+    "crash", "restart", "split", "heal", "clog", "unclog",
+    "spike_on", "spike_off",
+)
+
+
+def schedule_tuples(
+    events: Sequence[NemesisEvent], horizon_us: Optional[int] = None
+) -> List[Tuple[int, str, int, int]]:
+    """Normalize a pure schedule for stream comparison (skew rows are
+    t=0 assignments, not events — compare those via plan.skew_ppm)."""
+    out = []
+    for ev in events:
+        if ev.kind == "skew":
+            continue
+        if horizon_us is not None and ev.t_us >= horizon_us:
+            continue
+        if ev.kind in ("split", "heal"):
+            out.append((ev.t_us, ev.kind, ev.side_mask, -1))
+        elif ev.kind in ("clog", "unclog"):
+            out.append((ev.t_us, ev.kind, ev.node, ev.dst))
+        elif ev.kind in ("spike_on", "spike_off"):
+            out.append((ev.t_us, ev.kind, -1, -1))
+        else:  # crash / restart
+            out.append((ev.t_us, ev.kind, ev.node, -1))
+    return out
+
+
+def device_chaos_events(
+    sim, seed: int, max_steps: int = 20_000,
+    horizon_us: Optional[int] = None,
+) -> List[Tuple[int, str, int, int]]:
+    """One seed's schedule-level chaos stream as executed ON DEVICE.
+
+    Re-runs the seed through the traced step function and extracts
+    crash/restart/split/heal/clog/unclog/spike events in normalized tuple
+    form. With `horizon_us` set (pass the config's horizon), events at or
+    past it are dropped — the engine fires at most one event past the
+    horizon before the lane freezes, the pure schedule stops exactly at
+    it.
+    """
+    from .trace import trace_seed
+
+    clog_pair = (-1, -1)
+    out: List[Tuple[int, str, int, int]] = []
+    for ev in trace_seed(sim, seed, max_steps=max_steps):
+        if ev.kind not in _CHAOS_KINDS:
+            continue
+        if horizon_us is not None and ev.t_us >= horizon_us:
+            continue
+        if ev.kind in ("crash", "restart"):
+            out.append((ev.t_us, ev.kind, ev.node, -1))
+        elif ev.kind in ("split", "heal"):
+            # trace detail carries the split sides; side_mask round-trips
+            # through the record's i32
+            out.append((ev.t_us, ev.kind, _side_mask_of(ev), -1))
+        elif ev.kind == "clog":
+            clog_pair = (ev.node, ev.src)
+            out.append((ev.t_us, "clog", ev.node, ev.src))
+        elif ev.kind == "unclog":
+            out.append((ev.t_us, "unclog", clog_pair[0], clog_pair[1]))
+        else:
+            out.append((ev.t_us, ev.kind, -1, -1))
+    return out
+
+
+def _side_mask_of(ev) -> int:
+    if ev.kind == "heal":
+        return -2  # heal records no mask; schedule side carries the split's
+    a = ev.detail.split("|")[0].strip()
+    mask = 0
+    for tok in a.strip("[] ").split(","):
+        tok = tok.strip()
+        if tok:
+            mask |= 1 << int(tok)
+    return mask
+
+
+def assert_device_matches_schedule(
+    sim, plan: FaultPlan, seed: int, horizon_us: int,
+    max_steps: int = 20_000,
+) -> int:
+    """Twin-test helper: the engine's chaos stream for `seed` must equal
+    the pure schedule event-for-event (times, kinds, victims, sides, clog
+    pairs) below the horizon. Returns the number of compared events."""
+    want = schedule_tuples(
+        plan.schedule(seed, horizon_us, sim.spec.n_nodes), horizon_us
+    )
+    got = device_chaos_events(
+        sim, seed, max_steps=max_steps, horizon_us=horizon_us
+    )
+    # normalize for comparison: heal events carry no mask in the trace,
+    # and SAME-MICROSECOND ties across clauses are emitted in clause order
+    # by the trace but sorted lexicographically by the schedule — a sorted
+    # (multiset) compare is order-exact everywhere times differ and
+    # tie-insensitive where they don't
+    norm = lambda evs: sorted(
+        (t, k, -2 if k == "heal" else a, b) for (t, k, a, b) in evs
+    )
+    if norm(want) != norm(got):
+        for i, (w, g) in enumerate(zip(norm(want), norm(got))):
+            if w != g:
+                raise AssertionError(
+                    f"chaos stream diverges at event {i}: schedule {w} vs "
+                    f"device {g}\n  full schedule: {want}\n  full device: {got}"
+                )
+        raise AssertionError(
+            f"chaos stream length mismatch: schedule {len(want)} events vs "
+            f"device {len(got)}\n  schedule: {want}\n  device: {got}"
+        )
+    return len(want)
+
+
+def enabled_fire_kinds(cfg: SimConfig) -> Tuple[str, ...]:
+    """Which FIRE_KINDS this config can produce (legacy knobs included)."""
+    kinds: List[str] = []
+    if cfg.any_crash_enabled:
+        kinds += ["crash", "restart"]
+        if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
+            kinds.append("wipe")
+    if cfg.any_partition_enabled:
+        kinds += ["partition", "heal"]
+    if cfg.nem_clog_enabled:
+        kinds.append("clog")
+    if cfg.nem_spike_enabled:
+        kinds.append("spike")
+    if cfg.nem_loss_rate > 0:
+        kinds.append("loss")  # the MsgLoss clause; base loss_rate is ambience
+    if cfg.nem_dup_rate > 0:
+        kinds.append("dup")
+    if cfg.nem_reorder_rate > 0:
+        kinds.append("reorder")
+    if cfg.nem_skew_enabled:
+        kinds.append("skew")
+    return tuple(kinds)
+
+
+def coverage_report(summary: Dict[str, Any], cfg: SimConfig) -> str:
+    """The chaos-coverage line for a batch summary.
+
+        seed batch of 1024: crash 312, restart 301, dup 0 => DEAD CLAUSE
+
+    An enabled clause with zero fires across a whole seed batch means the
+    knobs can never trigger (interval beyond the horizon, rate too low for
+    the message volume) — the suite believes it is exploring a failure
+    mode it never executes."""
+    lanes = summary.get("lanes", "?")
+    parts = []
+    dead = []
+    for kind in enabled_fire_kinds(cfg):
+        n = int(summary.get(f"fires_{kind}", 0))
+        parts.append(f"{kind} {n}")
+        if n == 0:
+            dead.append(kind)
+    if not parts:
+        return f"seed batch of {lanes}: no chaos clauses enabled"
+    line = f"seed batch of {lanes}: " + ", ".join(parts)
+    if dead:
+        line += " => DEAD CLAUSE: " + ", ".join(dead)
+    return line

@@ -295,6 +295,47 @@ class SimConfig:
     partition_interval_hi_us: int = 0
     partition_heal_lo_us: int = 500_000
     partition_heal_hi_us: int = 3_000_000
+    # ---- nemesis: schedule-driven fault clauses (madsim_tpu/nemesis.py,
+    # compiled onto these knobs by madsim_tpu.tpu.nemesis.compile_plan).
+    # Unlike the legacy chaos knobs above — whose next-event times are
+    # trajectory-coupled (`clock + delay`) — nemesis event times, victims,
+    # partition sides, clog pairs and skew assignments are PURE functions
+    # of (seed, occurrence index) drawn from the lane's base key, so the
+    # fault schedule is identical on the host twin and replayable as
+    # `FaultPlan.schedule(seed, ...)`. A nemesis clause and its legacy
+    # counterpart cannot both be enabled (BatchedSim rejects the combo).
+    # crash/restart (+ crash-with-state-wipe at wipe_rate)
+    nem_crash_interval_lo_us: int = 0
+    nem_crash_interval_hi_us: int = 0  # 0 disables
+    nem_crash_down_lo_us: int = 500_000
+    nem_crash_down_hi_us: int = 3_000_000
+    nem_crash_wipe_rate: float = 0.0
+    # random bipartitions
+    nem_partition_interval_lo_us: int = 0
+    nem_partition_interval_hi_us: int = 0  # 0 disables
+    nem_partition_heal_lo_us: int = 500_000
+    nem_partition_heal_hi_us: int = 3_000_000
+    # asymmetric single-link clog (src->dst only)
+    nem_clog_interval_lo_us: int = 0
+    nem_clog_interval_hi_us: int = 0  # 0 disables
+    nem_clog_heal_lo_us: int = 500_000
+    nem_clog_heal_hi_us: int = 3_000_000
+    # latency-spike windows: +extra on every message while open
+    nem_spike_interval_lo_us: int = 0
+    nem_spike_interval_hi_us: int = 0  # 0 disables
+    nem_spike_duration_lo_us: int = 200_000
+    nem_spike_duration_hi_us: int = 1_000_000
+    nem_spike_extra_us: int = 100_000
+    # message-level clauses (per-candidate coins on the step's net key —
+    # backend-local streams; rates and fire counts match the host, events
+    # do not, by the per-backend determinism contract)
+    nem_loss_rate: float = 0.0  # on top of loss_rate
+    nem_dup_rate: float = 0.0  # duplicate with an independent latency roll
+    nem_reorder_rate: float = 0.0  # extra delay in [0, window] (reorders;
+    nem_reorder_window_us: int = 0  # latency only LENGTHENS => lookahead-safe)
+    # per-node clock skew: relative timer delays scale by 1 + ppm * 1e-6,
+    # ppm drawn once per (seed, node) from [-max, +max]
+    nem_skew_max_ppm: int = 0
     horizon_us: int = 30_000_000  # virtual-time budget per lane
     # scheduling-order nondeterminism (the utils/mpsc.rs:71-84 random-pop
     # analog, on device): break equal-timestamp delivery ties by a random
@@ -323,3 +364,37 @@ class SimConfig:
     @property
     def partition_enabled(self) -> bool:
         return self.partition_interval_hi_us > 0
+
+    # -- nemesis clause switches --
+
+    @property
+    def nem_crash_enabled(self) -> bool:
+        return self.nem_crash_interval_hi_us > 0
+
+    @property
+    def nem_partition_enabled(self) -> bool:
+        return self.nem_partition_interval_hi_us > 0
+
+    @property
+    def nem_clog_enabled(self) -> bool:
+        return self.nem_clog_interval_hi_us > 0
+
+    @property
+    def nem_spike_enabled(self) -> bool:
+        return self.nem_spike_interval_hi_us > 0
+
+    @property
+    def nem_skew_enabled(self) -> bool:
+        return self.nem_skew_max_ppm > 0
+
+    @property
+    def nem_dup_enabled(self) -> bool:
+        return self.nem_dup_rate > 0
+
+    @property
+    def any_crash_enabled(self) -> bool:
+        return self.chaos_enabled or self.nem_crash_enabled
+
+    @property
+    def any_partition_enabled(self) -> bool:
+        return self.partition_enabled or self.nem_partition_enabled

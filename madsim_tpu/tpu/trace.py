@@ -29,8 +29,10 @@ from .engine import BatchedSim, TraceRecord
 class TraceEvent:
     step: int
     t_us: int
-    kind: str  # deliver | timer | crash | restart | split | heal | violation | deadlock
-    node: int = -1  # acting node (dst for deliver)
+    # deliver | timer | crash | restart | split | heal | clog | unclog |
+    # spike_on | spike_off | violation | deadlock
+    kind: str
+    node: int = -1  # acting node (dst for deliver; src for clog)
     src: int = -1  # sender (deliver only)
     msg_kind: int = -1  # protocol message kind (deliver only)
     msg_name: str = ""  # human name for msg_kind, if provided
@@ -53,6 +55,12 @@ class TraceEvent:
             return f"[{t:9.6f}s #{self.step}] partition split {self.detail}"
         if self.kind == "heal":
             return f"[{t:9.6f}s #{self.step}] partition healed"
+        if self.kind in ("clog", "unclog"):
+            return f"[{t:9.6f}s #{self.step}] {self.kind} link {self.detail}"
+        if self.kind == "spike_on":
+            return f"[{t:9.6f}s #{self.step}] latency spike begins {self.detail}"
+        if self.kind == "spike_off":
+            return f"[{t:9.6f}s #{self.step}] latency spike ends"
         return f"[{t:9.6f}s #{self.step}] {self.kind.upper()} {self.detail}"
 
 
@@ -88,6 +96,11 @@ def extract_trace(
     side_mask = np.asarray(recs.side_mask)[:, lane]
     violation = np.asarray(recs.violation)[:, lane]
     deadlock = np.asarray(recs.deadlock)[:, lane]
+    clog_src = np.asarray(recs.clog_src)[:, lane]
+    clog_dst = np.asarray(recs.clog_dst)[:, lane]
+    unclog = np.asarray(recs.unclog)[:, lane]
+    spike_on = np.asarray(recs.spike_on)[:, lane]
+    spike_off = np.asarray(recs.spike_off)[:, lane]
 
     T, N = msg_fired.shape
     events: List[TraceEvent] = []
@@ -95,6 +108,7 @@ def extract_trace(
     busy = (
         msg_fired.any(1) | timer_fired.any(1) | (crash >= 0) | (restart >= 0)
         | split | heal | violation | deadlock
+        | (clog_src >= 0) | unclog | spike_on | spike_off
     )
     for t in np.nonzero(busy)[0]:
         t = int(t)
@@ -145,6 +159,20 @@ def extract_trace(
             )
         if heal[t]:
             events.append(TraceEvent(step=t, t_us=t_chaos, kind="heal"))
+        if clog_src[t] >= 0:
+            events.append(
+                TraceEvent(
+                    step=t, t_us=t_chaos, kind="clog", node=int(clog_src[t]),
+                    src=int(clog_dst[t]),
+                    detail=f"{int(clog_src[t])}->{int(clog_dst[t])}",
+                )
+            )
+        if unclog[t]:
+            events.append(TraceEvent(step=t, t_us=t_chaos, kind="unclog"))
+        if spike_on[t]:
+            events.append(TraceEvent(step=t, t_us=t_chaos, kind="spike_on"))
+        if spike_off[t]:
+            events.append(TraceEvent(step=t, t_us=t_chaos, kind="spike_off"))
         if violation[t]:
             events.append(
                 TraceEvent(

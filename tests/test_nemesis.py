@@ -1,0 +1,464 @@
+"""Nemesis FaultPlan: one seed-deterministic fault vocabulary, two backends.
+
+The subsystem's contract (madsim_tpu/nemesis.py):
+  * schedule-level clauses (crash/wipe/partition/clog/spike/skew) fire at
+    times that are pure functions of (seed, occurrence index) — the pure
+    `FaultPlan.schedule` IS the stream both backends execute;
+  * message-level clauses (loss/dup/reorder) are per-backend coin streams
+    whose FIRE COUNTS surface in the chaos-coverage report;
+  * every clause firing is counted, so a dead clause is visible.
+
+`chaos`-marked tests are the fast smoke tier (`make chaos-smoke`);
+`slow`-marked sweeps are the 1024-seed acceptance runs.
+"""
+
+import dataclasses
+
+import pytest
+
+from madsim_tpu import nemesis
+from madsim_tpu.nemesis import (
+    ClockSkew,
+    Crash,
+    Duplicate,
+    FaultPlan,
+    LatencySpike,
+    LinkClog,
+    MsgLoss,
+    Partition,
+    Reorder,
+)
+
+HORIZON_US = 4_000_000
+
+FULL_PLAN = FaultPlan(
+    name="full",
+    clauses=(
+        Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=300_000, down_hi_us=1_000_000, wipe_rate=0.3),
+        Partition(interval_lo_us=500_000, interval_hi_us=2_000_000,
+                  heal_lo_us=400_000, heal_hi_us=1_500_000),
+        LinkClog(interval_lo_us=600_000, interval_hi_us=2_000_000),
+        LatencySpike(interval_lo_us=700_000, interval_hi_us=2_500_000,
+                     extra_us=50_000),
+        MsgLoss(rate=0.02),
+        Duplicate(rate=0.05),
+        Reorder(rate=0.1, window_us=40_000),
+        ClockSkew(max_ppm=50_000),
+    ),
+)
+
+# the acceptance-criteria composition: crash + partition + duplication +
+# reorder + clock skew, one plan, both backends, one seed
+ACCEPT_PLAN = FaultPlan(
+    name="acceptance",
+    clauses=(
+        Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=300_000, down_hi_us=1_000_000),
+        Partition(interval_lo_us=500_000, interval_hi_us=2_000_000,
+                  heal_lo_us=400_000, heal_hi_us=1_500_000),
+        Duplicate(rate=0.05),
+        Reorder(rate=0.1, window_us=40_000),
+        ClockSkew(max_ppm=20_000),
+    ),
+)
+
+
+# ------------------------------------------------------------------ pure
+
+
+def test_schedule_is_pure_and_seed_sensitive():
+    a = FULL_PLAN.schedule(7, HORIZON_US, 5)
+    b = FULL_PLAN.schedule(7, HORIZON_US, 5)
+    c = FULL_PLAN.schedule(8, HORIZON_US, 5)
+    assert a == b
+    assert a != c
+    assert all(0 <= e.t_us < HORIZON_US or e.kind == "skew" for e in a)
+    kinds = {e.kind for e in a}
+    assert {"crash", "restart", "split", "clog", "spike_on", "skew"} <= kinds
+    # crash/restart alternate per victim stream and times are monotone
+    times = [e.t_us for e in a]
+    assert times == sorted(times)
+
+
+def test_schedule_respects_horizon_and_node_count():
+    for seed in range(16):
+        for e in FULL_PLAN.schedule(seed, 1_000_000, 3):
+            assert e.t_us < 1_000_000
+            if e.kind in ("crash", "restart"):
+                assert 0 <= e.node < 3
+            if e.kind in ("clog", "unclog"):
+                assert 0 <= e.node < 3 and 0 <= e.dst < 3
+                assert e.node != e.dst  # a link, not a loopback
+            if e.kind == "split":
+                assert 0 <= e.side_mask < 8
+
+
+def test_skew_assignment_pure_and_bounded():
+    ppm = FULL_PLAN.skew_ppm(3, 5)
+    assert ppm == FULL_PLAN.skew_ppm(3, 5)
+    assert len(ppm) == 5
+    assert all(-50_000 <= p <= 50_000 for p in ppm)
+    assert FULL_PLAN.skew_ppm(4, 5) != ppm
+
+
+def test_plan_validation_rejects_bad_clauses():
+    with pytest.raises(ValueError, match="must be in \\[0, 1\\)"):
+        FaultPlan(clauses=(MsgLoss(rate=1.5),))
+    with pytest.raises(ValueError, match="must be in \\[0, 1\\)"):
+        FaultPlan(clauses=(Duplicate(rate=-0.1),))
+    with pytest.raises(ValueError, match="interval"):
+        FaultPlan(clauses=(Crash(interval_lo_us=10, interval_hi_us=5),))
+    with pytest.raises(ValueError, match="window_us"):
+        FaultPlan(clauses=(Reorder(rate=0.1, window_us=0),))
+    with pytest.raises(ValueError, match="duplicate"):
+        FaultPlan(clauses=(MsgLoss(), MsgLoss()))
+    with pytest.raises(TypeError):
+        FaultPlan(clauses=("not-a-clause",))
+
+
+def test_prng_mirror_matches_device_prng():
+    """The pure-Python murmur3 chain must be bit-exact against tpu/prng —
+    it is the load-bearing wall of cross-backend schedule agreement."""
+    jnp = pytest.importorskip("jax.numpy")
+    from madsim_tpu.tpu import prng
+
+    for seed in (0, 1, 0xDEADBEEF, 2**32 - 1):
+        key_py = nemesis.key_from_seed(seed)
+        key_dev = int(prng.key_from(jnp.uint32(seed)))
+        assert key_py == key_dev
+        for site in (1, 201, 241):
+            for idx in (0, 1, 63, 10_000):
+                assert nemesis.bits32(key_py, site, idx) == int(
+                    prng.bits(jnp.uint32(key_dev), site, index=jnp.uint32(idx))
+                )
+                assert nemesis.randint32(key_py, site, -50, 700, idx) == int(
+                    prng.randint(
+                        jnp.uint32(key_dev), site, -50, 700,
+                        index=jnp.uint32(idx),
+                    )
+                )
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_netconfig_validates_like_the_engine():
+    from madsim_tpu.core.config import Config, NetConfig
+
+    with pytest.raises(ValueError, match="packet_loss_rate must be in \\[0, 1\\), got 1.5"):
+        NetConfig(packet_loss_rate=1.5)
+    with pytest.raises(ValueError, match="packet_loss_rate must be in \\[0, 1\\), got -0.1"):
+        Config.parse("[net]\npacket_loss_rate = -0.1\n")
+    with pytest.raises(ValueError, match="packet_duplicate_rate"):
+        Config.parse("[net]\npacket_duplicate_rate = 2.0\n")
+    with pytest.raises(ValueError, match="0 <= lo <= hi"):
+        NetConfig(send_latency_min=0.1, send_latency_max=0.05)
+    # a reorder rate with no window would silently run zero reordering —
+    # same contract as the engine's nem_reorder validation
+    with pytest.raises(ValueError, match="packet_reorder_window > 0"):
+        NetConfig(packet_reorder_rate=0.5)
+
+
+def test_config_hash_keys_on_nemesis_knobs():
+    from madsim_tpu.core.config import Config
+
+    base = Config()
+    toml = base.to_toml()
+    for knob in (
+        "packet_extra_loss_rate", "packet_duplicate_rate",
+        "packet_reorder_rate", "packet_reorder_window",
+    ):
+        assert knob in toml, f"{knob} missing from to_toml"
+    tweaked = Config()
+    tweaked.net.packet_duplicate_rate = 0.07
+    assert tweaked.hash() != base.hash()
+    # and the knobs round-trip through parse
+    again = Config.parse(tweaked.to_toml())
+    assert again.net.packet_duplicate_rate == 0.07
+    assert again.hash() == tweaked.hash()
+
+
+def test_fault_plan_to_net_config():
+    net = FULL_PLAN.to_net_config()
+    assert net.packet_extra_loss_rate == 0.02
+    assert net.packet_duplicate_rate == 0.05
+    assert net.packet_reorder_rate == 0.1
+    assert net.packet_reorder_window == pytest.approx(0.04)
+
+
+# ------------------------------------------------------------------ buggify
+
+
+def test_buggify_two_level_semantics():
+    import madsim_tpu as ms
+
+    def run(seed, hits=400):
+        rt = ms.Runtime(seed=seed)
+
+        async def body():
+            ms.buggify.enable()
+            fired = sum(
+                1 for _ in range(hits) if ms.buggify.buggify("slow_disk")
+            )
+            active = ms.buggify.is_active("slow_disk")
+            return active, fired, ms.buggify.fire_counts()
+
+        return rt.block_on(body())
+
+    results = {seed: run(seed) for seed in range(24)}
+    # determinism: same seed => same activation AND same fire count
+    for seed, (active, fired, counts) in results.items():
+        assert run(seed) == (active, fired, counts)
+        if active:
+            # an active point at p=0.25 over 400 hits essentially must fire
+            assert fired > 0
+            assert counts == {"slow_disk": fired}
+        else:
+            assert fired == 0
+            assert counts == {}
+    # two-level: SOME runs activate the point, some don't (0.25 each way
+    # over 24 seeds: both outcomes all-but-certain)
+    actives = [a for a, _, _ in results.values()]
+    assert any(actives) and not all(actives)
+
+
+def test_buggify_activation_is_call_order_independent():
+    import madsim_tpu as ms
+
+    def run(order):
+        rt = ms.Runtime(seed=11)
+
+        async def body():
+            ms.buggify.enable()
+            return {n: ms.buggify.is_active(n) for n in order}
+
+        return rt.block_on(body())
+
+    names = ["a", "b", "slow_disk", "partition_heal"]
+    assert run(names) == run(list(reversed(names)))
+
+
+def test_unnamed_buggify_unchanged():
+    import madsim_tpu as ms
+
+    rt = ms.Runtime(seed=5)
+
+    async def body():
+        assert not ms.buggify.buggify()  # disabled by default
+        ms.buggify.enable()
+        fired = sum(1 for _ in range(400) if ms.buggify.buggify())
+        return fired
+
+    fired = rt.block_on(body())
+    assert 40 < fired < 160  # ~25%
+    # unnamed points are not in the named registry
+    assert rt.handle.rng.buggify_fires == {}
+
+
+# ------------------------------------------------------------------ device
+
+jnp = None
+
+
+def _dev():
+    global jnp
+    import jax.numpy as _j
+
+    jnp = _j
+    from madsim_tpu.tpu import BatchedSim, SimConfig, make_raft_spec, summarize
+    from madsim_tpu.tpu import nemesis as tpu_nemesis
+
+    return BatchedSim, SimConfig, make_raft_spec, summarize, tpu_nemesis
+
+
+@pytest.mark.chaos
+def test_device_chaos_stream_equals_pure_schedule():
+    """The engine executes EXACTLY the plan's pure schedule: times, kinds,
+    victims, partition sides, clog pairs — for several seeds.
+
+    (Wipe-free variant of the full plan: wiping Raft's durable state
+    legitimately violates its invariants, and a frozen violating lane
+    truncates its chaos stream early — a different, correct behavior.)"""
+    BatchedSim, SimConfig, make_raft_spec, _, tn = _dev()
+    plan = FaultPlan(
+        name="stream",
+        clauses=tuple(
+            dataclasses.replace(c, wipe_rate=0.0) if isinstance(c, Crash) else c
+            for c in FULL_PLAN.clauses
+        ),
+    )
+    cfg = tn.compile_plan(plan, SimConfig(horizon_us=HORIZON_US))
+    sim = BatchedSim(make_raft_spec(5), cfg)
+    total = 0
+    for seed in (0, 1, 7, 1234):
+        total += tn.assert_device_matches_schedule(
+            sim, plan, seed, horizon_us=HORIZON_US
+        )
+    assert total > 20  # the comparison actually compared things
+
+
+@pytest.mark.chaos
+def test_acceptance_plan_both_paths_bit_identical_and_all_clauses_fire():
+    """The acceptance composition (crash + partition + duplication +
+    reorder + clock skew) on a 64-lane smoke: bit-identical repeat runs
+    (check_determinism) and nonzero fire counts for every enabled clause."""
+    from madsim_tpu.tpu.batch import run_batch
+    from madsim_tpu.tpu.raft import raft_workload
+
+    BatchedSim, SimConfig, make_raft_spec, _, tn = _dev()
+    wl = raft_workload(virtual_secs=HORIZON_US / 1e6)
+    wl = dataclasses.replace(
+        wl, config=tn.compile_plan(ACCEPT_PLAN, wl.config), host_repro=None
+    )
+    res = run_batch(
+        range(64), wl, repro_on_host=False, max_traces=0,
+        check_determinism=True,
+    )
+    assert res.violations == 0, res.summary
+    for kind in ACCEPT_PLAN.enabled_kinds:
+        assert res.chaos_fires.get(kind, 0) > 0, (kind, res.chaos_fires)
+    assert "DEAD CLAUSE" not in res.chaos_report()
+    assert "crash" in res.chaos_report()
+
+
+@pytest.mark.chaos
+def test_dead_clause_visible_in_coverage_report():
+    """A clause whose knobs can never fire inside the horizon must show up
+    as a dead clause, not silently report chaos it never ran."""
+    from madsim_tpu.tpu.batch import run_batch
+    from madsim_tpu.tpu.raft import raft_workload
+
+    BatchedSim, SimConfig, make_raft_spec, _, tn = _dev()
+    dead = FaultPlan(clauses=(
+        Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=300_000, down_hi_us=1_000_000),
+        # first split can never arrive before the horizon => dead clause
+        Partition(interval_lo_us=50_000_000, interval_hi_us=60_000_000),
+    ))
+    wl = raft_workload(virtual_secs=2.0)
+    wl = dataclasses.replace(
+        wl, config=tn.compile_plan(dead, wl.config), host_repro=None
+    )
+    res = run_batch(range(16), wl, repro_on_host=False, max_traces=0)
+    assert res.chaos_fires["crash"] > 0
+    assert res.chaos_fires["partition"] == 0
+    assert "DEAD CLAUSE" in res.chaos_report()
+    assert "partition" in res.chaos_report().split("DEAD CLAUSE")[1]
+
+
+@pytest.mark.chaos
+def test_dead_node_drops_counted_separately_from_overflow():
+    """engine satellite: sends to crashed nodes land in `dead_drops`, not
+    `overflow` — pool pressure and crash fallout are different diagnoses.
+    Differential: same seeds without the crash clause count ZERO dead
+    drops, so the counter isolates crash fallout exactly."""
+    from madsim_tpu.tpu.raft import raft_workload
+
+    BatchedSim, SimConfig, make_raft_spec, summarize, tn = _dev()
+    wl = raft_workload(virtual_secs=3.0)
+    base = dataclasses.replace(
+        wl.config, crash_interval_lo_us=0, crash_interval_hi_us=0,
+        partition_interval_lo_us=0, partition_interval_hi_us=0,
+        loss_rate=0.0,
+    )
+    plan = FaultPlan(clauses=(
+        Crash(interval_lo_us=200_000, interval_hi_us=800_000,
+              down_lo_us=500_000, down_hi_us=2_000_000),
+    ))
+    crashy = BatchedSim(wl.spec, tn.compile_plan(plan, base))
+    s = summarize(crashy.run(jnp.arange(32), max_steps=30_000))
+    # long downtimes + heartbeats at dead nodes: dead drops must be seen
+    assert s["total_dead_drops"] > 0
+    assert s["violations"] == 0
+    quiet = BatchedSim(wl.spec, base)
+    sq = summarize(quiet.run(jnp.arange(32), max_steps=30_000))
+    assert sq["total_dead_drops"] == 0
+    assert sq["violations"] == 0
+
+
+@pytest.mark.chaos
+def test_clock_skew_perturbs_trajectories_but_stays_safe():
+    """Skew must actually CHANGE behavior (different event counts vs the
+    unskewed run of the same seeds) while every safety invariant holds."""
+    import numpy as np
+
+    BatchedSim, SimConfig, make_raft_spec, summarize, tn = _dev()
+    base_cfg = SimConfig(horizon_us=3_000_000)
+    plain = BatchedSim(make_raft_spec(5), base_cfg).run(
+        jnp.arange(32), max_steps=30_000
+    )
+    skew_cfg = tn.compile_plan(
+        FaultPlan(clauses=(ClockSkew(max_ppm=100_000),)), base_cfg
+    )
+    skewed = BatchedSim(make_raft_spec(5), skew_cfg).run(
+        jnp.arange(32), max_steps=30_000
+    )
+    assert summarize(plain)["violations"] == 0
+    assert summarize(skewed)["violations"] == 0
+    ev_a = np.asarray(plain.events)
+    ev_b = np.asarray(skewed.events)
+    assert (ev_a != ev_b).any(), "10% clock skew changed nothing"
+
+
+@pytest.mark.chaos
+def test_duplication_delivers_more_events_than_it_sends():
+    """With a heavy dup rate, delivered-event counts must rise against the
+    same seeds without duplication (the copies really arrive)."""
+    import numpy as np
+
+    BatchedSim, SimConfig, make_raft_spec, summarize, tn = _dev()
+    base_cfg = SimConfig(horizon_us=2_000_000)
+    plain = BatchedSim(make_raft_spec(5), base_cfg).run(
+        jnp.arange(24), max_steps=30_000
+    )
+    dup_cfg = tn.compile_plan(
+        FaultPlan(clauses=(Duplicate(rate=0.3),)), base_cfg
+    )
+    dupped = BatchedSim(make_raft_spec(5), dup_cfg).run(
+        jnp.arange(24), max_steps=30_000
+    )
+    assert summarize(plain)["violations"] == 0
+    assert summarize(dupped)["violations"] == 0
+    assert (
+        np.asarray(dupped.events).sum() > np.asarray(plain.events).sum()
+    )
+    assert int(np.asarray(dupped.fires).sum(0)[
+        nemesis.FIRE_INDEX["dup"]
+    ]) > 0
+
+
+@pytest.mark.chaos
+def test_engine_rejects_legacy_plus_nemesis_combo():
+    BatchedSim, SimConfig, make_raft_spec, _, tn = _dev()
+    cfg = tn.compile_plan(
+        FaultPlan(clauses=(Crash(),)), SimConfig()
+    )
+    cfg = dataclasses.replace(
+        cfg, crash_interval_lo_us=1_000_000, crash_interval_hi_us=2_000_000
+    )
+    with pytest.raises(ValueError, match="cannot both be enabled"):
+        BatchedSim(make_raft_spec(5), cfg)
+    with pytest.raises(ValueError, match="nem_dup_rate must be in"):
+        BatchedSim(make_raft_spec(5), SimConfig(nem_dup_rate=1.5))
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_acceptance_1024_seed_batch_reports_every_clause():
+    """The acceptance sweep: 1024 seeds, full acceptance plan, nonzero
+    fire counts for EVERY enabled clause in BatchResult."""
+    from madsim_tpu.tpu.batch import run_batch
+    from madsim_tpu.tpu.raft import raft_workload
+
+    _, _, _, _, tn = _dev()
+    wl = raft_workload(virtual_secs=3.0)
+    wl = dataclasses.replace(
+        wl, config=tn.compile_plan(ACCEPT_PLAN, wl.config), host_repro=None
+    )
+    res = run_batch(range(1024), wl, repro_on_host=False, max_traces=0)
+    assert res.violations == 0, res.summary
+    assert res.summary["lanes"] == 1024
+    for kind in ACCEPT_PLAN.enabled_kinds:
+        assert res.chaos_fires.get(kind, 0) > 0, (kind, res.chaos_fires)
+    assert "DEAD CLAUSE" not in res.chaos_report()

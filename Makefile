@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all chaos-smoke real native bench dryrun demo clean
+.PHONY: test deep test-all chaos-smoke triage-smoke real native bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -15,6 +15,9 @@ deep:            ## deep device sweeps (~10 min; CI nightly)
 
 chaos-smoke:     ## fast nemesis smoke: 64-lane fault plans on both backends
 	$(PY) -m pytest tests/ -q -m "chaos and not slow"
+
+triage-smoke:    ## tiny seeded shrink of a planted raft bug + bundle replay
+	$(PY) -m pytest tests/test_triage.py -q -m "chaos and not slow"
 
 test-all: test deep
 

@@ -37,7 +37,7 @@ from .core import task  # noqa: F401
 from .core import vtime as time  # noqa: F401
 from .core.buggify import buggify_with_prob  # noqa: F401
 from .core.task import spawn, yield_now  # noqa: F401
-from . import fs, nemesis, net, signal, testing, tracing  # noqa: F401
+from . import fs, nemesis, net, signal, testing, tracing, triage  # noqa: F401
 from .nemesis import FaultPlan, NemesisDriver  # noqa: F401
 from .tracing import init_logger  # noqa: F401
 from .core import sync  # noqa: F401

@@ -21,11 +21,16 @@ from .core.task import NodeId
 
 
 class _INode:
-    __slots__ = ("data", "synced")
+    __slots__ = ("data", "synced", "ever_synced")
 
     def __init__(self) -> None:
         self.data = bytearray()
         self.synced = b""  # snapshot of content as of the last sync_all
+        # whether ANY sync has happened: a file created but never synced
+        # has no durable directory entry, so a power failure loses the
+        # whole inode — not just its bytes (matching a real filesystem,
+        # where the create itself needs a directory fsync to survive)
+        self.ever_synced = False
 
 
 class FsSim(Simulator):
@@ -49,9 +54,17 @@ class FsSim(Simulator):
 
         Restores each file to its exact content at the last `sync_all` —
         unsynced in-place overwrites of previously-synced byte ranges are
-        rolled back too, not just appended length.
+        rolled back too, not just appended length. Files created since the
+        last sync are REMOVED entirely: their directory entry was never
+        made durable, so the path must not survive as a present-but-empty
+        file (that lie is exactly the bug class power_fail exists to
+        expose — recovery code stat()ing a file that a real power loss
+        would have erased).
         """
-        for inode in self._fs.get(node_id, {}).values():
+        node_fs = self._fs.get(node_id, {})
+        for path in [p for p, ino in node_fs.items() if not ino.ever_synced]:
+            del node_fs[path]
+        for inode in node_fs.values():
             inode.data[:] = inode.synced
 
     def get_file_size(self, node_id: NodeId, path: str) -> Optional[int]:
@@ -141,6 +154,7 @@ class File:
 
     async def sync_all(self) -> None:
         self._inode.synced = bytes(self._inode.data)
+        self._inode.ever_synced = True
 
     async def metadata(self) -> Metadata:
         return Metadata(len(self._inode.data))

@@ -139,6 +139,39 @@ FIRE_KINDS: Tuple[str, ...] = (
 )
 FIRE_INDEX: Dict[str, int] = {k: i for i, k in enumerate(FIRE_KINDS)}
 
+# --------------------------------------------------------------------------
+# triage vocabulary (madsim_tpu/triage.py + the engine's TriageCtl lanes)
+# --------------------------------------------------------------------------
+# One name per shrinkable clause ATOM. The engine's per-lane TriageCtl
+# carries a bitmask over this tuple (set bit = clause disabled in that
+# lane); the four SCHEDULE clauses additionally support per-OCCURRENCE
+# disable masks (bit k = occurrence k's effect suppressed — the timing
+# machinery still advances through the skipped window, so dropping
+# occurrence k never moves occurrence k+1: the seed-pure schedule
+# invariant survives shrinking).
+
+TRIAGE_CLAUSES: Tuple[str, ...] = (
+    "crash", "partition", "clog", "spike", "skew", "loss", "dup",
+    "reorder", "wipe",
+)
+TRIAGE_BIT: Dict[str, int] = {n: 1 << i for i, n in enumerate(TRIAGE_CLAUSES)}
+# schedule clauses with occurrence counters (rows of TriageCtl.occ)
+OCC_CLAUSES: Tuple[str, ...] = ("crash", "partition", "clog", "spike")
+OCC_ROW: Dict[str, int] = {n: i for i, n in enumerate(OCC_CLAUSES)}
+# message-level clauses with per-lane rate scaling (rows of
+# TriageCtl.rate_scale)
+RATE_CLAUSES: Tuple[str, ...] = ("loss", "dup", "reorder")
+RATE_ROW: Dict[str, int] = {n: i for i, n in enumerate(RATE_CLAUSES)}
+# schedule-event kind -> owning clause name (restart belongs to its crash
+# occurrence, heal to its split, ...)
+CLAUSE_OF_EVENT: Dict[str, str] = {
+    "crash": "crash", "restart": "crash",
+    "split": "partition", "heal": "partition",
+    "clog": "clog", "unclog": "clog",
+    "spike_on": "spike", "spike_off": "spike",
+    "skew": "skew",
+}
+
 
 # --------------------------------------------------------------------------
 # clauses
@@ -392,6 +425,7 @@ class NemesisEvent:
     wipe: bool = False  # crash/restart: state-wipe variant
     ppm: int = 0  # skew
     extra_us: int = 0  # spike_on
+    k: int = -1  # clause occurrence index (the ddmin atom id; -1 = n/a)
 
     def __str__(self) -> str:
         t = self.t_us / 1e6
@@ -440,12 +474,14 @@ def plan_schedule(
             wipe = crash.wipe_rate > 0 and coin32(
                 key, NEM_SITE_CRASH_WIPE, crash.wipe_rate, index=k
             )
-            events.append(NemesisEvent(t, "crash", node=victim, wipe=wipe))
+            events.append(NemesisEvent(t, "crash", node=victim, wipe=wipe, k=k))
             t += randint32(key, NEM_SITE_CRASH_DOWN, crash.down_lo_us,
                            crash.down_hi_us, index=k)
             if t >= horizon_us:
                 break
-            events.append(NemesisEvent(t, "restart", node=victim, wipe=wipe))
+            events.append(
+                NemesisEvent(t, "restart", node=victim, wipe=wipe, k=k)
+            )
             k += 1
 
     part = plan.get(Partition)
@@ -460,12 +496,12 @@ def plan_schedule(
             for n in range(n_nodes):
                 if bits32(key, NEM_SITE_PART_SIDE, index=k * 64 + n) & 1:
                     mask |= 1 << n
-            events.append(NemesisEvent(t, "split", side_mask=mask))
+            events.append(NemesisEvent(t, "split", side_mask=mask, k=k))
             t += randint32(key, NEM_SITE_PART_HEAL, part.heal_lo_us,
                            part.heal_hi_us, index=k)
             if t >= horizon_us:
                 break
-            events.append(NemesisEvent(t, "heal", side_mask=mask))
+            events.append(NemesisEvent(t, "heal", side_mask=mask, k=k))
             k += 1
 
     clog = plan.get(LinkClog)
@@ -479,12 +515,12 @@ def plan_schedule(
             src = randint32(key, NEM_SITE_CLOG_SRC, 0, n_nodes, index=k)
             d = randint32(key, NEM_SITE_CLOG_DST, 0, n_nodes - 1, index=k)
             dst = d + (1 if d >= src else 0)
-            events.append(NemesisEvent(t, "clog", node=src, dst=dst))
+            events.append(NemesisEvent(t, "clog", node=src, dst=dst, k=k))
             t += randint32(key, NEM_SITE_CLOG_HEAL, clog.heal_lo_us,
                            clog.heal_hi_us, index=k)
             if t >= horizon_us:
                 break
-            events.append(NemesisEvent(t, "unclog", node=src, dst=dst))
+            events.append(NemesisEvent(t, "unclog", node=src, dst=dst, k=k))
             k += 1
 
     spike = plan.get(LatencySpike)
@@ -495,16 +531,44 @@ def plan_schedule(
                            spike.interval_hi_us, index=k)
             if t >= horizon_us:
                 break
-            events.append(NemesisEvent(t, "spike_on", extra_us=spike.extra_us))
+            events.append(
+                NemesisEvent(t, "spike_on", extra_us=spike.extra_us, k=k)
+            )
             t += randint32(key, NEM_SITE_SPIKE_DUR, spike.duration_lo_us,
                            spike.duration_hi_us, index=k)
             if t >= horizon_us:
                 break
-            events.append(NemesisEvent(t, "spike_off"))
+            events.append(NemesisEvent(t, "spike_off", k=k))
             k += 1
 
     events.sort()
     return events
+
+
+def filter_schedule(
+    events: Sequence[NemesisEvent],
+    occ_off: Optional[Dict[str, int]] = None,
+    drop_clauses: Sequence[str] = (),
+) -> List[NemesisEvent]:
+    """A shrunk schedule: drop whole clauses and/or masked occurrences.
+
+    `occ_off` maps a schedule-clause name ("crash", "partition", "clog",
+    "spike") to an occurrence bitmask — bit k set removes occurrence k
+    (both halves of its window: crash AND restart, split AND heal, ...).
+    This is the pure-schedule face of the engine's per-lane TriageCtl, so
+    a shrunk bundle's host twin compares against exactly this stream.
+    """
+    occ_off = occ_off or {}
+    drop = set(drop_clauses)
+    out: List[NemesisEvent] = []
+    for ev in events:
+        clause = CLAUSE_OF_EVENT.get(ev.kind)
+        if clause in drop:
+            continue
+        if ev.k >= 0 and (occ_off.get(clause, 0) >> ev.k) & 1:
+            continue
+        out.append(ev)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -544,13 +608,21 @@ class NemesisDriver:
         horizon_us: int,
         seed: Optional[int] = None,
         on_wipe: Optional[Callable[[int], None]] = None,
+        occ_off: Optional[Dict[str, int]] = None,
     ) -> None:
         self.plan = plan
         self.handle = handle
         self.node_ids = list(node_ids)
         self.on_wipe = on_wipe
         self.seed = handle.seed if seed is None else seed
-        self.schedule = plan.schedule(self.seed, horizon_us, len(self.node_ids))
+        self.occ_off = dict(occ_off or {})
+        # occ_off replays a SHRUNK plan (triage.py repro bundles): masked
+        # occurrences are skipped while the survivors keep their original
+        # times — the schedule stays a pure function of the seed
+        self.schedule = filter_schedule(
+            plan.schedule(self.seed, horizon_us, len(self.node_ids)),
+            self.occ_off,
+        )
         self.applied: List[NemesisEvent] = []
         self.fired: Dict[str, int] = {}
         self._installed = False

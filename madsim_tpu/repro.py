@@ -1,0 +1,269 @@
+"""Replay a triage repro bundle: `python -m madsim_tpu.repro bundle.json`.
+
+The counterpart of `madsim_tpu/triage.py`: a bundle is only worth shipping
+in a bug report if a fresh process — with no access to the sweep that found
+it — replays the violation bit-deterministically. This module is that
+check, as a library (`replay`) and a CLI:
+
+    python -m madsim_tpu.repro bundle.json                 # device replay
+    python -m madsim_tpu.repro bundle.json --backend host  # schedule twin
+    python -m madsim_tpu.repro bundle.json --trace 60      # + event tail
+
+Device replay (`--backend tpu`, the default) rebuilds the ProtocolSpec from
+the bundle's `spec_ref`, the SimConfig from its TOML (hash-checked), runs
+the seed under the bundle's shrink ctl TWICE, asserts the two final states
+are bitwise identical, and asserts the violation fires at the recorded
+step and virtual time.
+
+Host replay (`--backend host`) drives the bundle's SHRUNK FaultPlan through
+a fresh host runtime's NemesisDriver (idle nodes; the schedule needs no
+traffic) and asserts the applied fault stream equals the occurrence-filtered
+pure schedule — the twin invariant, surviving the shrink.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from .triage import ReproBundle
+
+
+class ReplayError(AssertionError):
+    """The bundle did not replay as recorded."""
+
+
+def resolve_spec(spec_ref: str, spec_kwargs: Optional[Dict[str, Any]] = None):
+    """Rebuild a ProtocolSpec from a dotted "module:factory" reference."""
+    mod_name, _, fn_name = spec_ref.partition(":")
+    if not mod_name or not fn_name:
+        raise ValueError(
+            f"spec_ref must look like 'package.module:factory', got {spec_ref!r}"
+        )
+    # bundles written inside a checkout reference test modules by their
+    # repo-relative dotted path; make the common case work from anywhere.
+    # Remove the exact entry we added (not pop(0)): the spec module's own
+    # import may mutate sys.path, and a positional pop would evict it.
+    cwd = os.getcwd()
+    sys.path.insert(0, cwd)
+    try:
+        mod = importlib.import_module(mod_name)
+    finally:
+        try:
+            sys.path.remove(cwd)
+        except ValueError:
+            pass
+    return getattr(mod, fn_name)(**(spec_kwargs or {}))
+
+
+def _configure_jax_cache() -> None:
+    """Persistent XLA cache (same location as the test suite): a repro run
+    in a fresh process should pay seconds, not a cold compile."""
+    try:
+        import jax
+    except ImportError:
+        return
+    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            f"/tmp/madsim_tpu_jaxcache-{os.getuid()}",
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+
+def replay_device(
+    bundle: ReproBundle,
+    spec=None,
+    repeats: int = 2,
+    trace: int = 0,
+    out=print,
+) -> Dict[str, Any]:
+    """Device replay: the violation must fire at the recorded step/time,
+    bit-identically across `repeats` runs. Returns a report dict."""
+    _configure_jax_cache()
+    import jax
+    import numpy as np
+
+    from .tpu.engine import BatchedSim
+    from .tpu.spec import REBASE_US
+
+    if spec is None:
+        if not bundle.spec_ref:
+            raise ReplayError(
+                "bundle has no spec_ref — pass the ProtocolSpec explicitly "
+                "(replay_device(bundle, spec=...)) or re-emit the bundle "
+                "with shrink_seed(spec_ref=...)"
+            )
+        spec = resolve_spec(bundle.spec_ref, bundle.spec_kwargs)
+    if spec.n_nodes != bundle.n_nodes:
+        raise ReplayError(
+            f"spec has {spec.n_nodes} nodes, bundle recorded {bundle.n_nodes}"
+        )
+    cfg = bundle.config()  # hash-checked
+    sim = BatchedSim(spec, cfg, triage=True)
+    ctl = bundle.ctl(1)
+    states = [
+        sim.run([bundle.seed], max_steps=bundle.max_steps, ctl=ctl)
+        for _ in range(max(1, repeats))
+    ]
+    a = states[0]
+    for i, b in enumerate(states[1:], start=2):
+        la = jax.tree_util.tree_leaves(a)
+        lb = jax.tree_util.tree_leaves(b)
+        for j, (x, y) in enumerate(zip(la, lb)):
+            if not np.array_equal(np.asarray(x), np.asarray(y)):
+                raise ReplayError(
+                    f"replay {i} diverged from replay 1 at state leaf {j} — "
+                    "the device stream is not bit-deterministic"
+                )
+    violated = bool(np.asarray(a.violated)[0])
+    step = int(np.asarray(a.violation_step)[0])
+    t_us = int(
+        np.asarray(a.violation_epoch, np.int64)[0] * REBASE_US
+        + np.asarray(a.violation_at, np.int64)[0]
+    )
+    if not violated:
+        raise ReplayError(
+            f"seed {bundle.seed} did NOT violate under the bundle's shrunk "
+            "configuration — stale bundle or schema drift"
+        )
+    if step != bundle.violation_step or t_us != bundle.violation_t_us:
+        raise ReplayError(
+            f"violation replayed at step {step} / t={t_us}us but the bundle "
+            f"recorded step {bundle.violation_step} / "
+            f"t={bundle.violation_t_us}us"
+        )
+    if trace > 0:
+        from .tpu.trace import trace_seed
+
+        events = trace_seed(
+            sim, bundle.seed, max_steps=step + 2,
+            kind_names=spec.msg_kind_names, ctl=ctl,
+        )
+        for e in events[-trace:]:
+            out(str(e))
+    out(
+        f"device replay OK: seed {bundle.seed} violates at step {step}, "
+        f"t={t_us}us, bit-identical across {max(1, repeats)} runs"
+    )
+    return {"violated": True, "step": step, "t_us": t_us, "repeats": repeats}
+
+
+def replay_host(bundle: ReproBundle, out=print) -> Dict[str, Any]:
+    """Host schedule twin: a fresh runtime's NemesisDriver applies exactly
+    the shrunk plan's occurrence-filtered pure schedule."""
+    import madsim_tpu as ms
+    from .nemesis import NemesisDriver, filter_schedule
+
+    plan = bundle.shrunk_plan()
+    horizon_us = int(bundle.horizon_us)
+    n = int(bundle.n_nodes)
+
+    async def body():
+        handle = ms.Handle.current()
+
+        async def idle():
+            while True:
+                await ms.time.sleep(3600.0)
+
+        nodes = [
+            handle.create_node().name(f"r{i}").ip(f"10.9.9.{i + 1}")
+            .init(idle).build()
+            for i in range(n)
+        ]
+        driver = NemesisDriver(
+            plan, handle, [nd.id for nd in nodes], horizon_us=horizon_us,
+            seed=bundle.seed, occ_off=bundle.occ_off,
+        )
+        driver.install()
+        t = ms.time.current()
+        end = t.elapsed() + horizon_us / 1e6 + 0.001
+        while t.elapsed() < end:
+            await ms.time.sleep(0.05)
+        return driver
+
+    rt = ms.Runtime(seed=bundle.seed)
+    driver = rt.block_on(body())
+    want = [
+        e for e in filter_schedule(
+            plan.schedule(bundle.seed, horizon_us, n), bundle.occ_off
+        )
+        if e.kind != "skew"  # applied at install time, not replayed
+    ]
+    got = list(driver.applied)
+    if got != want:
+        raise ReplayError(
+            "host driver stream diverged from the shrunk pure schedule:\n"
+            f"  want ({len(want)}): {[str(e) for e in want]}\n"
+            f"  got  ({len(got)}): {[str(e) for e in got]}"
+        )
+    out(
+        f"host schedule twin OK: {len(want)} shrunk fault events applied "
+        "exactly as scheduled"
+    )
+    return {"events": len(want)}
+
+
+def replay(
+    bundle: ReproBundle, backend: str = "tpu", spec=None, repeats: int = 2,
+    trace: int = 0, out=print,
+) -> Dict[str, Any]:
+    if backend == "tpu":
+        return replay_device(
+            bundle, spec=spec, repeats=repeats, trace=trace, out=out
+        )
+    if backend == "host":
+        return replay_host(bundle, out=out)
+    if backend == "both":
+        rep = replay_device(
+            bundle, spec=spec, repeats=repeats, trace=trace, out=out
+        )
+        rep.update(replay_host(bundle, out=out))
+        return rep
+    raise ValueError(f"unknown backend {backend!r} (tpu|host|both)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m madsim_tpu.repro",
+        description="Replay a triage repro bundle and assert the violation "
+        "still fires (see docs/triage.md).",
+    )
+    p.add_argument("bundle", help="path to a repro bundle JSON")
+    p.add_argument(
+        "--backend", choices=("tpu", "host", "both"), default="tpu",
+        help="tpu: replay the violation on the batched engine; host: assert "
+        "the shrunk plan's schedule twin on the host runtime",
+    )
+    p.add_argument(
+        "--spec-ref", default=None,
+        help="override the bundle's 'module:factory' ProtocolSpec reference",
+    )
+    p.add_argument(
+        "--repeats", type=int, default=2,
+        help="device replays to compare bitwise (default 2)",
+    )
+    p.add_argument(
+        "--trace", type=int, default=0, metavar="N",
+        help="print the last N trace events of the replayed violation",
+    )
+    args = p.parse_args(argv)
+    bundle = ReproBundle.load(args.bundle)
+    if args.spec_ref:
+        bundle.spec_ref = args.spec_ref
+    try:
+        replay(
+            bundle, backend=args.backend, repeats=args.repeats,
+            trace=args.trace,
+        )
+    except (ReplayError, ValueError) as e:
+        print(f"REPLAY FAILED: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

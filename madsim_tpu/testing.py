@@ -59,13 +59,39 @@ class UnpicklableResult:
         return f"UnpicklableResult({self.repr})"
 
 
+def single_seed_repro_command(seed: int) -> str:
+    """The exact one-liner that re-runs ONE failing seed: env (seed, count,
+    and any config/time-limit overrides active in this run) plus the pytest
+    node id when running under pytest — CI logs become self-serve repros
+    instead of "go find the test and guess the env"."""
+    import shlex
+
+    env = os.environ
+    parts = [f"MADSIM_TEST_SEED={seed}", "MADSIM_TEST_NUM=1"]
+    for var in ("MADSIM_TEST_CONFIG", "MADSIM_TEST_TIME_LIMIT"):
+        if var in env:
+            parts.append(f"{var}={shlex.quote(env[var])}")
+    current = env.get("PYTEST_CURRENT_TEST", "")
+    if current:
+        # "tests/test_x.py::test_y[param with spaces] (call)" -> the node
+        # id: strip only the trailing " (stage)" suffix, never split a
+        # parametrized id on its own spaces
+        node_id = current.rsplit(" (", 1)[0]
+        parts.append(f"python -m pytest {shlex.quote(node_id)} -x")
+    else:
+        parts.append("<rerun the test entry point>")
+    return " ".join(parts)
+
+
 class TestFailure(AssertionError):
-    """A seed in the sweep failed; carries the repro seed."""
+    """A seed in the sweep failed; carries the repro seed and the exact
+    single-seed repro command (env + seed + pytest marker)."""
 
     def __init__(self, seed: int, cause: BaseException) -> None:
+        self.repro_command = single_seed_repro_command(seed)
         super().__init__(
             f"seed={seed} failed: {type(cause).__name__}: {cause}\n"
-            f"    reproduce with: MADSIM_TEST_SEED={seed}"
+            f"    reproduce with: {self.repro_command}"
         )
         self.seed = seed
         self.__cause__ = cause

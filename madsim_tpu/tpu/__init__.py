@@ -14,7 +14,9 @@ from .engine import (  # noqa: F401
     SimState,
     StragPool,
     TraceRecord,
+    TriageCtl,
     abs_time_us,
+    default_ctl,
     summarize,
 )
 from .kv import KvState, kv_workload, make_kv_spec  # noqa: F401

@@ -88,15 +88,45 @@ def _assert_runs_bitwise_equal(a: SimState, b: SimState, context: str) -> None:
 
 
 class BatchViolation(AssertionError):
-    """Violations found in a batch; carries repro seeds (builder.rs DX analog)."""
+    """Violations found in a batch; carries repro seeds (builder.rs DX
+    analog), the exact single-seed repro command, and — when the sweep ran
+    with shrink_on_violation — the shrunk repro bundle's path and replay
+    one-liner (madsim_tpu/triage.py)."""
 
-    def __init__(self, seeds: List[int], detail: str) -> None:
+    def __init__(
+        self, seeds: List[int], detail: str,
+        bundle_path: Optional[str] = None,
+        bundle: Any = None,
+    ) -> None:
+        from ..testing import single_seed_repro_command
+
         shown = ", ".join(str(s) for s in seeds[:16])
         more = "" if len(seeds) <= 16 else f" (+{len(seeds) - 16} more)"
-        super().__init__(
+        self.repro_command = single_seed_repro_command(seeds[0])
+        self.bundle_path = bundle_path
+        msg = (
             f"{len(seeds)} violating seed(s): {shown}{more}\n    {detail}\n"
-            f"    reproduce one with: MADSIM_TEST_SEED={seeds[0]}"
+            f"    reproduce one with: {self.repro_command}"
         )
+        if bundle_path:
+            msg += f"\n    shrunk repro bundle: {bundle_path}"
+            if bundle is not None and not getattr(bundle, "spec_ref", None):
+                # a bundle without a spec factory reference can't rebuild
+                # the ProtocolSpec in a fresh process — advertise only the
+                # commands that actually work, and say what's missing
+                msg += (
+                    f"\n    replay the shrunk fault schedule with: "
+                    f"python -m madsim_tpu.repro {bundle_path} --backend host"
+                    f"\n    (device replay needs --spec-ref "
+                    f"'your.module:spec_factory' — or pass spec_ref= in "
+                    f"shrink_kwargs to bake it into the bundle)"
+                )
+            else:
+                msg += (
+                    f"\n    replay it with: "
+                    f"python -m madsim_tpu.repro {bundle_path}"
+                )
+        super().__init__(msg)
         self.seeds = seeds
 
 
@@ -114,6 +144,11 @@ class BatchResult:
     # lists): the full trajectory that violated — deliveries, timers,
     # crashes, partitions — debuggable with no host twin
     traces: Dict[int, list] = dataclasses.field(default_factory=dict)
+    # the workload that ran (so .shrink() can rebuild the triage sim), and
+    # the shrunk repro bundle when run_batch(shrink_on_violation=True)
+    workload: Optional["BatchWorkload"] = None
+    bundle: Any = None  # triage.ReproBundle | None
+    bundle_path: Optional[str] = None
 
     @property
     def violations(self) -> int:
@@ -137,11 +172,35 @@ class BatchResult:
     def violating_seeds(self) -> List[int]:
         return [int(s) for s in self.seeds[self.violated]]
 
+    def shrink(self, seed: Optional[int] = None, **kwargs):
+        """Shrink one violating seed (default: the first) into a minimal,
+        portable repro bundle — see madsim_tpu.triage.shrink_seed for the
+        keyword surface (out_dir, spec_ref, lane_width, ...). Returns the
+        triage.ShrinkResult and remembers the bundle on this result."""
+        from .. import triage
+
+        if self.workload is None:
+            raise ValueError(
+                "this BatchResult carries no workload — run it through "
+                "run_batch (or set result.workload) before shrinking"
+            )
+        if seed is None:
+            if not self.violations:
+                raise ValueError("no violating seeds to shrink")
+            seed = self.violating_seeds[0]
+        kwargs.setdefault("out_dir", triage.default_bundle_dir())
+        sr = triage.shrink_seed(self.workload, seed, **kwargs)
+        self.bundle = sr.bundle
+        self.bundle_path = sr.bundle_path
+        return sr
+
     def raise_on_violation(self) -> None:
         if self.violations:
             raise BatchViolation(
                 self.violating_seeds,
                 f"summary: {self.summary}",
+                bundle_path=self.bundle_path,
+                bundle=self.bundle,
             )
 
 
@@ -176,6 +235,8 @@ def run_batch(
     max_traces: int = 2,
     mesh: Any = "auto",
     check_determinism: bool = False,
+    shrink_on_violation: bool = False,
+    shrink_kwargs: Optional[Dict[str, Any]] = None,
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
 
@@ -195,6 +256,12 @@ def run_batch(
     results are bit-identical whatever the mesh: no engine draw folds the
     lane index, so a trajectory never depends on which device (or batch
     position) its lane landed on.
+
+    `shrink_on_violation` closes the triage loop: the first violating seed
+    is automatically ddmin-shrunk into a minimal, portable repro bundle
+    (madsim_tpu/triage.py; a handful of extra batched dispatches), written
+    under triage.default_bundle_dir() unless shrink_kwargs["out_dir"] says
+    otherwise, and reported in BatchViolation with its replay one-liner.
     """
     seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
     if seeds_arr.ndim != 1 or seeds_arr.size == 0:
@@ -240,7 +307,11 @@ def run_batch(
         for k, v in s.items():
             if not isinstance(v, (int, float)):
                 continue
-            if k.startswith("mean_"):
+            if k == "first_violation_step":
+                # a per-chunk MINIMUM: summing chunk minima would fabricate
+                # a step index no lane violated at
+                totals[k] = min(totals.get(k, v), v)
+            elif k.startswith("mean_"):
                 # lane-weighted average across chunks, not a sum of means
                 totals[k] = totals.get(k, 0) + v * part.size
                 weights[k] = weights.get(k, 0) + part.size
@@ -267,7 +338,25 @@ def run_batch(
         deadlocked=deadlocked,
         summary=totals,
         state=state,
+        workload=workload,
     )
+
+    if result.violations and shrink_on_violation:
+        # auto-triage: ddmin the FIRST violating seed into a minimal repro
+        # bundle (a handful of extra device dispatches; see triage.py).
+        # raise_on_violation and batch_test then report the bundle path.
+        # A triage failure must never eat the primary result — which seeds
+        # violated — so it degrades to a warning and the normal report.
+        try:
+            result.shrink(**(shrink_kwargs or {}))
+        except Exception as e:  # noqa: BLE001 - opt-in convenience step
+            import warnings
+
+            warnings.warn(
+                f"shrink_on_violation failed ({type(e).__name__}: {e}); "
+                "reporting the unshrunken violation",
+                stacklevel=2,
+            )
 
     if result.violations and max_traces > 0:
         # device-side microscope: re-run violating seeds with event capture
@@ -293,6 +382,8 @@ def batch_test(
     workload: BatchWorkload,
     default_num: int = 1024,
     expect_violations: bool = False,
+    shrink_on_violation: bool = False,
+    shrink_kwargs: Optional[Dict[str, Any]] = None,
 ):
     """Decorator: run the env-configured seed range as ONE device batch.
 
@@ -335,23 +426,12 @@ def batch_test(
                     float(env["MADSIM_TEST_TIME_LIMIT"]) * 1e6
                 )
             if "MADSIM_TEST_CONFIG" in env:
-                try:
-                    import tomllib
-                except ImportError:  # Python < 3.11: vendored reader
-                    from .. import _toml as tomllib
+                from .spec import simconfig_dict_from_toml
 
-                with open(env["MADSIM_TEST_CONFIG"], "rb") as f:
-                    doc = tomllib.load(f)
-                cfg_fields = {
-                    fld.name for fld in dataclasses.fields(SimConfig)
-                }
-                unknown = set(doc) - cfg_fields
-                if unknown:
-                    raise ValueError(
-                        f"MADSIM_TEST_CONFIG: unknown SimConfig fields "
-                        f"{sorted(unknown)}"
-                    )
-                overrides.update(doc)
+                with open(env["MADSIM_TEST_CONFIG"], encoding="utf-8") as f:
+                    overrides.update(simconfig_dict_from_toml(
+                        f.read(), context="MADSIM_TEST_CONFIG"
+                    ))
             if overrides:
                 wl = dataclasses.replace(
                     wl,
@@ -360,9 +440,14 @@ def batch_test(
                     ),
                 )
             result = run_batch(
-                range(first, first + num), wl, check_determinism=check
+                range(first, first + num), wl, check_determinism=check,
+                shrink_on_violation=shrink_on_violation,
+                shrink_kwargs=shrink_kwargs,
             )
             if not expect_violations:
+                # the raised BatchViolation carries the single-seed repro
+                # command (env + pytest node id) and, when shrinking ran,
+                # the bundle path + replay one-liner
                 result.raise_on_violation()
             return fn(result, *args, **kwargs)
 

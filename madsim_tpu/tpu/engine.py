@@ -76,6 +76,11 @@ from ..nemesis import (
     COIN_DENOM,
     FIRE_INDEX,
     FIRE_KINDS,
+    OCC_CLAUSES,
+    OCC_ROW,
+    RATE_CLAUSES,
+    RATE_ROW,
+    TRIAGE_BIT,
     NEM_SITE_CLOG_DST,
     NEM_SITE_CLOG_HEAL,
     NEM_SITE_CLOG_IV,
@@ -161,6 +166,60 @@ class NemesisState(NamedTuple):
     skew: Any  # f32 [L,N] per-node timer rate (1.0 = none) | None
 
 
+class TriageCtl(NamedTuple):
+    """Per-lane shrink controls (present iff `BatchedSim(..., triage=True)`).
+
+    The triage subsystem (madsim_tpu/triage.py) evaluates every ddmin
+    shrink candidate as a LANE of one batched dispatch: all lanes share
+    the full plan's compiled knobs, and these tensors switch clauses,
+    individual clause occurrences, message-coin rates and the time horizon
+    off PER LANE. Disabling never perturbs anything else's draws — clause
+    times/victims are indexed by (lane base key, clause site, occurrence)
+    and a disabled occurrence still advances the timing machinery through
+    its window — so a shrink candidate IS the original seed's trajectory
+    minus exactly the suppressed faults, and one compiled step program
+    serves every generation of the shrink.
+    """
+
+    off: Any  # i32 [L] clause-disable bitmask over nemesis.TRIAGE_CLAUSES
+    occ: Any  # i32 [L, 4] occurrence-disable bitmasks (nemesis.OCC_CLAUSES
+    #           rows; bit k suppresses occurrence k; occurrences past the
+    #           mask are always enabled — triage.py caps atoms at bit 30,
+    #           the int32 sign bit being unusable)
+    rate_scale: Any  # f32 [L, 3] scales the loss/dup/reorder coin rates
+    #           (nemesis.RATE_CLAUSES rows; the coin is `u < rate * scale`,
+    #           so a scaled-down lane's fires are a SUBSET of the full run's)
+    h_epoch: Any  # i32 [L] per-lane horizon, epoch part (see REBASE_US)
+    h_off: Any  # i32 [L] per-lane horizon, offset part
+
+
+def default_ctl(L: int, horizon_us: int) -> TriageCtl:
+    """The no-op ctl: every clause and occurrence on, full horizon."""
+    eh, oh = divmod(int(horizon_us), REBASE_US)
+    return TriageCtl(
+        off=jnp.zeros((L,), jnp.int32),
+        occ=jnp.zeros((L, len(OCC_CLAUSES)), jnp.int32),
+        rate_scale=jnp.ones((L, len(RATE_CLAUSES)), jnp.float32),
+        h_epoch=jnp.full((L,), eh, jnp.int32),
+        h_off=jnp.full((L,), oh, jnp.int32),
+    )
+
+
+def _clause_on(ctl: TriageCtl, name: str) -> jnp.ndarray:
+    """bool [L]: clause `name` enabled per lane."""
+    return (ctl.off & TRIAGE_BIT[name]) == 0
+
+
+def _occ_on(ctl: TriageCtl, name: str, k) -> jnp.ndarray:
+    """bool [L]: occurrence `k` of schedule clause `name` enabled per lane
+    (k: i32 [L], the lane's current occurrence counter)."""
+    bit = (
+        ctl.occ[:, OCC_ROW[name]].astype(jnp.uint32)
+        >> jnp.clip(k, 0, 31).astype(jnp.uint32)
+    ) & jnp.uint32(1)
+    return _clause_on(ctl, name) & ((bit == 0) | (k >= 32))
+
+
 class TraceRecord(NamedTuple):
     """One step's observable events, for per-lane violation traces.
 
@@ -205,6 +264,9 @@ class SimState(NamedTuple):
     violated: Any  # bool [L]
     violation_at: Any  # i32 [L] (offset; INF_US = none)
     violation_epoch: Any  # i32 [L]
+    violation_step: Any  # i32 [L] first violating step index (-1 = none;
+    #            with run(max_steps=step+1) this is the run-to-step
+    #            truncation handle the triage shrinker bisects to)
     deadlocked: Any  # bool [L]
     steps: Any  # i32 [L]
     events: Any  # i32 [L]
@@ -224,6 +286,7 @@ class SimState(NamedTuple):
     msgs: MsgPool
     strag: Any  # StragPool | None (None unless buggify_delay_rate > 0)
     nem: Any  # NemesisState | None (None unless a nemesis clause is on)
+    ctl: Any  # TriageCtl | None (None unless BatchedSim(triage=True))
 
 
 def _first_free(free: jnp.ndarray, K: int) -> jnp.ndarray:
@@ -255,9 +318,18 @@ def _tree_where(mask: jnp.ndarray, a: Any, b: Any) -> Any:
 class BatchedSim:
     """Vectorized multi-lane simulator for one ProtocolSpec."""
 
-    def __init__(self, spec: ProtocolSpec, config: Optional[SimConfig] = None) -> None:
+    def __init__(
+        self, spec: ProtocolSpec, config: Optional[SimConfig] = None,
+        triage: bool = False,
+    ) -> None:
+        """`triage=True` threads a per-lane `TriageCtl` through the state:
+        the same compiled step program then evaluates shrink candidates
+        (clauses / occurrences / rates / horizons switched off per lane)
+        as lanes of one dispatch — see madsim_tpu/triage.py. Off by
+        default: normal sweeps pay nothing for it."""
         self.spec = spec
         self.config = config or SimConfig()
+        self.triage = bool(triage)
         cfg = self.config
         N = spec.n_nodes
         # fail loudly at construction, not as shape errors deep inside jit
@@ -492,11 +564,20 @@ class BatchedSim:
 
     # ------------------------------------------------------------------ init
 
-    def _init(self, seeds: jnp.ndarray) -> SimState:
-        """Build lane state for a batch of seeds (int array [L])."""
+    def _init(self, seeds: jnp.ndarray, ctl=None) -> SimState:
+        """Build lane state for a batch of seeds (int array [L]).
+
+        `ctl` (triage mode only) carries the per-lane shrink controls; by
+        default every clause is on and the horizon is the config's."""
         spec, cfg = self.spec, self.config
         seeds = jnp.asarray(seeds, jnp.uint32)
         L, N, CK = seeds.shape[0], spec.n_nodes, self._CK
+        if ctl is not None and not self.triage:
+            raise ValueError(
+                "a TriageCtl requires BatchedSim(..., triage=True)"
+            )
+        if self.triage and ctl is None:
+            ctl = default_ctl(L, cfg.horizon_us)
 
         key = prng.key_from(seeds)  # u32 [L]
         node_keys = prng.fold(key[:, None], jnp.arange(N, dtype=jnp.uint32))
@@ -514,8 +595,15 @@ class BatchedSim:
                 index=jnp.arange(N, dtype=jnp.uint32)[None, :],
             )  # [L,N]
             skew = jnp.float32(1.0) + ppm.astype(jnp.float32) * jnp.float32(1e-6)
+            skew_applied = ppm != 0
+            if self.triage:
+                # a skew-disabled lane runs every node at rate 1.0; the ppm
+                # draws still happen (sites untouched), they just don't apply
+                en_skew = _clause_on(ctl, "skew")
+                skew = jnp.where(en_skew[:, None], skew, jnp.float32(1.0))
+                skew_applied = skew_applied & en_skew[:, None]
             fires = fires.at[:, FIRE_INDEX["skew"]].set(
-                (ppm != 0).sum(axis=1, dtype=jnp.int32)
+                skew_applied.sum(axis=1, dtype=jnp.int32)
             )
             # initial timers are armed at local t=0: scale the delay
             sk_ok = (timer >= 0) & (timer < INF_GUARD)
@@ -597,6 +685,7 @@ class BatchedSim:
             violated=jnp.zeros((L,), jnp.bool_),
             violation_at=jnp.full((L,), INF_US, jnp.int32),
             violation_epoch=jnp.zeros((L,), jnp.int32),
+            violation_step=jnp.full((L,), -1, jnp.int32),
             deadlocked=jnp.zeros((L,), jnp.bool_),
             steps=jnp.zeros((L,), jnp.int32),
             events=jnp.zeros((L,), jnp.int32),
@@ -619,6 +708,7 @@ class BatchedSim:
             ),
             strag=strag,
             nem=nem,
+            ctl=ctl,
         )
 
     # ------------------------------------------------------------------ step
@@ -788,6 +878,7 @@ class BatchedSim:
         # masks are false. One tree pass merges all three outcomes instead
         # of three full-state passes.
         any_crash = cfg.any_crash_enabled
+        ctl: Optional[TriageCtl] = state.ctl
         if any_crash:
             chaos_due = active & (state.chaos_at <= t_next)
             is_restart_evt = state.crashed >= 0
@@ -802,9 +893,25 @@ class BatchedSim:
                 )
             else:
                 victim = prng.randint(ckey, 1, 0, N)
-            crash_mask = do_crash[:, None] & (node_ids == victim[:, None])
+            # triage: a suppressed occurrence keeps the timing machinery
+            # (chaos_at / crashed / crash_k advance through the window as
+            # always — do_crash/do_restart below) but applies NO effect:
+            # ap_* gate the kill, the restart handler, the pool drops, the
+            # trace rows and the fire counts. Later occurrences keep their
+            # schedule-pure times, so one dropped atom never moves another.
+            if self.triage:
+                k_idx = (
+                    state.nem.crash_k if cfg.nem_crash_enabled
+                    else jnp.zeros((L,), jnp.int32)
+                )
+                crash_en = _occ_on(ctl, "crash", k_idx)
+            else:
+                crash_en = jnp.ones((L,), jnp.bool_)
+            ap_crash = do_crash & crash_en
+            ap_restart = do_restart & crash_en
+            crash_mask = ap_crash[:, None] & (node_ids == victim[:, None])
             restart_node = jnp.clip(state.crashed, 0, N - 1)
-            restart_mask = do_restart[:, None] & (node_ids == restart_node[:, None])
+            restart_mask = ap_restart[:, None] & (node_ids == restart_node[:, None])
         else:
             restart_mask = None
 
@@ -832,6 +939,10 @@ class BatchedSim:
                         for f in spec.time_fields
                     })
                 wipe_mask = restart_mask & state.nem.wipe[:, None]
+                if self.triage:
+                    # wipe is its own triage atom: with it off, the crash
+                    # occurrence still happens but restarts via on_restart
+                    wipe_mask = wipe_mask & _clause_on(ctl, "wipe")[:, None]
                 ns_r = _tree_where(wipe_mask, ns_w, ns_r)
                 timer_r = jnp.where(wipe_mask, timer_w, timer_r)
 
@@ -986,14 +1097,14 @@ class BatchedSim:
             crashed = jnp.where(
                 do_crash, victim, jnp.where(do_restart, -1, state.crashed)
             )
-            tr_crash = jnp.where(do_crash, victim, -1)
-            tr_restart = jnp.where(do_restart, restart_node, -1)
+            tr_crash = jnp.where(ap_crash, victim, -1)
+            tr_restart = jnp.where(ap_restart, restart_node, -1)
             # in-flight messages to a crashed node are lost (reset_node closes
             # sockets, network.rs:142-147): its pool slice simply empties
             valid = valid & ~crash_mask[:, :, None]
             if self._B:
                 svalid = svalid & ~(
-                    do_crash[:, None] & (strag.dst == victim[:, None])
+                    ap_crash[:, None] & (strag.dst == victim[:, None])
                 )
 
         # -- 5b. partition chaos: random bipartition splits, later heals ----
@@ -1058,14 +1169,27 @@ class BatchedSim:
                     clock + heal_delay,
                     jnp.where(do_heal, clock + next_split, state.part_at),
                 )
+            if self.triage:
+                pk_idx = (
+                    state.nem.part_k if cfg.nem_partition_enabled
+                    else jnp.zeros((L,), jnp.int32)
+                )
+                part_en = _occ_on(ctl, "partition", pk_idx)
+            else:
+                part_en = jnp.ones((L,), jnp.bool_)
+            # a suppressed occurrence toggles `partitioned` (timing) but
+            # never touches link_ok: its heal is then a no-op on links that
+            # were never cut (part_k is the same k at split and heal)
+            ap_split = do_split & part_en
+            ap_heal = do_heal & part_en
             same_side = side[:, :, None] == side[:, None, :]  # [L,N,N]
             link_ok = jnp.where(
-                do_split[:, None, None],
+                ap_split[:, None, None],
                 same_side,
-                jnp.where(do_heal[:, None, None], True, state.link_ok),
+                jnp.where(ap_heal[:, None, None], True, state.link_ok),
             )
             partitioned = (state.partitioned | do_split) & ~do_heal
-            tr_split, tr_heal = do_split, do_heal
+            tr_split, tr_heal = ap_split, ap_heal
             tr_side = (
                 side.astype(jnp.int32) * (1 << jnp.arange(N, dtype=jnp.int32))
             ).sum(-1)
@@ -1077,6 +1201,7 @@ class BatchedSim:
         tr_clog_dst = jnp.full((L,), -1, jnp.int32)
         tr_unclog = jnp.zeros((L,), jnp.bool_)
         clogged = clog_src = clog_dst = None
+        clog_en = None
         nem_clog_at = nem_clog_k = None
         if cfg.nem_clog_enabled:
             nst = state.nem
@@ -1084,6 +1209,13 @@ class BatchedSim:
             do_clog = clog_due & ~nst.clogged
             do_unclog = clog_due & nst.clogged
             kk = nst.clog_k
+            # triage: clog_k names the window open (or opening) this step,
+            # so one gate covers the toggle trace rows AND every in-window
+            # send filtered below (the window still opens/closes on time)
+            clog_en = (
+                _occ_on(ctl, "clog", kk) if self.triage
+                else jnp.ones((L,), jnp.bool_)
+            )
             src_d = prng.randint(state.key0, NEM_SITE_CLOG_SRC, 0, N, index=kk)
             dst_d = prng.randint(
                 state.key0, NEM_SITE_CLOG_DST, 0, N - 1, index=kk
@@ -1105,12 +1237,13 @@ class BatchedSim:
                 jnp.where(do_unclog, nst.clog_at + next_d, nst.clog_at),
             )
             nem_clog_k = kk + do_unclog.astype(jnp.int32)
-            tr_clog_src = jnp.where(do_clog, src_d, -1)
-            tr_clog_dst = jnp.where(do_clog, dst_d, -1)
-            tr_unclog = do_unclog
+            tr_clog_src = jnp.where(do_clog & clog_en, src_d, -1)
+            tr_clog_dst = jnp.where(do_clog & clog_en, dst_d, -1)
+            tr_unclog = do_unclog & clog_en
         tr_spike_on = jnp.zeros((L,), jnp.bool_)
         tr_spike_off = jnp.zeros((L,), jnp.bool_)
         spiking = None
+        spike_en = None
         nem_spike_at = nem_spike_k = None
         if cfg.nem_spike_enabled:
             nst = state.nem
@@ -1118,6 +1251,10 @@ class BatchedSim:
             do_spike = spike_due & ~nst.spiking
             do_unspike = spike_due & nst.spiking
             sk = nst.spike_k
+            spike_en = (
+                _occ_on(ctl, "spike", sk) if self.triage
+                else jnp.ones((L,), jnp.bool_)
+            )
             spiking = (nst.spiking | do_spike) & ~do_unspike
             dur_d = prng.randint(
                 state.key0, NEM_SITE_SPIKE_DUR, cfg.nem_spike_duration_lo_us,
@@ -1132,7 +1269,8 @@ class BatchedSim:
                 jnp.where(do_unspike, nst.spike_at + next_d, nst.spike_at),
             )
             nem_spike_k = sk + do_unspike.astype(jnp.int32)
-            tr_spike_on, tr_spike_off = do_spike, do_unspike
+            tr_spike_on = do_spike & spike_en
+            tr_spike_off = do_unspike & spike_en
 
         # -- 6. collect outboxes, roll the network, pack into pool ---------
         def flat(out: Outbox, emitting, e):  # [L,N,e,...] -> [L, N*e, ...]
@@ -1163,9 +1301,18 @@ class BatchedSim:
             # candidate (position 2c+1 mirrors 2c); the copy rolls its own
             # loss/latency below, so it can arrive reordered or die alone
             bidx = jnp.arange(self._Cb, dtype=jnp.uint32)[None, :]
-            dcoin = prng.bernoulli(
-                net_key, NET_SITE_DUP, cfg.nem_dup_rate, index=bidx
-            )
+            if self.triage:
+                # per-lane scaled rate on the SAME uniform stream
+                # (bernoulli is `uniform < p`): a scaled-down lane's dup
+                # set is a strict subset of the full-rate lane's
+                p_dup = (
+                    jnp.float32(cfg.nem_dup_rate)
+                    * ctl.rate_scale[:, RATE_ROW["dup"]]
+                    * _clause_on(ctl, "dup").astype(jnp.float32)
+                )[:, None]
+            else:
+                p_dup = cfg.nem_dup_rate
+            dcoin = prng.uniform(net_key, NET_SITE_DUP, index=bidx) < p_dup
             dup_fires = (cand_valid & dcoin).sum(axis=1, dtype=jnp.int32)
 
             def il(x):
@@ -1211,6 +1358,8 @@ class BatchedSim:
                 & (src_const[None, :] == clog_src[:, None])
                 & (cand_dst == clog_dst[:, None])
             )
+            if self.triage:
+                clog_hit = clog_hit & clog_en[:, None]
             keep = keep & ~clog_hit
         if cfg.nem_loss_rate > 0:
             # nemesis extra loss coin, rolled LAST — only on messages that
@@ -1220,7 +1369,15 @@ class BatchedSim:
             # host NetSim counts too (its clog check precedes the coin);
             # the coverage report reads the same on both backends
             u2 = prng.uniform(net_key, NET_SITE_NEM_LOSS, index=cidx)
-            nem_lost = keep & (u2 < cfg.nem_loss_rate)
+            if self.triage:
+                p_loss = (
+                    jnp.float32(cfg.nem_loss_rate)
+                    * ctl.rate_scale[:, RATE_ROW["loss"]]
+                    * _clause_on(ctl, "loss").astype(jnp.float32)
+                )[:, None]
+            else:
+                p_loss = cfg.nem_loss_rate
+            nem_lost = keep & (u2 < p_loss)
             loss_drops = nem_lost.sum(axis=1, dtype=jnp.int32)
             keep = keep & ~nem_lost
         else:
@@ -1229,8 +1386,16 @@ class BatchedSim:
             # bounded reordering: an extra uniform delay in [0, window] —
             # latency only LENGTHENS, so the conservative lookahead bound
             # (latency_lo) is untouched while later sends overtake
-            rcoin = keep & prng.bernoulli(
-                net_key, NET_SITE_REORDER, cfg.nem_reorder_rate, index=cidx
+            if self.triage:
+                p_ro = (
+                    jnp.float32(cfg.nem_reorder_rate)
+                    * ctl.rate_scale[:, RATE_ROW["reorder"]]
+                    * _clause_on(ctl, "reorder").astype(jnp.float32)
+                )[:, None]
+            else:
+                p_ro = cfg.nem_reorder_rate
+            rcoin = keep & (
+                prng.uniform(net_key, NET_SITE_REORDER, index=cidx) < p_ro
             )
             extra = prng.randint(
                 net_key, NET_SITE_REORDER_EXTRA, 0,
@@ -1241,8 +1406,10 @@ class BatchedSim:
         else:
             reorder_fires = jnp.zeros((L,), jnp.int32)
         if cfg.nem_spike_enabled:
+            spike_open = spiking & spike_en if self.triage else spiking
             lat = jnp.where(
-                spiking[:, None], lat + jnp.int32(cfg.nem_spike_extra_us), lat
+                spike_open[:, None], lat + jnp.int32(cfg.nem_spike_extra_us),
+                lat,
             )
         if self._B:
             # the rand_delay buggify tail (net/mod.rs:287-295): a surviving
@@ -1431,17 +1598,20 @@ class BatchedSim:
             )
 
         if any_crash:
-            _count("crash", do_crash)
-            _count("restart", do_restart)
+            _count("crash", ap_crash)
+            _count("restart", ap_restart)
             if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
-                _count("wipe", do_crash & wipe_coin)
+                ap_wipe = ap_crash & wipe_coin
+                if self.triage:
+                    ap_wipe = ap_wipe & _clause_on(ctl, "wipe")
+                _count("wipe", ap_wipe)
         if cfg.any_partition_enabled:
-            _count("partition", do_split)
-            _count("heal", do_heal)
+            _count("partition", ap_split)
+            _count("heal", ap_heal)
         if cfg.nem_clog_enabled:
-            _count("clog", do_clog)
+            _count("clog", do_clog & clog_en)
         if cfg.nem_spike_enabled:
-            _count("spike", do_spike)
+            _count("spike", do_spike & spike_en)
         _count("loss", loss_drops)
         _count("dup", dup_fires)
         _count("reorder", reorder_fires)
@@ -1454,11 +1624,23 @@ class BatchedSim:
         violation_at = jnp.where(new_violation, clock, state.violation_at)
         violation_epoch = jnp.where(new_violation, state.epoch,
                                     state.violation_epoch)
-        # horizon in (epoch, offset) space: horizon_us may exceed int32
-        eh, oh = divmod(int(cfg.horizon_us), REBASE_US)
-        reached_horizon = (state.epoch > eh) | (
-            (state.epoch == eh) & (clock >= oh)
+        # first violating step index: state.steps is the count of completed
+        # active steps BEFORE this one, i.e. this step's 0-based index —
+        # run(max_steps=violation_step + 1) re-reaches the violation
+        violation_step = jnp.where(
+            new_violation, state.steps, state.violation_step
         )
+        # horizon in (epoch, offset) space: horizon_us may exceed int32
+        if self.triage:
+            # per-lane horizon: the shrinker's time-truncation axis
+            reached_horizon = (state.epoch > ctl.h_epoch) | (
+                (state.epoch == ctl.h_epoch) & (clock >= ctl.h_off)
+            )
+        else:
+            eh, oh = divmod(int(cfg.horizon_us), REBASE_US)
+            reached_horizon = (state.epoch > eh) | (
+                (state.epoch == eh) & (clock >= oh)
+            )
         done = state.done | deadlocked | reached_horizon | violated
 
         # -- 8. epoch rebase: unbounded virtual time, int32 arithmetic -----
@@ -1521,6 +1703,7 @@ class BatchedSim:
             violated=violated,
             violation_at=violation_at,
             violation_epoch=violation_epoch,
+            violation_step=violation_step,
             deadlocked=state.deadlocked | deadlocked,
             steps=state.steps + active.astype(jnp.int32),
             events=state.events
@@ -1545,6 +1728,7 @@ class BatchedSim:
             ),
             strag=new_strag,
             nem=new_nem,
+            ctl=state.ctl,
         )
         record = TraceRecord(
             clock=clock,
@@ -1589,7 +1773,7 @@ class BatchedSim:
 
     def run(
         self, seeds, max_steps: int = 100_000, dispatch_steps: int = 10_000,
-        mesh: Optional[jax.sharding.Mesh] = None,
+        mesh: Optional[jax.sharding.Mesh] = None, ctl=None,
     ) -> SimState:
         """Run lanes until every lane is done (or max_steps).
 
@@ -1611,7 +1795,7 @@ class BatchedSim:
         """
         if dispatch_steps <= 0:
             raise ValueError(f"dispatch_steps must be positive, got {dispatch_steps}")
-        state = self.init(seeds)
+        state = self.init(seeds) if ctl is None else self.init(seeds, ctl)
         if mesh is not None:
             L = state.clock.shape[0]
             n_dev = int(mesh.devices.size)
@@ -1648,16 +1832,19 @@ class BatchedSim:
 
         return jax.lax.scan(body, state, None, length=n_steps)
 
-    def run_traced(self, seed: int, max_steps: int = 20_000):
+    def run_traced(self, seed: int, max_steps: int = 20_000, ctl=None):
         """Re-run ONE seed with full event capture (the violation microscope).
 
         Returns (final_state, TraceRecord with [T, 1, ...] leaves). Use
         trace.extract_trace to turn the records into readable events. The
         trajectory is bit-identical to the same seed inside any batch: the
         step function is the same jitted program and all randomness is
-        derived from the lane seed, never from lane position.
+        derived from the lane seed, never from lane position. `ctl` (triage
+        mode) traces a SHRUNK candidate — e.g. a repro bundle's — with the
+        suppressed faults absent from the record stream.
         """
-        state = self.init(jnp.asarray([seed], jnp.uint32))
+        seeds = jnp.asarray([seed], jnp.uint32)
+        state = self.init(seeds) if ctl is None else self.init(seeds, ctl)
         return self._run_traced(state, max_steps)
 
     # ------------------------------------------------------------ sharding
@@ -1746,6 +1933,11 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
         "mean_steps": float(np.asarray(state.steps).mean()),
         "mean_virtual_secs": float(abs_time_us(state).mean()) / 1e6,
     }
+    if violated.any():
+        # earliest first-violation step over violating lanes: the triage
+        # shrinker's run-to-step truncation anchor
+        vs = np.asarray(state.violation_step)
+        out["first_violation_step"] = int(vs[violated].min())
     # per-fault-kind chaos fire counts (the coverage report's raw data)
     fires = np.asarray(state.fires)
     for i, name in enumerate(FIRE_KINDS):

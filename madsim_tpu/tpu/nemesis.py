@@ -134,7 +134,7 @@ def schedule_tuples(
 
 def device_chaos_events(
     sim, seed: int, max_steps: int = 20_000,
-    horizon_us: Optional[int] = None,
+    horizon_us: Optional[int] = None, ctl=None,
 ) -> List[Tuple[int, str, int, int]]:
     """One seed's schedule-level chaos stream as executed ON DEVICE.
 
@@ -143,13 +143,13 @@ def device_chaos_events(
     form. With `horizon_us` set (pass the config's horizon), events at or
     past it are dropped — the engine fires at most one event past the
     horizon before the lane freezes, the pure schedule stops exactly at
-    it.
+    it. `ctl` (triage sims) extracts a SHRUNK candidate's stream.
     """
     from .trace import trace_seed
 
     clog_pair = (-1, -1)
     out: List[Tuple[int, str, int, int]] = []
-    for ev in trace_seed(sim, seed, max_steps=max_steps):
+    for ev in trace_seed(sim, seed, max_steps=max_steps, ctl=ctl):
         if ev.kind not in _CHAOS_KINDS:
             continue
         if horizon_us is not None and ev.t_us >= horizon_us:
@@ -184,16 +184,28 @@ def _side_mask_of(ev) -> int:
 
 def assert_device_matches_schedule(
     sim, plan: FaultPlan, seed: int, horizon_us: int,
-    max_steps: int = 20_000,
+    max_steps: int = 20_000, ctl=None, occ_off=None,
 ) -> int:
     """Twin-test helper: the engine's chaos stream for `seed` must equal
     the pure schedule event-for-event (times, kinds, victims, sides, clog
-    pairs) below the horizon. Returns the number of compared events."""
+    pairs) below the horizon. Returns the number of compared events.
+
+    With `ctl` / `occ_off` (triage): the device runs the shrunk candidate
+    and the schedule side is occurrence-filtered the same way — the twin
+    invariant must survive shrinking. Pass a plan already stripped of
+    dropped clauses; `occ_off` maps schedule-clause names to occurrence
+    bitmasks (see nemesis.filter_schedule).
+    """
+    from ..nemesis import filter_schedule
+
     want = schedule_tuples(
-        plan.schedule(seed, horizon_us, sim.spec.n_nodes), horizon_us
+        filter_schedule(
+            plan.schedule(seed, horizon_us, sim.spec.n_nodes), occ_off
+        ),
+        horizon_us,
     )
     got = device_chaos_events(
-        sim, seed, max_steps=max_steps, horizon_us=horizon_us
+        sim, seed, max_steps=max_steps, horizon_us=horizon_us, ctl=ctl
     )
     # normalize for comparison: heal events carry no mask in the trace,
     # and SAME-MICROSECOND ties across clauses are emitted in clause order

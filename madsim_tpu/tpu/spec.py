@@ -357,6 +357,33 @@ class SimConfig:
     # Off => one global-minimum instant per step (the round-2 behavior).
     lookahead: bool = True
 
+    # -- portable serialization (triage repro bundles + MADSIM_TEST_CONFIG) --
+
+    def to_toml(self) -> str:
+        """Every declarative knob as flat TOML, parseable back by
+        `simconfig_from_toml` and by the MADSIM_TEST_CONFIG overlay path
+        (batch_test). Fields at None (derived defaults) are omitted; the
+        emission order is the dataclass field order, so equal configs
+        produce byte-equal documents and `hash()` keys on the full knob
+        surface — the repro-bundle analog of core.config.Config.to_toml."""
+        lines = []
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                lines.append(f"{f.name} = {'true' if v else 'false'}")
+            else:
+                lines.append(f"{f.name} = {v}")
+        return "\n".join(lines) + "\n"
+
+    def hash(self) -> str:
+        """Stable hex digest of the full config (repro-bundle cache key:
+        a bundle replayed under a different config must fail loudly)."""
+        import hashlib
+
+        return hashlib.sha256(self.to_toml().encode()).hexdigest()[:16]
+
     @property
     def chaos_enabled(self) -> bool:
         return self.crash_interval_hi_us > 0
@@ -398,3 +425,31 @@ class SimConfig:
     @property
     def any_partition_enabled(self) -> bool:
         return self.partition_enabled or self.nem_partition_enabled
+
+
+def simconfig_dict_from_toml(text: str, context: str = "SimConfig TOML") -> dict:
+    """Parse a TOML document into validated SimConfig field overrides.
+
+    The single loader behind both repro bundles (`simconfig_from_toml`)
+    and the MADSIM_TEST_CONFIG overlay (batch_test). Unknown keys fail
+    loudly — a bundle or config file from a newer tree must not be
+    silently half-applied by an older one.
+    """
+    try:
+        import tomllib
+    except ImportError:  # Python < 3.11: vendored reader
+        from .. import _toml as tomllib
+
+    doc = tomllib.loads(text)
+    fields = {f.name for f in dataclasses.fields(SimConfig)}
+    unknown = set(doc) - fields
+    if unknown:
+        raise ValueError(
+            f"{context}: unknown SimConfig fields {sorted(unknown)}"
+        )
+    return doc
+
+
+def simconfig_from_toml(text: str) -> SimConfig:
+    """Parse a SimConfig from its `to_toml` document (round-trip exact)."""
+    return SimConfig(**simconfig_dict_from_toml(text))

@@ -200,7 +200,12 @@ def trace_seed(
     seed: int,
     max_steps: int = 20_000,
     kind_names: Optional[Sequence[str]] = None,
+    ctl=None,
 ) -> List[TraceEvent]:
-    """One-call microscope: re-run `seed` traced and return its event list."""
-    _, recs = sim.run_traced(seed, max_steps=max_steps)
+    """One-call microscope: re-run `seed` traced and return its event list.
+
+    `ctl` (a single-lane TriageCtl; triage-mode sims only) traces a shrunk
+    candidate — suppressed clauses/occurrences never appear in the events.
+    """
+    _, recs = sim.run_traced(seed, max_steps=max_steps, ctl=ctl)
     return extract_trace(recs, kind_names=kind_names)

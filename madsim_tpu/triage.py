@@ -1,0 +1,706 @@
+"""Triage: batched shrinking of violating seeds into minimal repro bundles.
+
+`run_batch` finds violating seeds by the thousand; before this module every
+one of them was triaged BY HAND — re-run the seed, stare at the trace, guess
+which of the fault plan's many clauses actually mattered (docs/bugs_found.md
+is explicit about it). Mature DST stacks close that loop automatically:
+FoundationDB-style simulators and TigerBeetle's VOPR ship QuickCheck-style
+delta-debugging that reduces a failure to a minimal schedule. This is that
+loop for the batched engine, built on the one property the nemesis subsystem
+guarantees everywhere: fault draws are PURE in (seed, clause site, occurrence
+index), so suppressing one fault never perturbs another's time, victim or
+side.
+
+Shrinking is ddmin over three axes:
+
+  (a) CLAUSES and individual clause OCCURRENCES — each schedule-level fault
+      window (crash k, split k, clog k, spike k) and each message-level
+      clause (loss, dup, reorder, skew, wipe) is one ddmin atom;
+  (b) TIME HORIZON — the engine records `first_violation_step` /
+      violation time per lane, and every candidate runs with its horizon
+      truncated just past the baseline violation, so the final bundle's
+      horizon is bisected down to the earliest violating instant;
+  (c) RATES — surviving message-level clauses are re-tried at reduced
+      rates (the coin is `u < rate * scale`, so a scaled lane's fire set
+      is a strict subset of the full run's).
+
+The batching trick: shrink candidates are evaluated as LANES of one
+dispatch. `BatchedSim(..., triage=True)` threads a per-lane `TriageCtl`
+(clause bitmask, occurrence bitmasks, rate scales, per-lane horizon) through
+the jitted step, so one compiled program evaluates a whole ddmin generation
+— a full shrink costs a handful of device dispatches, not a re-run per
+candidate.
+
+The output is a portable JSON `ReproBundle` (seed, shrunk plan, full
+`SimConfig.to_toml`, config hash, violation step/time, ctl spec, trace tail)
+replayable by `python -m madsim_tpu.repro bundle.json [--backend host|tpu]`.
+See docs/triage.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .nemesis import (
+    CLAUSE_OF_EVENT,
+    ClockSkew,
+    Crash,
+    Duplicate,
+    FaultPlan,
+    LatencySpike,
+    LinkClog,
+    MsgLoss,
+    OCC_CLAUSES,
+    OCC_ROW,
+    Partition,
+    RATE_CLAUSES,
+    RATE_ROW,
+    Reorder,
+    TRIAGE_BIT,
+    TRIAGE_CLAUSES,
+    filter_schedule,
+)
+
+BUNDLE_FORMAT = "madsim-tpu-repro/1"
+
+# an atom is (clause_name, occurrence k | None); k=None means the whole
+# clause (message-level clauses, skew, wipe, and legacy chaos knobs)
+Atom = Tuple[str, Optional[int]]
+
+_CLAUSE_TYPES = {
+    "crash": Crash, "partition": Partition, "clog": LinkClog,
+    "spike": LatencySpike, "skew": ClockSkew, "loss": MsgLoss,
+    "dup": Duplicate, "reorder": Reorder,
+}
+
+
+class NotReproducible(AssertionError):
+    """The seed did not violate under the full configuration — nothing to
+    shrink (wrong workload/config for this seed, or a nondeterminism bug
+    upstream, which check_determinism exists to catch)."""
+
+
+# --------------------------------------------------------------------------
+# FaultPlan <-> SimConfig <-> JSON plumbing
+# --------------------------------------------------------------------------
+
+
+def plan_from_config(cfg, name: str = "recovered") -> FaultPlan:
+    """Reconstruct the nemesis FaultPlan a SimConfig was compiled from.
+
+    compile_plan is a bijection clause-by-clause, so any nemesis-enabled
+    workload is shrinkable without threading the plan object through
+    run_batch. Legacy trajectory-coupled knobs (crash_interval_*,
+    partition_interval_*) have no plan face — they shrink clause-level via
+    the ctl bitmask and ride the bundle's config TOML.
+    """
+    clauses: list = []
+    if cfg.nem_crash_enabled:
+        clauses.append(Crash(
+            interval_lo_us=cfg.nem_crash_interval_lo_us,
+            interval_hi_us=cfg.nem_crash_interval_hi_us,
+            down_lo_us=cfg.nem_crash_down_lo_us,
+            down_hi_us=cfg.nem_crash_down_hi_us,
+            wipe_rate=cfg.nem_crash_wipe_rate,
+        ))
+    if cfg.nem_partition_enabled:
+        clauses.append(Partition(
+            interval_lo_us=cfg.nem_partition_interval_lo_us,
+            interval_hi_us=cfg.nem_partition_interval_hi_us,
+            heal_lo_us=cfg.nem_partition_heal_lo_us,
+            heal_hi_us=cfg.nem_partition_heal_hi_us,
+        ))
+    if cfg.nem_clog_enabled:
+        clauses.append(LinkClog(
+            interval_lo_us=cfg.nem_clog_interval_lo_us,
+            interval_hi_us=cfg.nem_clog_interval_hi_us,
+            heal_lo_us=cfg.nem_clog_heal_lo_us,
+            heal_hi_us=cfg.nem_clog_heal_hi_us,
+        ))
+    if cfg.nem_spike_enabled:
+        clauses.append(LatencySpike(
+            interval_lo_us=cfg.nem_spike_interval_lo_us,
+            interval_hi_us=cfg.nem_spike_interval_hi_us,
+            duration_lo_us=cfg.nem_spike_duration_lo_us,
+            duration_hi_us=cfg.nem_spike_duration_hi_us,
+            extra_us=cfg.nem_spike_extra_us,
+        ))
+    if cfg.nem_loss_rate > 0:
+        clauses.append(MsgLoss(rate=cfg.nem_loss_rate))
+    if cfg.nem_dup_enabled:
+        clauses.append(Duplicate(rate=cfg.nem_dup_rate))
+    if cfg.nem_reorder_rate > 0:
+        clauses.append(Reorder(
+            rate=cfg.nem_reorder_rate, window_us=cfg.nem_reorder_window_us
+        ))
+    if cfg.nem_skew_enabled:
+        clauses.append(ClockSkew(max_ppm=cfg.nem_skew_max_ppm))
+    return FaultPlan(clauses=tuple(clauses), name=name)
+
+
+def plan_to_json(plan: FaultPlan) -> dict:
+    return {
+        "name": plan.name,
+        "clauses": [
+            {"type": type(c).__name__, **dataclasses.asdict(c)}
+            for c in plan.clauses
+        ],
+    }
+
+
+def plan_from_json(doc: dict) -> FaultPlan:
+    by_name = {cls.__name__: cls for cls in _CLAUSE_TYPES.values()}
+    clauses = []
+    for c in doc.get("clauses", []):
+        kw = dict(c)
+        cls = by_name[kw.pop("type")]
+        clauses.append(cls(**kw))
+    return FaultPlan(clauses=tuple(clauses), name=doc.get("name", "bundle"))
+
+
+def shrink_plan(
+    plan: FaultPlan, dropped: Sequence[str], rate_scale: Dict[str, float],
+) -> FaultPlan:
+    """The human/host-twin face of a shrink outcome: dropped clauses
+    removed, surviving message rates scaled down (occurrence masks live
+    beside the plan — see ReproBundle.occ_off / nemesis.filter_schedule)."""
+    dropped = set(dropped)
+    out = []
+    for c in plan.clauses:
+        name = next(n for n, cls in _CLAUSE_TYPES.items() if isinstance(c, cls))
+        if name in dropped:
+            continue
+        if isinstance(c, Crash) and "wipe" in dropped and c.wipe_rate > 0:
+            c = dataclasses.replace(c, wipe_rate=0.0)
+        if name in RATE_CLAUSES and rate_scale.get(name, 1.0) != 1.0:
+            c = dataclasses.replace(c, rate=c.rate * rate_scale[name])
+        out.append(c)
+    return FaultPlan(clauses=tuple(out), name=f"{plan.name}-shrunk")
+
+
+def build_ctl(
+    L: int,
+    horizon_us: int,
+    off_clauses: Sequence[str] = (),
+    occ_off: Optional[Dict[str, int]] = None,
+    rate_scale: Optional[Dict[str, float]] = None,
+):
+    """A uniform TriageCtl (every lane identical) — the repro-replay shape."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .tpu.engine import default_ctl
+
+    ctl = default_ctl(L, horizon_us)
+    off = 0
+    for name in off_clauses:
+        off |= TRIAGE_BIT[name]
+    occ = np.zeros((L, len(OCC_CLAUSES)), np.int32)
+    for name, mask in (occ_off or {}).items():
+        occ[:, OCC_ROW[name]] = mask
+    rs = np.ones((L, len(RATE_CLAUSES)), np.float32)
+    for name, s in (rate_scale or {}).items():
+        rs[:, RATE_ROW[name]] = s
+    return ctl._replace(
+        off=jnp.full((L,), off, jnp.int32),
+        occ=jnp.asarray(occ),
+        rate_scale=jnp.asarray(rs),
+    )
+
+
+# --------------------------------------------------------------------------
+# the repro bundle
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReproBundle:
+    """A portable, self-describing repro of one shrunk violation.
+
+    `config_toml` is the FULL compiled SimConfig the shrinker ran under —
+    shapes and draw layouts must match the verified candidate exactly, so
+    dropped clauses are expressed through the ctl fields
+    (`dropped_clauses` / `occ_off` / `rate_scale`), never by removing
+    their knobs from the config. `plan` is the shrunk FaultPlan for human
+    reading and the host schedule twin.
+    """
+
+    seed: int
+    spec_ref: Optional[str]  # "module:factory" rebuilding the ProtocolSpec
+    spec_kwargs: Dict[str, Any]
+    spec_name: str
+    n_nodes: int
+    config_toml: str
+    config_hash: str
+    violation_kind: str  # "invariant"
+    violation_step: int  # first violating step (run-to-step truncation)
+    violation_t_us: int  # absolute virtual time of the violation
+    dropped_clauses: List[str]
+    occ_off: Dict[str, int]
+    rate_scale: Dict[str, float]
+    horizon_us: int  # bisected: just past the violation
+    max_steps: int
+    plan: dict  # shrunk FaultPlan (plan_to_json)
+    trace_tail: List[str]
+    format: str = BUNDLE_FORMAT
+
+    # -- serialization --
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2) + "\n"
+
+    @staticmethod
+    def from_json(text: str) -> "ReproBundle":
+        doc = json.loads(text)
+        fmt = doc.get("format", "")
+        if fmt != BUNDLE_FORMAT:
+            raise ValueError(
+                f"unsupported bundle format {fmt!r} (want {BUNDLE_FORMAT!r})"
+            )
+        fields = {f.name for f in dataclasses.fields(ReproBundle)}
+        unknown = set(doc) - fields
+        if unknown:
+            raise ValueError(f"unknown bundle fields: {sorted(unknown)}")
+        return ReproBundle(**doc)
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+        return path
+
+    @staticmethod
+    def load(path: str) -> "ReproBundle":
+        with open(path) as f:
+            return ReproBundle.from_json(f.read())
+
+    # -- replay plumbing --
+
+    def ctl(self, L: int = 1):
+        """The TriageCtl that replays exactly the verified candidate."""
+        return build_ctl(
+            L, self.horizon_us, self.dropped_clauses, self.occ_off,
+            self.rate_scale,
+        )
+
+    def config(self):
+        from .tpu.spec import simconfig_from_toml
+
+        cfg = simconfig_from_toml(self.config_toml)
+        if cfg.hash() != self.config_hash:
+            raise ValueError(
+                "bundle config hash mismatch: the TOML was edited or the "
+                f"SimConfig schema drifted ({cfg.hash()} != {self.config_hash})"
+            )
+        return cfg
+
+    def shrunk_plan(self) -> FaultPlan:
+        return plan_from_json(self.plan)
+
+    def repro_command(self, path: str) -> str:
+        return f"python -m madsim_tpu.repro {path}"
+
+
+# --------------------------------------------------------------------------
+# batched ddmin
+# --------------------------------------------------------------------------
+
+
+def ddmin(
+    atoms: List[Atom],
+    batch_violates: Callable[[List[List[Atom]]], List[bool]],
+) -> List[Atom]:
+    """Zeller/Hildebrandt ddmin, with every generation's candidate subsets
+    AND complements evaluated by ONE `batch_violates` call (one batched
+    device dispatch). Returns a 1-minimal kept-set: the result violates,
+    and removing any single atom from it does not.
+    """
+    cur = list(atoms)
+    if not cur:
+        return cur
+    if len(cur) == 1:
+        # the only generation ddmin proper never tests: nothing at all
+        if batch_violates([[]])[0]:
+            return []
+        return cur
+    n = 2
+    while len(cur) >= 2:
+        chunk = -(-len(cur) // n)
+        subsets = [cur[i:i + chunk] for i in range(0, len(cur), chunk)]
+        cands: List[List[Atom]] = list(subsets)
+        compl: List[List[Atom]] = []
+        if len(subsets) > 2:
+            compl = [
+                [a for s in (subsets[:i] + subsets[i + 1:]) for a in s]
+                for i in range(len(subsets))
+            ]
+        res = batch_violates(cands + compl)
+        hit = next((i for i, r in enumerate(res[: len(cands)]) if r), None)
+        if hit is not None:
+            cur = cands[hit]
+            n = 2
+            continue
+        chit = next((i for i, r in enumerate(res[len(cands):]) if r), None)
+        if chit is not None:
+            cur = compl[chit]
+            n = max(n - 1, 2)
+            continue
+        if n >= len(cur):
+            break
+        n = min(len(cur), 2 * n)
+    return cur
+
+
+# --------------------------------------------------------------------------
+# the shrinker
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShrinkResult:
+    bundle: ReproBundle
+    bundle_path: Optional[str]
+    dispatches: int  # batched device evaluations the whole shrink cost
+    original_atoms: int
+    kept_atoms: List[Atom]
+
+    @property
+    def repro_command(self) -> str:
+        if self.bundle_path:
+            return self.bundle.repro_command(self.bundle_path)
+        return f"seed={self.bundle.seed} (bundle not written)"
+
+
+class _Eval:
+    """Evaluates shrink candidates as lanes of one batched dispatch."""
+
+    def __init__(self, sim, seed: int, max_steps: int, lane_width: int):
+        import jax.numpy as jnp  # noqa: F401  (device backend required)
+
+        self.sim = sim
+        self.seed = int(seed)
+        self.max_steps = int(max_steps)
+        self.lane_width = max(2, int(lane_width))
+        self.dispatches = 0
+
+    def run(
+        self, rows: List[Tuple[int, List[int], List[float], int]]
+    ) -> List[Dict[str, int]]:
+        """rows: (off_bits, occ_masks[4], rate_scales[3], horizon_us) per
+        candidate. Returns per-candidate {violated, step, t_us}. Rows are
+        padded to `lane_width` so every generation reuses ONE compiled
+        program; oversized generations chunk into several dispatches."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .tpu.engine import TriageCtl, abs_time_us
+        from .tpu.spec import REBASE_US
+
+        out: List[Dict[str, int]] = []
+        for lo in range(0, len(rows), self.lane_width):
+            part = rows[lo:lo + self.lane_width]
+            n = len(part)
+            pad = self.lane_width - n
+            # pad lanes replay the first candidate; results are discarded
+            part = part + [part[0]] * pad
+            off = np.asarray([r[0] for r in part], np.int32)
+            occ = np.asarray([r[1] for r in part], np.int32)
+            rs = np.asarray([r[2] for r in part], np.float32)
+            eh = np.asarray([r[3] // REBASE_US for r in part], np.int32)
+            oh = np.asarray([r[3] % REBASE_US for r in part], np.int32)
+            ctl = TriageCtl(
+                off=jnp.asarray(off), occ=jnp.asarray(occ),
+                rate_scale=jnp.asarray(rs), h_epoch=jnp.asarray(eh),
+                h_off=jnp.asarray(oh),
+            )
+            seeds = np.full((self.lane_width,), self.seed, np.uint32)
+            state = self.sim.run(seeds, max_steps=self.max_steps, ctl=ctl)
+            self.dispatches += 1
+            violated = np.asarray(state.violated)
+            step = np.asarray(state.violation_step)
+            t_us = (
+                np.asarray(state.violation_epoch, np.int64) * REBASE_US
+                + np.asarray(state.violation_at, np.int64)
+            )
+            for i in range(n):
+                out.append({
+                    "violated": bool(violated[i]),
+                    "step": int(step[i]),
+                    "t_us": int(t_us[i]) if violated[i] else -1,
+                })
+        return out
+
+
+def _atom_rows(
+    kept: Sequence[Atom], all_atoms: Sequence[Atom], horizon_us: int,
+    rate_scale: Optional[Dict[str, float]] = None,
+) -> Tuple[int, List[int], List[float], int]:
+    """One candidate row: every atom NOT in `kept` is suppressed."""
+    kept_set = set(kept)
+    off = 0
+    occ = [0] * len(OCC_CLAUSES)
+    for atom in all_atoms:
+        if atom in kept_set:
+            continue
+        name, k = atom
+        if k is None:
+            off |= TRIAGE_BIT[name]
+        else:
+            occ[OCC_ROW[name]] |= 1 << k
+    rs = [1.0] * len(RATE_CLAUSES)
+    for name, s in (rate_scale or {}).items():
+        rs[RATE_ROW[name]] = float(s)
+    return (off, occ, rs, int(horizon_us))
+
+
+def enumerate_atoms(
+    plan: FaultPlan, cfg, seed: int, horizon_us: int, n_nodes: int,
+    max_occ: int = 31,
+) -> List[Atom]:
+    """The ddmin universe for one (plan, seed, horizon).
+
+    Schedule clauses contribute one atom per occurrence whose window OPENS
+    inside the horizon (pure — read off `plan.schedule`, no device run);
+    clauses with more than `max_occ` occurrences fall back to a single
+    clause-level atom. Occurrence bits live in an int32 mask whose sign
+    bit (bit 31) is unusable, so indices >= 31 also force the fallback.
+    Message clauses, skew, wipe and legacy chaos knobs are clause-level
+    atoms.
+    """
+    atoms: List[Atom] = []
+    occ_of: Dict[str, set] = {}
+    for ev in plan.schedule(seed, horizon_us, n_nodes):
+        clause = CLAUSE_OF_EVENT.get(ev.kind)
+        if clause in OCC_ROW and ev.k >= 0:
+            occ_of.setdefault(clause, set()).add(ev.k)
+    for clause in OCC_CLAUSES:
+        ks = sorted(occ_of.get(clause, ()))
+        if not ks:
+            continue
+        if len(ks) > max_occ or max(ks) >= 31:
+            atoms.append((clause, None))
+        else:
+            atoms.extend((clause, k) for k in ks)
+    if plan.get(MsgLoss) is not None:
+        atoms.append(("loss", None))
+    if plan.get(Duplicate) is not None:
+        atoms.append(("dup", None))
+    if plan.get(Reorder) is not None:
+        atoms.append(("reorder", None))
+    if plan.get(ClockSkew) is not None:
+        atoms.append(("skew", None))
+    crash = plan.get(Crash)
+    if crash is not None and crash.wipe_rate > 0:
+        atoms.append(("wipe", None))
+    # legacy trajectory-coupled knobs: clause-level only (no pure schedule)
+    if cfg.chaos_enabled:
+        atoms.append(("crash", None))
+    if cfg.partition_enabled:
+        atoms.append(("partition", None))
+    return atoms
+
+
+def shrink_seed(
+    workload,
+    seed: int,
+    out_dir: Optional[str] = None,
+    spec_ref: Optional[str] = None,
+    spec_kwargs: Optional[Dict[str, Any]] = None,
+    slack_us: int = 2_000,
+    lane_width: int = 16,
+    rate_steps: Sequence[float] = (0.5, 0.25),
+    trace_tail: int = 40,
+    sim=None,
+    log: Optional[Callable[[str], None]] = None,
+) -> ShrinkResult:
+    """Shrink one violating seed of a BatchWorkload into a ReproBundle.
+
+    Pipeline (each numbered item is ONE batched dispatch unless noted):
+
+      1. baseline — the full plan AND the empty plan as two lanes of one
+         run; the full lane must violate (else NotReproducible), and its
+         violation time bisects the horizon for everything after;
+      2..k. ddmin generations over clause/occurrence atoms, every
+         generation one dispatch (subsets + complements as lanes);
+      k+1. optional rate-reduction probe for surviving message clauses
+         (one dispatch for the scale grid, one to confirm the combination);
+      k+2. final confirmation under the exact bundle ctl (also re-reads
+         the final violation step/time the bundle records).
+
+    The trace tail is captured with a separate single-lane traced run of
+    the final candidate (the microscope, not a shrink dispatch). `sim`
+    accepts a pre-built `BatchedSim(spec, config, triage=True)` so a test
+    suite can amortize one compile across many shrinks.
+    """
+    from .tpu.batch import BatchWorkload  # noqa: F401  (doc pointer)
+    from .tpu.engine import BatchedSim
+    from .tpu.spec import SimConfig
+
+    say = log or (lambda msg: None)
+    spec = workload.spec
+    cfg = workload.config or SimConfig()
+    if sim is None:
+        sim = BatchedSim(spec, cfg, triage=True)
+    elif not sim.triage:
+        raise ValueError("shrink_seed needs a BatchedSim(..., triage=True)")
+    ev = _Eval(sim, seed, workload.max_steps, lane_width)
+    plan = plan_from_config(cfg)
+    full_h = int(cfg.horizon_us)
+
+    # -- 1. baseline: full plan + empty plan, one dispatch ------------------
+    base_atoms = enumerate_atoms(plan, cfg, seed, full_h, spec.n_nodes)
+    full_row = _atom_rows(base_atoms, base_atoms, full_h)
+    empty_row = _atom_rows([], base_atoms, full_h)
+    base, empty = ev.run([full_row, empty_row])[:2]
+    if not base["violated"]:
+        raise NotReproducible(
+            f"seed {seed} does not violate under the full configuration "
+            f"(horizon {full_h} us) — nothing to shrink"
+        )
+    trunc_h = min(full_h, base["t_us"] + slack_us)
+    say(
+        f"baseline: violation at step {base['step']}, t={base['t_us']}us; "
+        f"horizon truncated {full_h} -> {trunc_h}us"
+    )
+
+    # -- 2..k. ddmin over the truncated-horizon atom universe ---------------
+    if empty["violated"]:
+        # the protocol violates with no chaos at all: the minimal plan is
+        # empty and the empty lane's own violation bisects the horizon.
+        # The suppression universe stays base_atoms so the confirmation
+        # (and the bundle ctl) really runs chaos-free.
+        universe: List[Atom] = list(base_atoms)
+        kept: List[Atom] = []
+        trunc_h = min(full_h, empty["t_us"] + slack_us)
+    else:
+        universe = enumerate_atoms(plan, cfg, seed, trunc_h, spec.n_nodes)
+
+        def batch_violates(cands: List[List[Atom]]) -> List[bool]:
+            rows = [_atom_rows(c, universe, trunc_h) for c in cands]
+            res = ev.run(rows)
+            say(
+                f"ddmin generation: {len(cands)} candidates -> "
+                f"{sum(r['violated'] for r in res)} violating"
+            )
+            return [r["violated"] for r in res]
+
+        kept = ddmin(universe, batch_violates)
+    say(f"ddmin: {len(universe)} atoms -> {len(kept)} kept: {kept}")
+
+    # -- k+1. rate reduction for surviving message clauses ------------------
+    kept_clauses = {name for name, _ in kept}
+    rate_scale: Dict[str, float] = {}
+    rate_targets = [n for n in RATE_CLAUSES if (n, None) in kept]
+    if rate_targets and rate_steps:
+        grid: List[Tuple[str, float]] = [
+            (n, s) for n in rate_targets for s in rate_steps
+        ]
+        res = ev.run([
+            _atom_rows(kept, universe, trunc_h, rate_scale={n: s})
+            for n, s in grid
+        ])
+        for n in rate_targets:
+            best = min(
+                (s for (gn, s), r in zip(grid, res)
+                 if gn == n and r["violated"]),
+                default=1.0,
+            )
+            if best < 1.0:
+                rate_scale[n] = best
+    final: Optional[Dict[str, int]] = None
+    if rate_targets and rate_steps and rate_scale:
+        # scales probed one clause at a time; the combination must be
+        # re-confirmed (falls back to full rates if it stops violating).
+        # A confirmed combination row is byte-identical to the final
+        # confirmation below, so it doubles as it — one dispatch saved.
+        ok = ev.run(
+            [_atom_rows(kept, universe, trunc_h, rate_scale=rate_scale)]
+        )[0]
+        if ok["violated"]:
+            final = ok
+        else:
+            rate_scale = {}
+    if rate_targets:
+        say(f"rate reduction: {rate_scale or 'none'}")
+
+    # -- k+2. final confirmation under the exact bundle ctl -----------------
+    if final is None:
+        final = ev.run(
+            [_atom_rows(kept, universe, trunc_h, rate_scale=rate_scale)]
+        )[0]
+    assert final["violated"], "shrunk candidate must still violate"
+    final_h = min(trunc_h, final["t_us"] + slack_us)
+
+    # the bundle's ctl spec: everything in the universe minus the kept set
+    dropped = sorted({name for name, _ in universe} - kept_clauses)
+    occ_off: Dict[str, int] = {}
+    for name, k in universe:
+        if k is not None and (name, k) not in kept and name in kept_clauses:
+            occ_off[name] = occ_off.get(name, 0) | (1 << k)
+
+    # -- trace tail: single-lane microscope of the final candidate ----------
+    tail: List[str] = []
+    if trace_tail > 0:
+        from .tpu.trace import trace_seed
+
+        events = trace_seed(
+            sim, seed, max_steps=max(final["step"] + 2, 64),
+            kind_names=spec.msg_kind_names,
+            ctl=build_ctl(1, final_h, dropped, occ_off, rate_scale),
+        )
+        tail = [str(e) for e in events[-trace_tail:]]
+
+    bundle = ReproBundle(
+        seed=int(seed),
+        spec_ref=spec_ref,
+        spec_kwargs=dict(spec_kwargs or {}),
+        spec_name=spec.name,
+        n_nodes=spec.n_nodes,
+        config_toml=cfg.to_toml(),
+        config_hash=cfg.hash(),
+        violation_kind="invariant",
+        violation_step=final["step"],
+        violation_t_us=final["t_us"],
+        dropped_clauses=list(dropped),
+        occ_off=occ_off,
+        rate_scale=rate_scale,
+        horizon_us=int(final_h),
+        max_steps=int(workload.max_steps),
+        plan=plan_to_json(shrink_plan(plan, dropped, rate_scale)),
+        trace_tail=tail,
+    )
+    path = None
+    if out_dir is not None:
+        os.makedirs(out_dir, exist_ok=True)
+        # the config hash keys the name: concurrent runs of the same spec
+        # under different configs must not overwrite each other's bundles
+        path = os.path.join(
+            out_dir,
+            f"repro_{spec.name}_{cfg.hash()}_seed{int(seed)}.json",
+        )
+        bundle.save(path)
+    say(
+        f"shrunk seed {seed}: {len(base_atoms)} atoms -> {len(kept)} in "
+        f"{ev.dispatches} dispatches; bundle {path or '(unsaved)'}"
+    )
+    return ShrinkResult(
+        bundle=bundle,
+        bundle_path=path,
+        dispatches=ev.dispatches,
+        original_atoms=len(base_atoms),
+        kept_atoms=kept,
+    )
+
+
+def default_bundle_dir() -> str:
+    """Where run_batch drops bundles unless told otherwise (per-uid, like
+    the jax compilation cache dir: a shared path would leave second users
+    unable to write)."""
+    uid = os.getuid() if hasattr(os, "getuid") else "all"
+    return os.environ.get(
+        "MADSIM_TRIAGE_DIR",
+        os.path.join(tempfile.gettempdir(), f"madsim_tpu_repros-{uid}"),
+    )

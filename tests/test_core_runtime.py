@@ -404,6 +404,37 @@ def test_fs_read_write_and_power_fail():
     rt.block_on(main())
 
 
+def test_fs_power_fail_drops_never_synced_files():
+    # create -> power_fail -> stat: a file created but NEVER synced has no
+    # durable directory entry, so a power loss erases the whole inode —
+    # the path must be gone, not present-but-empty (recovery code that
+    # stat()s such a file must see what a real disk would show)
+    rt = ms.Runtime(seed=1)
+    from madsim_tpu import fs
+
+    async def main():
+        f = await fs.File.create("/data/wal")
+        await f.write_all_at(b"doomed", 0)
+        g = await fs.File.create("/data/kept")
+        await g.write_all_at(b"ok", 0)
+        await g.sync_all()
+        await g.write_all_at(b"XX", 0)  # unsynced overwrite on a synced file
+
+        sim = ms.plugin.simulator(fs.FsSim)
+        node_id = ms.plugin.node()
+        sim.power_fail(node_id)
+        assert sim.get_file_size(node_id, "/data/wal") is None
+        try:
+            await fs.File.open("/data/wal")
+            raise AssertionError("never-synced file must not survive")
+        except FileNotFoundError:
+            pass
+        # the synced file survives with its synced content only
+        assert await fs.read("/data/kept") == b"ok"
+
+    rt.block_on(main())
+
+
 def test_fs_power_fail_rolls_back_inplace_overwrites():
     # an unsynced overwrite of an already-synced byte range must NOT survive
     # a power failure (content snapshot, not just length truncation)

@@ -17,6 +17,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = str(Path(__file__).resolve().parent.parent)
 
 # A @madsim_test whose trace depends on str-set iteration order; it PRINTS
@@ -148,6 +150,105 @@ def _drive_fault_plan(seed: int):
     rt = ms.Runtime(seed=seed)
     driver = rt.block_on(body())
     return driver.applied, rt.handle.metrics().chaos_fires()
+
+
+# ----------------------------------------------------- triage repro bundles
+
+# The planted deposed-leader re-stamp spec, as SOURCE: exec'd here to run
+# the shrink, and written to a module file the CHILD process imports via
+# the bundle's spec_ref — proving a bundle carries everything a fresh
+# process needs (plus the spec factory reference) to replay the violation.
+PLANTED_SPEC_SRC = '''
+import jax.numpy as jnp
+
+from madsim_tpu.tpu import make_raft_spec
+from madsim_tpu.tpu import raft as raft_mod
+from madsim_tpu.tpu.spec import replace_handlers
+
+
+def make_planted_spec():
+    spec = make_raft_spec(5, client_rate=0.8)
+
+    def buggy_on_message(s, nid, src, kind, payload, now, key):
+        state, out, timer = spec.on_message(s, nid, src, kind, payload, now, key)
+        deposed = (s.role == raft_mod.LEADER) & (state.role != raft_mod.LEADER)
+        log_idx = jnp.arange(s.log_term.shape[0], dtype=jnp.int32)
+        in_log = log_idx < state.log_len
+        log_term = jnp.where(deposed & in_log, state.term, state.log_term)
+        return state._replace(log_term=log_term), out, timer
+
+    return replace_handlers(spec, on_message=buggy_on_message)
+'''
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_shrunk_bundle_replays_cross_process_on_both_backends(tmp_path):
+    """Satellite acceptance: a bundle written by the device shrinker must
+    (a) replay the violation bit-deterministically in a FRESH process
+    (`python -m madsim_tpu.repro`, which runs the seed twice and compares
+    the full final states bitwise), and (b) keep the twin invariant — the
+    shrunk FaultPlan.schedule equals the host driver's applied stream."""
+    import dataclasses
+
+    from madsim_tpu import triage
+    from madsim_tpu.tpu import SimConfig, raft_workload, run_batch
+    from madsim_tpu.tpu import nemesis as tn
+    from madsim_tpu import nemesis as nm
+
+    ns: dict = {}
+    exec(PLANTED_SPEC_SRC, ns)
+    (tmp_path / "bundle_spec.py").write_text(PLANTED_SPEC_SRC)
+
+    plan = nm.FaultPlan(name="sched-only", clauses=(
+        nm.Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+                 down_lo_us=300_000, down_hi_us=1_000_000),
+        nm.Partition(interval_lo_us=300_000, interval_hi_us=1_200_000,
+                     heal_lo_us=400_000, heal_hi_us=1_500_000),
+    ))
+    cfg = tn.compile_plan(plan, SimConfig(horizon_us=5_000_000, loss_rate=0.0))
+    wl = dataclasses.replace(
+        raft_workload(spec=ns["make_planted_spec"]()), config=cfg,
+        host_repro=None,
+    )
+    result = run_batch(range(24), wl, repro_on_host=False, max_traces=0)
+    assert result.violations > 0
+    sr = triage.shrink_seed(
+        wl, result.violating_seeds[0], out_dir=str(tmp_path),
+        spec_ref="bundle_spec:make_planted_spec",
+    )
+    # the shrink must have dropped real structure for this to test anything
+    assert sr.bundle.dropped_clauses or sr.bundle.occ_off
+
+    env = dict(os.environ)
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def replay(backend: str) -> subprocess.CompletedProcess:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "madsim_tpu.repro",
+                sr.bundle_path, "--backend", backend,
+            ],
+            cwd=str(tmp_path), env=env, capture_output=True, text=True,
+            timeout=600,
+        )
+        assert proc.returncode == 0, (
+            f"--backend {backend} failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+        return proc
+
+    # (a) fresh-process device replay: the CLI runs the seed twice and
+    # bitwise-compares the final states; the violation must land exactly
+    # where the bundle recorded it
+    tpu = replay("tpu")
+    assert (
+        f"seed {sr.bundle.seed} violates at step {sr.bundle.violation_step}"
+        in tpu.stdout
+    ), tpu.stdout
+    # (b) shrunk-schedule host twin in its own fresh process
+    host = replay("host")
+    assert "host schedule twin OK" in host.stdout, host.stdout
 
 
 def test_fault_plan_fire_schedule_identical_across_fresh_runtimes():

@@ -1,0 +1,410 @@
+"""Triage: batched ddmin shrinking of violating seeds into repro bundles.
+
+The subsystem's contract (madsim_tpu/triage.py):
+  * shrink candidates are lanes of ONE batched dispatch (TriageCtl), so a
+    full shrink costs a handful of device runs;
+  * suppressing a clause/occurrence never perturbs the remaining faults'
+    draws (the schedule-purity invariant, extended through shrinking);
+  * the output bundle replays the violation bit-deterministically via
+    `python -m madsim_tpu.repro`, and its shrunk FaultPlan.schedule still
+    equals the host driver stream.
+
+`chaos`-marked tests are the fast smoke tier (`make triage-smoke`);
+`slow`-marked multi-generation sweeps run nightly.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from madsim_tpu import nemesis as nm
+from madsim_tpu import triage
+from madsim_tpu.nemesis import (
+    ClockSkew,
+    Crash,
+    Duplicate,
+    FaultPlan,
+    LatencySpike,
+    MsgLoss,
+    Partition,
+    Reorder,
+)
+
+HORIZON_US = 5_000_000
+
+# the deposed-leader re-stamp regression (docs/bugs_found.md bug #1, the
+# round-2 trophy): a deposed leader re-stamps its stale log tail with the
+# newly adopted term — committed prefixes disagree under elections forced
+# by chaos. This module's planted violation throughout.
+
+
+def planted_restamp_spec():
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu import make_raft_spec
+    from madsim_tpu.tpu import raft as raft_mod
+    from madsim_tpu.tpu.spec import replace_handlers
+
+    spec = make_raft_spec(5, client_rate=0.8)
+
+    def buggy_on_message(s, nid, src, kind, payload, now, key):
+        state, out, timer = spec.on_message(s, nid, src, kind, payload, now, key)
+        deposed = (s.role == raft_mod.LEADER) & (state.role != raft_mod.LEADER)
+        log_idx = jnp.arange(s.log_term.shape[0], dtype=jnp.int32)
+        in_log = log_idx < state.log_len
+        log_term = jnp.where(deposed & in_log, state.term, state.log_term)
+        return state._replace(log_term=log_term), out, timer
+
+    return replace_handlers(spec, on_message=buggy_on_message)
+
+
+# schedule-clause plan: the repro must ride crash/partition windows, so the
+# shrinker has real occurrence atoms to drop (no message-level escape hatch)
+SCHED_PLAN = FaultPlan(name="sched-only", clauses=(
+    Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+          down_lo_us=300_000, down_hi_us=1_000_000),
+    Partition(interval_lo_us=300_000, interval_hi_us=1_200_000,
+              heal_lo_us=400_000, heal_hi_us=1_500_000),
+))
+
+# the full storm for the nightly shrink (every clause kind as an atom)
+STORM_PLAN = FaultPlan(name="storm", clauses=(
+    Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+          down_lo_us=300_000, down_hi_us=1_000_000),
+    Partition(interval_lo_us=300_000, interval_hi_us=1_200_000,
+              heal_lo_us=400_000, heal_hi_us=1_500_000),
+    LatencySpike(interval_lo_us=700_000, interval_hi_us=2_500_000,
+                 extra_us=50_000),
+    MsgLoss(rate=0.05),
+    Duplicate(rate=0.05),
+    Reorder(rate=0.1, window_us=40_000),
+    ClockSkew(max_ppm=20_000),
+))
+
+
+def _sched_workload():
+    from madsim_tpu.tpu import SimConfig, raft_workload
+    from madsim_tpu.tpu import nemesis as tn
+
+    cfg = tn.compile_plan(
+        SCHED_PLAN, SimConfig(horizon_us=HORIZON_US, loss_rate=0.0)
+    )
+    return dataclasses.replace(
+        raft_workload(spec=planted_restamp_spec()), config=cfg,
+        host_repro=None,
+    )
+
+
+# results shared along the file so later tests don't pay a second shrink
+_shared = {}
+
+
+# ---------------------------------------------------------------- pure ddmin
+
+
+def test_ddmin_is_one_minimal():
+    """Synthetic oracle: violates iff {3, 7} ⊆ kept. ddmin must find
+    exactly that pair, and every generation must be ONE batch call."""
+    atoms = [("a", k) for k in range(10)]
+    need = {("a", 3), ("a", 7)}
+    calls = []
+
+    def batch(cands):
+        calls.append(len(cands))
+        return [need <= set(c) for c in cands]
+
+    kept = triage.ddmin(atoms, batch)
+    assert set(kept) == need
+    assert len(calls) >= 2  # several generations, each one batch
+    # 1-minimality: removing either survivor breaks it (by the oracle)
+    for a in kept:
+        assert not batch([[x for x in kept if x != a]])[0]
+
+
+def test_ddmin_degenerate_universes():
+    # empty universe: nothing to do
+    assert triage.ddmin([], lambda c: [True] * len(c)) == []
+    # single necessary atom stays
+    assert triage.ddmin(
+        [("a", None)], lambda c: [("a", None) in s for s in c]
+    ) == [("a", None)]
+    # single unnecessary atom: the empty set is tested and wins
+    assert triage.ddmin([("a", None)], lambda c: [True] * len(c)) == []
+
+
+# ------------------------------------------------------------- serialization
+
+
+def test_simconfig_toml_roundtrip_and_hash():
+    from madsim_tpu.tpu import SimConfig
+    from madsim_tpu.tpu import nemesis as tn
+    from madsim_tpu.tpu.spec import simconfig_from_toml
+
+    cfg = tn.compile_plan(STORM_PLAN, SimConfig(horizon_us=1_234_567))
+    again = simconfig_from_toml(cfg.to_toml())
+    assert again == cfg
+    assert again.hash() == cfg.hash()
+    # the hash keys on every knob, including nemesis clause parameters
+    tweaked = dataclasses.replace(cfg, nem_reorder_window_us=99_999)
+    assert tweaked.hash() != cfg.hash()
+    with pytest.raises(ValueError, match="unknown SimConfig"):
+        simconfig_from_toml("no_such_knob = 1\n")
+
+
+def test_plan_recovered_from_config_roundtrip():
+    from madsim_tpu.tpu import SimConfig
+    from madsim_tpu.tpu import nemesis as tn
+
+    cfg = tn.compile_plan(STORM_PLAN, SimConfig())
+    recovered = triage.plan_from_config(cfg)
+    # clause-by-clause equality (order is compile_plan's, name differs)
+    assert set(recovered.clauses) == set(STORM_PLAN.clauses)
+    # and the plan JSON face round-trips
+    again = triage.plan_from_json(triage.plan_to_json(recovered))
+    assert set(again.clauses) == set(STORM_PLAN.clauses)
+
+
+def test_bundle_json_roundtrip_and_validation(tmp_path):
+    from madsim_tpu.tpu import SimConfig
+
+    cfg = SimConfig(horizon_us=2_000_000)
+    bundle = triage.ReproBundle(
+        seed=42, spec_ref="pkg.mod:factory", spec_kwargs={"n": 5},
+        spec_name="raft5", n_nodes=5, config_toml=cfg.to_toml(),
+        config_hash=cfg.hash(), violation_kind="invariant",
+        violation_step=17, violation_t_us=123_456,
+        dropped_clauses=["crash"], occ_off={"partition": 5},
+        rate_scale={"loss": 0.25}, horizon_us=130_000, max_steps=10_000,
+        plan=triage.plan_to_json(SCHED_PLAN), trace_tail=["ev1", "ev2"],
+    )
+    path = tmp_path / "b.json"
+    bundle.save(str(path))
+    again = triage.ReproBundle.load(str(path))
+    assert again == bundle
+    assert again.config() == cfg  # hash-checked parse
+    with pytest.raises(ValueError, match="format"):
+        triage.ReproBundle.from_json(json.dumps({"format": "bogus/9"}))
+    doc = json.loads(bundle.to_json())
+    doc["config_toml"] = doc["config_toml"].replace(
+        "loss_rate = 0.0", "loss_rate = 0.5"
+    )  # tamper an existing knob
+    with pytest.raises(ValueError, match="hash mismatch"):
+        triage.ReproBundle(**doc).config()
+
+
+def test_filtered_schedule_drops_whole_occurrence_windows():
+    evs = SCHED_PLAN.schedule(11, HORIZON_US, 5)
+    crash_ks = sorted({e.k for e in evs if e.kind in ("crash", "restart")})
+    assert crash_ks and crash_ks[0] == 0
+    kept = nm.filter_schedule(evs, occ_off={"crash": 0b1})
+    assert not any(
+        e.kind in ("crash", "restart") and e.k == 0 for e in kept
+    )
+    # both halves of later windows survive untouched, times unchanged
+    assert [e for e in kept if e.k != 0 or e.kind in ("split", "heal")] == [
+        e for e in evs if not (e.kind in ("crash", "restart") and e.k == 0)
+    ]
+
+
+def test_atom_universe_enumeration():
+    from madsim_tpu.tpu import SimConfig
+    from madsim_tpu.tpu import nemesis as tn
+
+    cfg = tn.compile_plan(STORM_PLAN, SimConfig(horizon_us=HORIZON_US))
+    plan = triage.plan_from_config(cfg)
+    atoms = triage.enumerate_atoms(plan, cfg, 7, HORIZON_US, 5)
+    names = {n for n, _ in atoms}
+    # schedule clauses contribute occurrence atoms, message clauses one each
+    assert {"crash", "partition", "spike", "loss", "dup", "reorder",
+            "skew"} <= names
+    assert any(k is not None for n, k in atoms if n == "crash")
+    # a tighter horizon yields (weakly) fewer atoms
+    fewer = triage.enumerate_atoms(plan, cfg, 7, HORIZON_US // 4, 5)
+    assert len(fewer) <= len(atoms)
+
+
+# ------------------------------------------------------------------- device
+
+
+@pytest.mark.chaos
+def test_planted_restamp_shrinks_to_minimal_bundle(tmp_path):
+    """The acceptance path on the deposed-leader re-stamp regression: a
+    multi-clause FaultPlan shrinks to a minimal clause/occurrence set with
+    the horizon bisected past the first violating step, in ≤ 10 batched
+    dispatches — and the bundle replays on both backends."""
+    from madsim_tpu import repro
+    from madsim_tpu.tpu import BatchedSim, run_batch
+    from madsim_tpu.tpu import nemesis as tn
+
+    wl = _sched_workload()
+    result = run_batch(range(24), wl, repro_on_host=False, max_traces=0)
+    assert result.violations > 0, result.summary
+    assert result.summary["first_violation_step"] >= 0
+    seed = result.violating_seeds[0]
+
+    sim = BatchedSim(wl.spec, wl.config, triage=True)
+    sr = triage.shrink_seed(
+        wl, seed, out_dir=str(tmp_path), sim=sim,
+        spec_ref="test_triage:planted_restamp_spec",
+    )
+    bundle = sr.bundle
+    # ≤ 10 batched dispatches for the whole shrink (acceptance criterion)
+    assert sr.dispatches <= 10, sr.dispatches
+    # genuinely shrunk: fewer atoms kept than the universe had, and at
+    # least one whole clause dropped from the two-clause plan
+    assert 0 < len(sr.kept_atoms) < sr.original_atoms
+    assert bundle.dropped_clauses
+    # horizon bisected to just past the (possibly earlier) final violation
+    assert bundle.horizon_us < wl.config.horizon_us
+    assert (
+        bundle.violation_t_us
+        < bundle.horizon_us
+        <= bundle.violation_t_us + 2_000
+    )
+    assert bundle.violation_step > 0
+    assert bundle.trace_tail and "VIOLATION" in bundle.trace_tail[-1]
+    assert bundle.config_hash == wl.config.hash()
+
+    # device replay: violation at the recorded step/time, bit-identical
+    # across repeats (in-process here; cross-process in
+    # test_cross_process_repro.py)
+    rep = repro.replay_device(
+        bundle, spec=wl.spec, repeats=2, out=lambda *_: None
+    )
+    assert rep["step"] == bundle.violation_step
+    assert rep["t_us"] == bundle.violation_t_us
+
+    # the twin invariant survives shrinking: the device chaos stream under
+    # the bundle's ctl equals the shrunk plan's occurrence-filtered pure
+    # schedule
+    shrunk = bundle.shrunk_plan()
+    compared = tn.assert_device_matches_schedule(
+        sim, shrunk, seed, horizon_us=bundle.horizon_us,
+        ctl=bundle.ctl(1), occ_off=bundle.occ_off,
+    )
+    # ... and the host driver applies exactly that filtered schedule
+    repro.replay_host(bundle, out=lambda *_: None)
+
+    # triage default ctl is the plain engine bit-for-bit: the full-ctl
+    # baseline found the violation at the same step the plain sweep did
+    import numpy as np
+
+    lane = result.violating_seeds.index(seed)
+    plain_step = int(np.asarray(result.state.violation_step)[
+        np.nonzero(result.violated)[0][lane]
+    ])
+    tri_state = sim.run([seed] * 16, max_steps=wl.max_steps)
+    assert int(np.asarray(tri_state.violation_step)[0]) == plain_step
+
+    _shared["result"] = result
+    _shared["shrink"] = sr
+    _shared["compared_events"] = compared
+
+
+@pytest.mark.chaos
+def test_batch_violation_reports_bundle_and_repro_command(monkeypatch):
+    """BatchViolation carries the single-seed env repro command and, after
+    a shrink, the bundle path + replay one-liner (satellite: CI logs are
+    self-serve)."""
+    from madsim_tpu.tpu.batch import BatchViolation
+
+    if "shrink" not in _shared:
+        pytest.skip("needs the shrink result from the acceptance test")
+    result = _shared["result"]
+    sr = _shared["shrink"]
+    result.bundle, result.bundle_path = sr.bundle, sr.bundle_path
+    with pytest.raises(BatchViolation) as e:
+        result.raise_on_violation()
+    msg = str(e.value)
+    assert f"MADSIM_TEST_SEED={result.violating_seeds[0]}" in msg
+    assert "MADSIM_TEST_NUM=1" in msg
+    assert "python -m pytest" in msg  # the pytest node id marker
+    assert f"python -m madsim_tpu.repro {sr.bundle_path}" in msg
+    assert e.value.bundle_path == sr.bundle_path
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_storm_plan_full_shrink_with_all_clause_kinds(tmp_path):
+    """Nightly: the 7-clause storm (every clause kind an atom, message
+    rates shrinkable) still reduces within the dispatch budget and the
+    bundle replays."""
+    from madsim_tpu import repro
+    from madsim_tpu.tpu import SimConfig, raft_workload, run_batch
+    from madsim_tpu.tpu import nemesis as tn
+
+    cfg = tn.compile_plan(
+        STORM_PLAN, SimConfig(horizon_us=HORIZON_US, loss_rate=0.1)
+    )
+    wl = dataclasses.replace(
+        raft_workload(spec=planted_restamp_spec()), config=cfg,
+        host_repro=None,
+    )
+    result = run_batch(range(64), wl, repro_on_host=False, max_traces=0)
+    assert result.violations > 0
+    sr = triage.shrink_seed(
+        wl, result.violating_seeds[0], out_dir=str(tmp_path),
+    )
+    assert sr.dispatches <= 12  # rate probes may add up to two dispatches
+    assert len(sr.kept_atoms) < sr.original_atoms
+    assert sr.bundle.horizon_us < cfg.horizon_us
+    rep = repro.replay_device(
+        sr.bundle, spec=wl.spec, repeats=2, out=lambda *_: None
+    )
+    assert rep["step"] == sr.bundle.violation_step
+
+
+@pytest.mark.chaos
+def test_chaos_free_violation_shrinks_to_empty_plan(tmp_path):
+    """A protocol bug that needs NO chaos must shrink to the empty plan —
+    and the bundle must replay with every clause suppressed (regression:
+    the confirmation lane once ran with ALL chaos enabled while the
+    bundle recorded everything dropped, so replays missed the recorded
+    step)."""
+    import jax.numpy as jnp
+
+    from madsim_tpu import repro
+    from madsim_tpu.tpu import make_raft_spec
+    from madsim_tpu.tpu.spec import replace_handlers
+
+    base = make_raft_spec(5)
+
+    def broken_invariants(ns, alive, now):
+        # violates on pure virtual time, chaos or not
+        return jnp.asarray(now < 600_000)
+
+    spec = replace_handlers(base, check_invariants=broken_invariants)
+    wl = dataclasses.replace(_sched_workload(), spec=spec)
+    sr = triage.shrink_seed(wl, 3, out_dir=str(tmp_path), lane_width=4)
+    assert sr.kept_atoms == []
+    # every clause in the universe is recorded dropped, none half-applied
+    assert set(sr.bundle.dropped_clauses) == {"crash", "partition"}
+    assert sr.bundle.occ_off == {}
+    rep = repro.replay_device(sr.bundle, spec=spec, out=lambda *_: None)
+    assert rep["step"] == sr.bundle.violation_step
+    assert rep["t_us"] == sr.bundle.violation_t_us
+
+
+@pytest.mark.chaos
+def test_shrink_rejects_non_violating_seed():
+    wl = _sched_workload()
+    # quiet config: no nemesis, tiny horizon — a healthy raft never violates
+    quiet = dataclasses.replace(
+        wl, config=dataclasses.replace(
+            wl.config,
+            nem_crash_interval_lo_us=0, nem_crash_interval_hi_us=0,
+            nem_partition_interval_lo_us=0, nem_partition_interval_hi_us=0,
+            horizon_us=1_000_000,
+        ),
+    )
+    with pytest.raises(triage.NotReproducible, match="does not violate"):
+        triage.shrink_seed(quiet, 0, lane_width=2)
+
+
+def test_ctl_requires_triage_mode():
+    from madsim_tpu.tpu import BatchedSim, SimConfig, make_raft_spec
+
+    sim = BatchedSim(make_raft_spec(5), SimConfig(horizon_us=500_000))
+    with pytest.raises(ValueError, match="triage=True"):
+        sim.init([0, 1], triage.build_ctl(2, 500_000))

@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all chaos-smoke triage-smoke real native bench dryrun demo clean
+.PHONY: test deep test-all chaos-smoke triage-smoke real native bench bench-smoke ttfb dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -29,6 +29,12 @@ native:          ## (re)build the C++ executor core in place
 
 bench:           ## the headline JSON line (runs on the live jax backend)
 	$(PY) bench.py
+
+bench-smoke:     ## <60s/workload micro-bench: completion + dispatch budget, never wall-clock
+	$(PY) benches/bench_smoke.py
+
+ttfb:            ## time-to-first-bug: cold-runtime wall to violation + ReproBundle on planted bugs
+	$(PY) benches/ttfb.py
 
 dryrun:          ## multi-chip sharding dry run on a virtual 8-device mesh
 	cd /tmp && $(PY) $(CURDIR)/__graft_entry__.py

@@ -1,0 +1,106 @@
+"""bench-smoke: a <60s-per-workload micro-bench for CI and the tier-1 tier.
+
+The full bench.py needs a real accelerator, tens of minutes, and a quiet
+machine; regressions in the SWEEP MACHINERY (eager init, per-chunk
+recompiles, a dispatch storm like the r5 ~1.4 s/sweep bug) don't need any
+of that to show up — they show up in the DISPATCH COUNT, which is
+platform-independent and contention-proof. Each workload runs a tiny
+sweep (64 lanes, ~0.6 virtual seconds) through the production run_batch
+path and asserts:
+
+  * completion with zero violations (the clean specs stay clean),
+  * zero pool overflow (the zero-drop discipline at smoke scale),
+  * the dispatch budget: init + one sweep segment = 2 device program
+    launches per chunk, exactly (BatchResult.dispatches).
+
+It NEVER asserts wall-clock — that is bench.py's job, on real hardware,
+with the fresh-seed/median discipline. Wall times are printed for eyes
+only.
+
+Usage: python benches/bench_smoke.py  (or `make bench-smoke`)
+Exit code != 0 on any assertion failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LANES = 64
+VIRTUAL_SECS = 0.6
+MAX_STEPS = 2_500  # < dispatch_steps (10k): the sweep must be ONE segment
+
+
+def workloads():
+    from madsim_tpu.tpu import chain_workload, raft_workload
+    from madsim_tpu.tpu.kv import kv_workload
+    from madsim_tpu.tpu.paxos import paxos_workload
+    from madsim_tpu.tpu.twopc import twopc_workload
+
+    return {
+        "raft": raft_workload(virtual_secs=VIRTUAL_SECS),
+        "kv": kv_workload(virtual_secs=VIRTUAL_SECS),
+        "twopc": twopc_workload(virtual_secs=VIRTUAL_SECS),
+        "paxos": paxos_workload(virtual_secs=VIRTUAL_SECS),
+        "chain": chain_workload(virtual_secs=VIRTUAL_SECS),
+    }
+
+
+def smoke_one(name: str, wl) -> dict:
+    from madsim_tpu.tpu.batch import run_batch
+
+    wl = dataclasses.replace(wl, max_steps=MAX_STEPS, host_repro=None)
+    t0 = time.perf_counter()
+    # mesh=None: a fixed single-shard layout keeps the dispatch budget
+    # exact everywhere (the mesh path adds one device_put per chunk)
+    res = run_batch(
+        range(LANES), wl, mesh=None, max_traces=0, repro_on_host=False
+    )
+    wall = time.perf_counter() - t0
+    row = {
+        "violations": res.violations,
+        "overflow": int(res.summary["total_overflow"]),
+        "dispatches": res.dispatches,
+        "device_ms": round(res.device_ms, 1),
+        "wall_s": round(wall, 2),  # informational ONLY — never asserted
+        "events": int(res.summary["total_events"]),
+    }
+    errors = []
+    if res.violations:
+        errors.append(f"{res.violations} violations on a clean spec")
+    if row["overflow"]:
+        errors.append(f"pool overflow {row['overflow']} at smoke scale")
+    # the budget: ONE jitted init + ONE while_loop segment, nothing else.
+    # An eager init is dozens of launches; a per-chunk recompile shows up
+    # as timeouts; a step-granular loop would be thousands.
+    if res.dispatches != 2:
+        errors.append(
+            f"dispatch budget blown: {res.dispatches} launches per sweep "
+            "(expected 2: jitted init + one run segment)"
+        )
+    if row["events"] <= 0:
+        errors.append("no events simulated — the sweep did nothing")
+    if errors:
+        row["errors"] = errors
+    return row
+
+
+def main() -> int:
+    out = {}
+    failed = False
+    for name, wl in workloads().items():
+        row = smoke_one(name, wl)
+        out[name] = row
+        failed = failed or bool(row.get("errors"))
+    out["ok"] = not failed
+    print(json.dumps(out), flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,6 +42,24 @@ class RuntimeMetrics:
         node = self._executor.nodes.get(node_id)
         return len(node.info.tasks) if node else 0
 
+    # -- sweep-overhead visibility (the host half of BatchResult's r6
+    # `dispatches`/`device_ms` fields: one vocabulary for "what did the
+    # execution machinery cost me" on both backends) --
+
+    @property
+    def dispatches(self) -> int:
+        """Scheduling rounds the executor drained so far — the host
+        runtime's analog of device program launches: each round is one
+        ready-queue drain between virtual-time advances."""
+        return self._executor.sched_rounds
+
+    @property
+    def device_ms(self) -> float:
+        """Wall-clock ms spent inside the executor's run loop (task
+        polls, not time-wheel bookkeeping) — what `BatchResult.device_ms`
+        reports for a device sweep."""
+        return self._executor.loop_busy_s * 1e3
+
     # -- chaos coverage (the nemesis / buggify fire registries) --
 
     def chaos_fires(self) -> Dict[str, int]:

@@ -29,6 +29,7 @@ fallback.
 from __future__ import annotations
 
 import sys
+import time as _time
 from typing import Any, Callable, Coroutine, Dict, List, Optional, Union
 
 from . import context
@@ -322,6 +323,11 @@ class Executor:
         # wires itself in via Runtime)
         self.on_node_created: List[Callable[[NodeId], None]] = []
         self.on_node_reset: List[Callable[[NodeId], None]] = []
+        # sweep-overhead visibility (RuntimeMetrics.dispatches/device_ms,
+        # the host half of BatchResult's r6 fields): scheduling rounds
+        # drained and wall time spent draining them
+        self.sched_rounds = 0
+        self.loop_busy_s = 0.0
 
     # -- task plumbing --
 
@@ -465,6 +471,14 @@ class Executor:
                 )
 
     def run_all_ready(self) -> None:
+        self.sched_rounds += 1
+        t0 = _time.perf_counter()
+        try:
+            self._run_all_ready()
+        finally:
+            self.loop_busy_s += _time.perf_counter() - t0
+
+    def _run_all_ready(self) -> None:
         while self.ready:
             task = self._pop_random()
             task._in_queue = False

@@ -33,6 +33,7 @@ import dataclasses
 import functools
 import inspect
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -149,6 +150,15 @@ class BatchResult:
     workload: Optional["BatchWorkload"] = None
     bundle: Any = None  # triage.ReproBundle | None
     bundle_path: Optional[str] = None
+    # sweep-overhead visibility without running benches: how many device
+    # program launches the sweep itself cost (init + run segments +
+    # sharding puts, via BatchedSim.dispatch_count — excludes post-sweep
+    # traces/shrinks), and the sweep loop's wall time in ms (dispatch
+    # through readback of the last chunk). The dispatch-budget regression
+    # test pins `dispatches` so eager-init-style regressions (r5's
+    # ~1.4 s/sweep of per-op dispatch latency) can't silently return.
+    dispatches: int = 0
+    device_ms: float = 0.0
 
     @property
     def violations(self) -> int:
@@ -226,6 +236,41 @@ def resolve_mesh(mesh) -> Optional[Any]:
     return mesh
 
 
+def pipelined(items, dispatch, decode, serial: bool = False):
+    """Double-buffered dispatch/decode loop — the chunk pipeline shared by
+    run_batch, triage's ddmin generations, and benches/ttfb.py.
+
+    `dispatch(item)` launches one chunk's device work and returns an entry
+    without waiting on results; `decode(entry)` reads the chunk's small
+    outputs (this is where the host blocks). Item k+1 is dispatched BEFORE
+    entry k is decoded, so host decoding overlaps device time. Decode
+    order stays item order, so any aggregation inside `decode` is
+    byte-for-byte what the serial loop produces.
+
+    The first non-None value returned by `decode` short-circuits the loop
+    (the in-flight chunk, if any, is dropped undecoded — the price of the
+    overlap) and becomes this function's return value. `serial=True`
+    decodes each entry immediately after its dispatch (same results, no
+    overlap) — the reference loop the pipelining tests compare against.
+    """
+    pending = None
+    for item in items:
+        entry = dispatch(item)
+        if serial:
+            hit = decode(entry)
+            if hit is not None:
+                return hit
+        else:
+            if pending is not None:
+                hit = decode(pending)
+                if hit is not None:
+                    return hit
+            pending = entry
+    if pending is not None:
+        return decode(pending)
+    return None
+
+
 def run_batch(
     seeds: Sequence[int],
     workload: BatchWorkload,
@@ -237,6 +282,7 @@ def run_batch(
     check_determinism: bool = False,
     shrink_on_violation: bool = False,
     shrink_kwargs: Optional[Dict[str, Any]] = None,
+    pipeline: bool = True,
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
 
@@ -262,6 +308,16 @@ def run_batch(
     (madsim_tpu/triage.py; a handful of extra batched dispatches), written
     under triage.default_bundle_dir() unless shrink_kwargs["out_dir"] says
     otherwise, and reported in BatchViolation with its replay one-liner.
+
+    `pipeline` (default on) double-buffers the chunk loop: chunk k+1's
+    device program is dispatched BEFORE the host decodes chunk k's
+    violation/metrics scalars, so host-side decoding (summarize, the
+    lane_check oracle) overlaps the next chunk's device time instead of
+    serializing with it — JAX async dispatch does the rest, and the host
+    only ever blocks on the small reduction outputs it is reading. Results
+    are bit-identical to the serial loop (the device programs and their
+    inputs are unchanged; only the host's read order moves), which the
+    pipelining-determinism tests pin.
     """
     seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
     if seeds_arr.ndim != 1 or seeds_arr.size == 0:
@@ -275,7 +331,15 @@ def run_batch(
     state: Optional[SimState] = None
     totals: Dict[str, float] = {}
     weights: Dict[str, int] = {}
-    for off in range(0, seeds_arr.size, chunk):
+    disp_before = sim.dispatch_count
+    t_sweep = time.perf_counter()
+
+    def dispatch(off: int):
+        """Launch one chunk's sweep. For single-segment runs (max_steps <=
+        dispatch_steps) this returns without waiting on results; longer
+        runs block only on the engine's tiny inter-segment early-stop
+        reduction, with the next segment already enqueued — the device
+        stays busy either way (engine.run's speculative early-stop)."""
         part = seeds_arr[off : off + chunk]
         pad = (-part.size) % n_dev
         if pad:
@@ -284,24 +348,35 @@ def run_batch(
             part_in = np.concatenate([part, np.repeat(part[:1], pad)])
         else:
             part_in = part
-        state = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
-        if check_determinism:
-            rerun = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+        st = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+        rerun = (
+            sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+            if check_determinism else None
+        )
+        return off, part.size, pad, st, rerun
+
+    def decode(entry) -> None:
+        """Read one chunk's small outputs and fold them into the totals
+        (this is where the host blocks on device results)."""
+        nonlocal state
+        off, size, pad, st, rerun = entry
+        if rerun is not None:
             _assert_runs_bitwise_equal(
-                state, rerun, f"seeds[{off}:{off + part.size}]"
+                st, rerun, f"seeds[{off}:{off + size}]"
             )
         if pad:
-            state = jax.tree_util.tree_map(lambda x: x[: part.size], state)
-        violated_parts.append(np.asarray(state.violated))
-        deadlocked_parts.append(np.asarray(state.deadlocked))
-        s = summarize(state, workload.spec)
+            st = jax.tree_util.tree_map(lambda x: x[:size], st)
+        state = st
+        violated_parts.append(np.asarray(st.violated))
+        deadlocked_parts.append(np.asarray(st.deadlocked))
+        s = summarize(st, workload.spec)
         if workload.lane_check is not None:
             # deep host-side oracle: every violating lane + a clean sample
             v = np.nonzero(violated_parts[-1])[0]
             clean = np.nonzero(~violated_parts[-1])[0][: workload.lane_check_sample]
             picked = np.concatenate([v, clean])
             if picked.size:
-                for k2, v2 in workload.lane_check(state, picked).items():
+                for k2, v2 in workload.lane_check(st, picked).items():
                     if isinstance(v2, (int, np.integer)):
                         s["lane_check_" + k2] = int(v2)
         for k, v in s.items():
@@ -313,12 +388,22 @@ def run_batch(
                 totals[k] = min(totals.get(k, v), v)
             elif k.startswith("mean_"):
                 # lane-weighted average across chunks, not a sum of means
-                totals[k] = totals.get(k, 0) + v * part.size
-                weights[k] = weights.get(k, 0) + part.size
+                totals[k] = totals.get(k, 0) + v * size
+                weights[k] = weights.get(k, 0) + size
             else:
                 totals[k] = totals.get(k, 0) + v
+
+    # double-buffered chunk loop: one chunk in flight on device while the
+    # host decodes its predecessor (decode always returns None — every
+    # chunk is aggregated; no early exit)
+    pipelined(
+        range(0, seeds_arr.size, chunk), dispatch, decode,
+        serial=not pipeline,
+    )
     for k, w in weights.items():
         totals[k] = totals[k] / w
+    sweep_dispatches = sim.dispatch_count - disp_before
+    sweep_ms = (time.perf_counter() - t_sweep) * 1e3
 
     violated = np.concatenate(violated_parts)
     deadlocked = np.concatenate(deadlocked_parts)
@@ -332,6 +417,8 @@ def run_batch(
 
     if enabled_fire_kinds(sim.config):
         totals["chaos_coverage"] = coverage_report(totals, sim.config)
+    totals["dispatches"] = sweep_dispatches
+    totals["device_ms"] = round(sweep_ms, 3)
     result = BatchResult(
         seeds=seeds_arr,
         violated=violated,
@@ -339,6 +426,8 @@ def run_batch(
         summary=totals,
         state=state,
         workload=workload,
+        dispatches=sweep_dispatches,
+        device_ms=sweep_ms,
     )
 
     if result.violations and shrink_on_violation:

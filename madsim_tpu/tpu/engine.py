@@ -561,6 +561,19 @@ class BatchedSim:
         # latency) — comparable to the entire 1,270-step simulation it
         # precedes. One jitted call collapses it to one dispatch.
         self.init = jax.jit(self._init)
+        # tiny scalar reduction for the chunked sweep's early-stop check:
+        # dispatched BEFORE the next segment so reading it never leaves
+        # the device idle for a host round-trip (see run())
+        self._any_alive = jax.jit(lambda s: jnp.any(~s.done))
+        # device program launches made by this sim's run paths (init +
+        # sweep segments + early-stop reductions + sharding device_put).
+        # run_batch snapshots the counter around a sweep to fill
+        # BatchResult.dispatches, and the dispatch-budget regression test
+        # pins it: an eager-init-style regression (the r5 ~1.4 s/sweep
+        # bug: dozens of per-op dispatches where one jitted program
+        # should be) blows the budget loudly instead of silently eating
+        # the sweep.
+        self.dispatch_count = 0
 
     # ------------------------------------------------------------------ init
 
@@ -1758,7 +1771,19 @@ class BatchedSim:
 
     # ------------------------------------------------------------------ run
 
-    @functools.partial(jax.jit, static_argnums=(0, 2))
+    # donate_argnums=1: the carry state's buffers are DONATED to each sweep
+    # segment — XLA writes the output state into the input's HBM instead of
+    # allocating a fresh ~100 MB pytree per dispatch and leaving the old one
+    # live until the host drops its reference. Inside the while_loop XLA
+    # already aliases the loop carry; donation extends that aliasing across
+    # the chunked-dispatch boundary, so a long sweep's peak HBM is ONE state
+    # (not two) and the inter-segment allocate/copy round-trip disappears.
+    # Safe because `run` immediately rebinds `state` to the result: the
+    # donated input is never read again (jax invalidates it loudly if a
+    # future caller tries).
+    @functools.partial(
+        jax.jit, static_argnums=(0, 2), donate_argnums=(1,)
+    )
     def _run(self, state: SimState, max_steps: int) -> SimState:
         def cond(carry):
             s, i = carry
@@ -1790,12 +1815,23 @@ class BatchedSim:
         running for minutes, which remote-tunnel TPU runtimes have been
         observed to kill (worker crash at ~70s on a 32k-lane, 24k-step
         dispatch). Chunking bounds each kernel's runtime and lets the host
-        stop as soon as every lane is done, at the cost of one host sync
-        per chunk. At most two programs compile (chunk size + final tail).
+        stop soon after every lane is done. At most two programs compile
+        (chunk size + final tail).
+
+        The early-stop check is SPECULATIVE (r6): segment k+1 is enqueued
+        before the host reads segment k's all-done reduction, so segments
+        run back-to-back with no host round-trip between them (the r5
+        loop blocked on `done.all()` before each dispatch — one tunnel
+        RTT of device idle per segment). When segment k did finish every
+        lane, the speculatively-enqueued k+1 is a device no-op (the
+        while_loop's cond is false on entry) and the loop exits one
+        dispatch later than strictly needed; results are bit-identical
+        either way.
         """
         if dispatch_steps <= 0:
             raise ValueError(f"dispatch_steps must be positive, got {dispatch_steps}")
         state = self.init(seeds) if ctl is None else self.init(seeds, ctl)
+        self.dispatch_count += 1
         if mesh is not None:
             L = state.clock.shape[0]
             n_dev = int(mesh.devices.size)
@@ -1805,13 +1841,31 @@ class BatchedSim:
                     "pad the seed batch (run_batch does this automatically)"
                 )
             state = self.shard_state(state, mesh, lane_axis=mesh.axis_names[0])
+            self.dispatch_count += 1  # the single whole-pytree device_put
         remaining = max_steps
+        alive = None
         while remaining > 0:
+            if alive is not None:
+                # enqueue the previous segment's all-done reduction FIRST
+                # (tiny scalar; reads state.done before the donation
+                # below — PJRT keeps the buffer alive for the in-flight
+                # reader, so donation stays safe)
+                alive = self._any_alive(state)
+                self.dispatch_count += 1
             n = min(dispatch_steps, remaining)
+            # _run DONATES state: the rebinding here is what makes that
+            # legal — the pre-segment buffers are dead the moment the
+            # segment is dispatched
             state = self._run(state, n)
+            self.dispatch_count += 1
             remaining -= n
-            if remaining > 0 and bool(state.done.all()):
+            # block on the reduction only AFTER the next segment is in
+            # flight: the early stop costs at most one no-op segment,
+            # never a device-idle host round-trip
+            if alive is not None and not bool(alive):
                 break
+            if alive is None and remaining > 0:
+                alive = True  # arm the check from the second segment on
         return state
 
     @functools.partial(jax.jit, static_argnums=(0, 2))
@@ -1824,7 +1878,12 @@ class BatchedSim:
         final, _ = jax.lax.scan(body, state, None, length=n_steps)
         return final
 
-    @functools.partial(jax.jit, static_argnums=(0, 2))
+    # donated like _run: run_traced hands the freshly-built init state in
+    # and never touches it again (the [T, 1, ...] record stream is a new
+    # allocation either way)
+    @functools.partial(
+        jax.jit, static_argnums=(0, 2), donate_argnums=(1,)
+    )
     def _run_traced(self, state: SimState, n_steps: int):
         def body(s, _):
             s2, rec = self._step_traced(s)
@@ -1845,6 +1904,7 @@ class BatchedSim:
         """
         seeds = jnp.asarray([seed], jnp.uint32)
         state = self.init(seeds) if ctl is None else self.init(seeds, ctl)
+        self.dispatch_count += 2  # init + the traced scan below
         return self._run_traced(state, max_steps)
 
     # ------------------------------------------------------------ sharding

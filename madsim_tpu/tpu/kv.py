@@ -595,27 +595,25 @@ def make_kv_spec(
         kind = ns.h_kind  # [N, OPS] ring ops (node-major kept: no reshape)
         valid = kind > 0
 
-        a = la_ok[:, None, None] & valid[None, :, :]  # [Nla, N, OPS]
-        same_key = ns.la_key[:, None, None] == ns.h_key[None, :, :]
+        # one [Nla, N, OPS] comparable-pair base mask shared by all three
+        # ring conditions, OR-folded BEFORE the reduction: one any() pass
+        # over one combined mask instead of three masked reductions (the
+        # masks are generated in-register, but the reduction passes are
+        # real work in the per-step hot loop)
+        base = (
+            la_ok[:, None, None] & valid[None, :, :]
+            & (ns.la_key[:, None, None] == ns.h_key[None, :, :])
+        )
+        la_rev = ns.la_rev[:, None, None]
+        h_rev = ns.h_rev[None, :, :]
         # real-time rev monotonicity, BOTH directions (same-step acks on
-        # other nodes land in the rings too):
-        #   register op invoked after ring op responded, smaller rev
-        stale_a = (
-            a & same_key
-            & (ns.la_tinv[:, None, None] > ns.h_trsp[None, :, :])
-            & (ns.la_rev[:, None, None] < ns.h_rev[None, :, :])
-        )
-        #   ring op invoked after register op responded, smaller rev
-        stale_b = (
-            a & same_key
-            & (ns.h_tinv[None, :, :] > ns.la_trsp[:, None, None])
-            & (ns.h_rev[None, :, :] < ns.la_rev[:, None, None])
-        )
-        # value coherence: same (key, rev) => same value
-        incoherent = (
-            a & same_key
-            & (ns.la_rev[:, None, None] == ns.h_rev[None, :, :])
-            & (ns.la_val[:, None, None] != ns.h_val[None, :, :])
+        # other nodes land in the rings too): register op invoked after
+        # ring op responded with a smaller rev, or vice versa; plus value
+        # coherence (same (key, rev) must observe the same value)
+        bad_pair = (
+            ((ns.la_tinv[:, None, None] > ns.h_trsp[None, :, :]) & (la_rev < h_rev))
+            | ((ns.h_tinv[None, :, :] > ns.la_trsp[:, None, None]) & (h_rev < la_rev))
+            | ((la_rev == h_rev) & (ns.la_val[:, None, None] != ns.h_val[None, :, :]))
         )
         # watermark staleness: a register op invoked after some node's
         # max-rev watermark was established must not observe a smaller
@@ -628,10 +626,7 @@ def make_kv_spec(
             & (ns.wm_t[None, :, :] < ns.la_tinv[:, None, None])
             & (ns.wm_rev[None, :, :] > ns.la_rev[:, None, None])
         )
-        return ~(
-            stale_a.any() | stale_b.any() | incoherent.any()
-            | wm_stale.any()
-        )
+        return ~((base & bad_pair).any() | wm_stale.any())
 
     # ------------------------------------------------------------ diagnostics
 

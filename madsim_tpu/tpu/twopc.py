@@ -60,17 +60,10 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
-import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import (  # noqa: F401
-    Outbox,
-    ProtocolSpec,
-    empty_outbox,
-    fuse_two_handlers,
-    tree_select,
-)
+from .spec import Outbox, ProtocolSpec
 
 NONE, COMMIT, ABORT = 0, 1, 2
 PREPARE, VOTE, OUTCOME, DREQ = 0, 1, 2, 3
@@ -104,34 +97,6 @@ def make_twopc_spec(
     tidx = jnp.arange(TXN, dtype=jnp.int32)
     ALL_YES = (1 << N) - 2  # bits 1..N-1
     IDLE_FAR = 2**28  # "unarmed" participant timer offset (ns-safe int32)
-
-    def no_out():
-        return empty_outbox(N, PAYLOAD_WIDTH)
-
-    def reply(dst, kind, tid, flag):
-        """One message in outbox ROW dst (not row 0): each destination gets
-        its own pool region, so the coordinator answering several DREQs
-        within one latency window never overflows a shared region."""
-        pay = jnp.zeros((N, PAYLOAD_WIDTH), jnp.int32)
-        pay = pay.at[dst, 0].set(tid).at[dst, 1].set(flag)
-        return Outbox(
-            valid=(peers == dst),
-            dst=jnp.full((N,), dst, jnp.int32),
-            kind=jnp.full((N,), kind, jnp.int32),
-            payload=pay,
-        )
-
-    def broadcast(kind, tid, flag):
-        """Coordinator -> all participants."""
-        pay = jnp.zeros((PAYLOAD_WIDTH,), jnp.int32).at[0].set(tid).at[1].set(flag)
-        return Outbox(
-            valid=(peers != 0),
-            dst=peers,
-            kind=jnp.full((N,), kind, jnp.int32),
-            payload=jnp.broadcast_to(pay[None, :], (N, PAYLOAD_WIDTH)),
-        )
-
-    pick_out = pick_state = tree_select
 
     def record_outcome(s: TpcState, do, tid, outcome):
         """Claim slot tid%TXN for (tid, outcome) when `do`; first write for
@@ -191,107 +156,185 @@ def make_twopc_spec(
         )
         return state, first
 
-    # ----------------------------------------------------------------- timer
+    # ----------------------------------------------------------- fused event
 
-    def on_timer(s: TpcState, nid, now, key):
+    def on_event(s: TpcState, nid, src, kind, payload, now, key):
+        """ALL events — PREPARE/VOTE/OUTCOME/DREQ and the timer tick
+        (kind == -1) — as ONE masked handler (the r5 kit's fused form,
+        applied to 2PC in r6).
+
+        The r5 spec ran `lax.switch` over four per-kind handlers inside
+        `fuse_two_handlers`: under vmap the switch executes EVERY branch
+        and selects, on_timer ran as a second full body, and tree_select
+        materialized two whole candidate states — ~6 TpcState builds (and
+        three ring passes through record_outcome) per node per step. The
+        fused form computes each state field once under mutually exclusive
+        event masks and folds the three record_outcome call sites into ONE
+        ring pass. Each kind's logic is the direct transcription of the
+        r5 per-kind handlers (h_prepare, h_vote, h_outcome, h_dreq, and
+        on_timer — see git history for the originals side by side); PRNG
+        sites (32/33/34) and draw formulas are unchanged, so trajectories
+        are bit-identical to the r5 spec's.
+        """
+        f = payload
+        is_timer = kind == -1
         is_coord = nid == 0
+        tid_msg = f[0]
+        flag = f[1]
+        out_msg = outcome_of(s, tid_msg)  # recorded outcome for f[0]
 
-        # -- coordinator: a timer fire with an open undecided txn means the
+        # ====================== timer path (kind == -1) ===================
+        # coordinator: a timer fire with an open undecided txn means the
         # prepare deadline passed OR this is post-restart recovery — both
         # are the presumed-abort case. Otherwise start the next txn.
-        open_undecided = (s.tid_cur >= 0) & (outcome_of(s, s.tid_cur) == NONE)
-        do_abort = is_coord & open_undecided
-        do_start = is_coord & ~open_undecided
+        open_undecided = (s.tid_cur >= 0) & (
+            outcome_of(s, s.tid_cur) == NONE
+        )
+        do_abort = is_timer & is_coord & open_undecided
+        do_start = is_timer & is_coord & ~open_undecided
         new_tid = s.tid_cur + 1
-
-        s_c = s._replace(
-            tid_cur=jnp.where(do_start, new_tid, s.tid_cur),
-            vote_mask=jnp.where(do_start | do_abort, 0, s.vote_mask),
-        )
-        s_c = record_outcome(s_c, do_abort, s.tid_cur, ABORT)
-        out_c = pick_out(
-            do_abort,
-            broadcast(OUTCOME, s.tid_cur, ABORT),
-            pick_out(do_start, broadcast(PREPARE, new_tid, 0), no_out()),
-        )
-        timer_c = jnp.where(
-            do_start,
-            now + prepare_timeout_us,
-            now + prng.randint(key, 32, txn_gap_us // 2, txn_gap_us),
-        )
-
-        # -- participant: cooperative termination for the OLDEST in-doubt
+        # participant: cooperative termination for the OLDEST in-doubt
         # yes-vote (retries walk the set oldest-first as outcomes land)
         doubt = unresolved_yes(s)
         in_doubt = (~is_coord) & doubt.any()
         dreq_tid = jnp.where(doubt, s.v_tid, jnp.int32(2**30)).min()
-        out_p = pick_out(in_doubt, reply(0, DREQ, dreq_tid, 0), no_out())
-        timer_p = now + jnp.where(in_doubt, doubt_retry_us, IDLE_FAR)
+        do_dreq_send = is_timer & in_doubt
 
-        state = pick_state(is_coord, s_c, s)
-        out = pick_out(is_coord, out_c, out_p)
-        timer = jnp.where(is_coord, timer_c, timer_p)
-        return state, out, timer
+        # ====================== message path (kind >= 0) ==================
+        is_prep = kind == PREPARE
+        is_vote = kind == VOTE
+        is_outc = kind == OUTCOME
+        is_dreq = kind == DREQ
 
-    # -------------------------------------------------------------- messages
-
-    def h_prepare(s: TpcState, nid, src, f, now, key):
-        tid = f[0]
-        # defensive dedupe (the network never duplicates, but a re-PREPARE
-        # of a decided or already-voted txn must not re-roll the vote)
-        voted = ((tidx == (tid % TXN)) & (s.v_tid == tid)).any()
-        known = (outcome_of(s, tid) != NONE) | voted
-        do = (nid != 0) & ~known
-        yes = prng.uniform(prng.fold(key.astype(jnp.uint32), tid), 33) < vote_yes_p
-        # NO: record the local abort (presumed abort lets a no-voter forget)
-        s_no = record_outcome(record_vote(s, do & ~yes, tid, ABORT),
-                              do & ~yes, tid, ABORT)
-        # YES: durable yes-vote — in-doubt until an outcome lands
-        s_yes = record_vote(s, do & yes, tid, COMMIT)
-        state = pick_state(do & yes, s_yes, s_no)
+        # -- PREPARE: defensive dedupe (the network never duplicates, but a
+        # re-PREPARE of a decided or already-voted txn must not re-roll the
+        # vote); NO records a local abort (presumed abort lets a no-voter
+        # forget), YES records the durable in-doubt vote
+        voted = ((tidx == (tid_msg % TXN)) & (s.v_tid == tid_msg)).any()
+        do_prep = is_prep & (nid != 0) & ~((out_msg != NONE) | voted)
+        yes = (
+            prng.uniform(prng.fold(key.astype(jnp.uint32), tid_msg), 33)
+            < vote_yes_p
+        )
         vote_flag = jnp.where(yes, COMMIT, ABORT)
-        out = pick_out(do, reply(src, VOTE, tid, vote_flag), no_out())
-        # a yes-voter arms its in-doubt retry timer
-        timer = jnp.where(do & yes, now + doubt_retry_us, jnp.int32(-1))
-        return state, out, timer
 
-    def h_vote(s: TpcState, nid, src, f, now, key):
-        tid, flag = f[0], f[1]
-        live = (nid == 0) & (tid == s.tid_cur) & (outcome_of(s, tid) == NONE)
+        # -- VOTE: the coordinator's one open round; any NO => ABORT, all
+        # N-1 YES => COMMIT, decided in the same event that broadcasts
+        live = (
+            is_vote & is_coord & (tid_msg == s.tid_cur) & (out_msg == NONE)
+        )
         no = live & (flag == ABORT)
         mask = jnp.where(
             live & (flag == COMMIT), s.vote_mask | (1 << src), s.vote_mask
         )
         all_yes = live & (mask == ALL_YES)
         decide = no | all_yes
-        outcome = jnp.where(no, ABORT, COMMIT)
-        s2 = s._replace(vote_mask=jnp.where(decide, 0, mask))
-        s2 = record_outcome(s2, decide, tid, outcome)
-        out = pick_out(decide, broadcast(OUTCOME, tid, outcome), no_out())
-        # on decide, schedule the next round; else keep the prepare deadline
-        timer = jnp.where(
-            decide,
-            now + prng.randint(key, 34, txn_gap_us // 2, txn_gap_us),
-            jnp.int32(-1),
+
+        # -- DREQ: the coordinator re-sends a recorded outcome (stays
+        # silent while itself undecided; the participant retries)
+        have = is_dreq & is_coord & (out_msg != NONE)
+
+        # -- merged ring writes: the event masks are mutually exclusive, so
+        # the three r5 record_outcome sites (timer presumed-abort, prepare
+        # NO, vote decide) plus the OUTCOME apply collapse to ONE pass
+        rec_do = do_abort | (do_prep & ~yes) | decide | is_outc
+        rec_tid = jnp.where(do_abort, s.tid_cur, tid_msg)
+        rec_val = jnp.where(
+            do_abort | (do_prep & ~yes) | no, ABORT,
+            jnp.where(all_yes, COMMIT, flag),
         )
-        return s2, out, timer
+        state = s._replace(
+            tid_cur=jnp.where(do_start, new_tid, s.tid_cur),
+            vote_mask=jnp.where(do_start | do_abort | decide, 0, mask),
+        )
+        state = record_vote(state, do_prep, tid_msg, vote_flag)
+        state = record_outcome(state, rec_do, rec_tid, rec_val)
 
-    def h_outcome(s: TpcState, nid, src, f, now, key):
-        tid, outcome = f[0], f[1]
-        return record_outcome(s, True, tid, outcome), no_out(), jnp.int32(-1)
+        # ================== merged outbox (E = N rows) ====================
+        # broadcast events (coordinator only): presumed-abort OUTCOME, next
+        # PREPARE, decide OUTCOME — rows 1..N-1. Single-message events put
+        # the payload in outbox ROW dst (not row 0): each destination gets
+        # its own pool region, so the coordinator answering several DREQs
+        # within one latency window never overflows a shared region.
+        bcast = do_abort | do_start | decide
+        bc_kind = jnp.where(do_start, PREPARE, OUTCOME)
+        bc_tid = jnp.where(
+            do_abort, s.tid_cur, jnp.where(do_start, new_tid, tid_msg)
+        )
+        bc_flag = jnp.where(
+            do_start, 0, jnp.where(do_abort | no, ABORT, COMMIT)
+        )
+        single = do_prep | have | do_dreq_send
+        s_dst = jnp.where(do_dreq_send, jnp.int32(0), src)
+        s_kind = jnp.where(
+            do_prep, VOTE, jnp.where(have, OUTCOME, DREQ)
+        )
+        s_tid = jnp.where(do_dreq_send, dreq_tid, tid_msg)
+        s_flag = jnp.where(do_prep, vote_flag, jnp.where(have, out_msg, 0))
+        at_row = peers == s_dst  # [N]
 
-    def h_dreq(s: TpcState, nid, src, f, now, key):
-        tid = f[0]
-        known = outcome_of(s, tid)
-        have = (nid == 0) & (known != NONE)
-        out = pick_out(have, reply(src, OUTCOME, tid, known), no_out())
-        return s, out, jnp.int32(-1)
+        def fields(tid, fl):
+            row = jnp.stack([
+                jnp.asarray(tid, jnp.int32), jnp.asarray(fl, jnp.int32),
+                jnp.int32(0),
+            ])
+            return row  # [P]
+
+        out = Outbox(
+            valid=jnp.where(bcast, peers != 0, single & at_row),
+            dst=jnp.where(
+                bcast, peers,
+                jnp.where(single, jnp.full((N,), 1, jnp.int32) * s_dst, 0),
+            ),
+            kind=jnp.where(
+                bcast, bc_kind, jnp.where(single, s_kind, 0)
+            ) * jnp.ones((N,), jnp.int32),
+            payload=jnp.where(
+                jnp.reshape(bcast, (1, 1)),
+                fields(bc_tid, bc_flag)[None, :],
+                jnp.where(
+                    (single & at_row)[:, None],
+                    fields(s_tid, s_flag)[None, :], 0,
+                ),
+            ),
+        )
+
+        # -- timer: coordinator reschedules every tick (prepare deadline on
+        # start, next-round gap otherwise); a yes-voting participant arms
+        # its in-doubt retry; a deciding coordinator schedules the next
+        # round; everything else keeps its deadline
+        timer_t = jnp.where(
+            is_coord,
+            jnp.where(
+                do_start,
+                now + prepare_timeout_us,
+                now + prng.randint(key, 32, txn_gap_us // 2, txn_gap_us),
+            ),
+            now + jnp.where(in_doubt, doubt_retry_us, IDLE_FAR),
+        )
+        timer_m = jnp.where(
+            do_prep & yes,
+            now + doubt_retry_us,
+            jnp.where(
+                decide,
+                now + prng.randint(key, 34, txn_gap_us // 2, txn_gap_us),
+                jnp.int32(-1),
+            ),
+        )
+        return state, out, jnp.where(is_timer, timer_t, timer_m)
+
+    # --------------------------------------- derived two-handler wrappers
+    # (for direct calls in tests and the engine's non-fused fallback; a
+    # spec whose on_message is REPLACED must also clear on_event — use
+    # spec.replace_handlers)
 
     def on_message(s: TpcState, nid, src, kind, payload, now, key):
-        return jax.lax.switch(
-            jnp.clip(kind, 0, 3),
-            [h_prepare, h_vote, h_outcome, h_dreq],
-            s, nid, src, payload, now, key,
+        return on_event(s, nid, src, kind, payload, now, key)
+
+    def on_timer(s: TpcState, nid, now, key):
+        return on_event(
+            s, nid, jnp.int32(0), jnp.int32(-1),
+            jnp.zeros((PAYLOAD_WIDTH,), jnp.int32), now, key,
         )
 
     # --------------------------------------------------------------- restart
@@ -342,7 +385,7 @@ def make_twopc_spec(
             "in_doubt_lanes": (voted_yes[:, 1:] & ~resolved[:, 1:]).any((-2, -1)),
         }
 
-    return fuse_two_handlers(ProtocolSpec(
+    return ProtocolSpec(
         name=f"twopc{N}",
         n_nodes=N,
         payload_width=PAYLOAD_WIDTH,
@@ -351,11 +394,12 @@ def make_twopc_spec(
         init=init,
         on_message=on_message,
         on_timer=on_timer,
+        on_event=on_event,
         on_restart=on_restart,
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
         msg_kind_names=("PREPARE", "VOTE", "OUTCOME", "DREQ"),
-    ))
+    )
 
 
 def twopc_workload(

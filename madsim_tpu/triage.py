@@ -392,15 +392,20 @@ class _Eval:
         """rows: (off_bits, occ_masks[4], rate_scales[3], horizon_us) per
         candidate. Returns per-candidate {violated, step, t_us}. Rows are
         padded to `lane_width` so every generation reuses ONE compiled
-        program; oversized generations chunk into several dispatches."""
+        program; oversized generations chunk into several dispatches,
+        double-buffered like run_batch's chunk loop — chunk k+1's device
+        program is dispatched before the host decodes chunk k's violation
+        scalars (legal: every candidate of one generation is independent),
+        so the host decode overlaps device time instead of serializing."""
         import jax.numpy as jnp
         import numpy as np
 
-        from .tpu.engine import TriageCtl, abs_time_us
+        from .tpu.engine import TriageCtl
         from .tpu.spec import REBASE_US
 
         out: List[Dict[str, int]] = []
-        for lo in range(0, len(rows), self.lane_width):
+
+        def dispatch(lo: int):
             part = rows[lo:lo + self.lane_width]
             n = len(part)
             pad = self.lane_width - n
@@ -419,6 +424,10 @@ class _Eval:
             seeds = np.full((self.lane_width,), self.seed, np.uint32)
             state = self.sim.run(seeds, max_steps=self.max_steps, ctl=ctl)
             self.dispatches += 1
+            return n, state
+
+        def decode(entry) -> None:
+            n, state = entry
             violated = np.asarray(state.violated)
             step = np.asarray(state.violation_step)
             t_us = (
@@ -431,6 +440,10 @@ class _Eval:
                     "step": int(step[i]),
                     "t_us": int(t_us[i]) if violated[i] else -1,
                 })
+
+        from .tpu.batch import pipelined
+
+        pipelined(range(0, len(rows), self.lane_width), dispatch, decode)
         return out
 
 

@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all chaos-smoke triage-smoke real native bench bench-smoke ttfb dryrun demo clean
+.PHONY: test deep test-all chaos-smoke triage-smoke explore-smoke real native bench bench-smoke ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -18,6 +18,9 @@ chaos-smoke:     ## fast nemesis smoke: 64-lane fault plans on both backends
 
 triage-smoke:    ## tiny seeded shrink of a planted raft bug + bundle replay
 	$(PY) -m pytest tests/test_triage.py -q -m "chaos and not slow"
+
+explore-smoke:   ## coverage-guided search smoke: monotone coverage + meta-seed determinism (CPU)
+	$(PY) -m pytest tests/test_explore.py -q -m "chaos and not slow"
 
 test-all: test deep
 
@@ -35,6 +38,9 @@ bench-smoke:     ## <60s/workload micro-bench: completion + dispatch budget, nev
 
 ttfb:            ## time-to-first-bug: cold-runtime wall to violation + ReproBundle on planted bugs
 	$(PY) benches/ttfb.py
+
+explore-bench:   ## explorer vs uniform sweep: coverage/dispatch + first-bug dispatches on planted bugs
+	$(PY) benches/explore_bench.py
 
 dryrun:          ## multi-chip sharding dry run on a virtual 8-device mesh
 	cd /tmp && $(PY) $(CURDIR)/__graft_entry__.py

@@ -367,6 +367,25 @@ def bench_ttfb(chunk: int = 1024, max_seeds: int = 8192) -> dict:
         sys.path.pop(0)
 
 
+def bench_explore(lanes: int = 256, dispatches: int = 8) -> dict:
+    """Explorer vs uniform sweep on the planted-bug configs: union
+    coverage per dispatch and dispatches-to-first-bug under the same lane
+    budget (the coverage-guided search of docs/explore.md; see
+    benches/explore_bench.py)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "benches"))
+    try:
+        import explore_bench
+
+        return explore_bench.explore_all(lanes=lanes, dispatches=dispatches)
+    except Exception as e:  # noqa: BLE001 - diagnostics must not kill BENCH
+        return {"explore_error": str(e)[:200]}
+    finally:
+        sys.path.pop(0)
+
+
 def bench_paxos(lanes: int, virtual_secs: float) -> dict:
     """Fourth device protocol: single-decree Paxos agreement under the
     full chaos battery (dueling proposers as the steady state)."""
@@ -498,6 +517,7 @@ def main() -> None:
     parser.add_argument("--client-rate", type=float, default=0.1)
     parser.add_argument("--skip-breakdown", action="store_true")
     parser.add_argument("--skip-ttfb", action="store_true")
+    parser.add_argument("--skip-explore", action="store_true")
     args = parser.parse_args()
 
     cpu = bench_cpu_baseline(args.cpu_seeds, args.virtual_secs, args.client_rate)
@@ -525,6 +545,7 @@ def main() -> None:
         else bench_roofline(args.lanes, args.virtual_secs, args.client_rate)
     )
     ttfb = {} if args.skip_ttfb else bench_ttfb()
+    explore = {} if args.skip_explore else bench_explore()
 
     # vs_baseline is computed against the STRONGEST CPU execution available:
     # the compiled C++ thread-per-seed DES (the reference's execution model)
@@ -628,6 +649,26 @@ def main() -> None:
         "ttfb_chain_straggler_bundle_s": (
             ttfb.get("chain_straggler", {}).get("wall_to_bundle_s")
             if isinstance(ttfb, dict) else None
+        ),
+        # coverage-guided explorer vs the uniform sweep (same lane budget;
+        # dispatch_advantage >= 0 is the acceptance bar — generation 0 IS
+        # the uniform sweep's first chunk)
+        "explore": explore,
+        "explore_raft_restamp_dispatch_advantage": (
+            explore.get("raft_restamp", {}).get("dispatch_advantage")
+            if isinstance(explore, dict) else None
+        ),
+        "explore_raft_restamp_coverage_gain_pct": (
+            explore.get("raft_restamp", {}).get("coverage_gain_pct")
+            if isinstance(explore, dict) else None
+        ),
+        "explore_chain_straggler_dispatch_advantage": (
+            explore.get("chain_straggler", {}).get("dispatch_advantage")
+            if isinstance(explore, dict) else None
+        ),
+        "explore_chain_straggler_coverage_gain_pct": (
+            explore.get("chain_straggler", {}).get("coverage_gain_pct")
+            if isinstance(explore, dict) else None
         ),
         "backend": tpu["backend"],
         "notes": (

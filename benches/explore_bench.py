@@ -1,0 +1,192 @@
+"""Explorer vs uniform sweep: coverage-per-dispatch and first-bug cost.
+
+The explorer's pitch (docs/explore.md) is that steering lanes toward novel
+behavior multiplies bugs-per-execution over the uniform random sweep the
+batch path runs today. This bench measures that claim on the SAME two
+planted-bug configs benches/ttfb.py sweeps — the deposed-leader re-stamp
+under a crash+partition schedule plan, and the chain blind-apply bug under
+heavy-tail stragglers — with the same lane budget on both sides:
+
+    uniform:  sequential seeds, `dispatches` chunks of `lanes`, coverage on
+    explore:  Explorer(meta_seed=0) — generation 0 IS the uniform sweep's
+              first chunk, later generations steer (mutants + swarm)
+
+Reported per config (the acceptance criterion is the dispatch comparison:
+the explorer must reach its first violation in no MORE dispatches than the
+uniform sweep, and every surfaced violation must carry a ReproBundle):
+
+    coverage_curve          union coverage bits after each dispatch, both
+    first_violation_dispatch / wall_to_first_violation_s, both
+    coverage_gain_pct       explorer's final union vs uniform's
+    violations / bundles    explorer's unique violations + shrunk bundles
+
+Usage: python benches/explore_bench.py [--lanes 256] [--dispatches 8]
+Prints one JSON line; bench.py embeds the same rows in BENCH as `explore`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _repo_root_on_path() -> None:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
+_repo_root_on_path()
+
+
+def uniform_sweep(
+    workload, lanes: int, dispatches: int, first_seed: int = 0,
+) -> dict:
+    """The baseline: sequential seeds in `dispatches` chunks of `lanes`
+    with coverage instrumentation on, from a cold sim (the explorer pays
+    its compiles inside its own wall number, so the baseline does too).
+    Tracks the union coverage curve and the first violating dispatch."""
+    import numpy as np
+
+    from madsim_tpu.explore import popcount_rows
+    from madsim_tpu.tpu.engine import BatchedSim, COV_WORDS
+
+    t0 = time.perf_counter()
+    sim = BatchedSim(workload.spec, workload.config, coverage=True)
+    union = np.zeros((COV_WORDS,), np.uint32)
+    curve = []
+    first_violation = None
+    wall_first = None
+    for d in range(dispatches):
+        seeds = np.arange(
+            first_seed + d * lanes, first_seed + (d + 1) * lanes,
+            dtype=np.uint32,
+        )
+        st = sim.run(seeds, max_steps=workload.max_steps)
+        violated = np.asarray(st.violated)
+        union |= np.bitwise_or.reduce(
+            np.asarray(st.cov.bitmap, np.uint32), axis=0
+        )
+        curve.append(int(popcount_rows(union)))
+        if first_violation is None and violated.any():
+            first_violation = d
+            wall_first = time.perf_counter() - t0
+    return {
+        "lanes": lanes,
+        "dispatches": dispatches,
+        "coverage_curve": curve,
+        "coverage_bits": curve[-1] if curve else 0,
+        "first_violation_dispatch": first_violation,
+        "wall_to_first_violation_s": (
+            round(wall_first, 3) if wall_first is not None else None
+        ),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def explore_vs_uniform(
+    workload, lanes: int = 256, dispatches: int = 8, meta_seed: int = 0,
+    shrink: bool = True, max_shrinks: "int | None" = 8,
+    out_dir: "str | None" = None,
+) -> dict:
+    """One config's comparison row. Both sides run cold with the same
+    lane x dispatch budget; the uniform side runs first so its compile
+    warms nothing the explorer reuses unfairly (the explorer compiles its
+    own triage+coverage program — a strictly BIGGER step)."""
+    from madsim_tpu.explore import Explorer
+
+    uni = uniform_sweep(workload, lanes, dispatches)
+
+    if out_dir is None and shrink:
+        out_dir = tempfile.mkdtemp(prefix="explore_bundles_")
+    t0 = time.perf_counter()
+    # the planted bugs are seed-DENSE (every violating lane would cost ~10
+    # ddmin dispatches), so the bench caps bundles at `max_shrinks`; the
+    # bundle-per-violation capability itself is pinned by tests/test_explore
+    ex = Explorer(
+        workload, meta_seed=meta_seed, lanes=lanes,
+        shrink_violations=shrink, max_shrinks=max_shrinks,
+        shrink_kwargs={"out_dir": out_dir} if out_dir else None,
+    )
+    rep = ex.run(dispatches)
+    wall = time.perf_counter() - t0
+
+    bundles = sum(1 for v in rep.violations if v.get("bundle_path"))
+    row = {
+        "uniform": uni,
+        "explore": {
+            "lanes": lanes,
+            "dispatches": dispatches,
+            "meta_seed": meta_seed,
+            "coverage_curve": rep.coverage_curve,
+            "coverage_bits": rep.coverage_bits,
+            "corpus_size": rep.corpus_size,
+            "first_violation_dispatch": rep.first_violation_dispatch,
+            "violations": len(rep.violations),
+            "bundles": bundles,
+            "wall_s": round(wall, 3),
+        },
+    }
+    if uni["coverage_bits"]:
+        row["coverage_gain_pct"] = round(
+            100.0 * (rep.coverage_bits - uni["coverage_bits"])
+            / uni["coverage_bits"], 1,
+        )
+    if (
+        uni["first_violation_dispatch"] is not None
+        and rep.first_violation_dispatch is not None
+    ):
+        # positive = explorer needed FEWER dispatches (the acceptance bar
+        # is >= 0: generation 0 is the uniform sweep's first chunk, so the
+        # explorer can never lose on a first-chunk-dense bug and must win
+        # or tie on the rest)
+        row["dispatch_advantage"] = (
+            uni["first_violation_dispatch"] - rep.first_violation_dispatch
+        )
+    return row
+
+
+def explore_all(
+    lanes: int = 256, dispatches: int = 8, meta_seed: int = 0,
+    shrink: bool = True, max_shrinks: "int | None" = 8,
+) -> dict:
+    """Both planted-bug configs (shared with benches/ttfb.py)."""
+    import ttfb
+
+    rows = {}
+    for name, (factory, _host) in ttfb.PLANTED.items():
+        try:
+            rows[name] = explore_vs_uniform(
+                factory(), lanes=lanes, dispatches=dispatches,
+                meta_seed=meta_seed, shrink=shrink,
+                max_shrinks=max_shrinks,
+            )
+        except Exception as e:  # noqa: BLE001 - one bad config must not
+            # hide the other's number
+            rows[name] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    return rows
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lanes", type=int, default=256)
+    parser.add_argument("--dispatches", type=int, default=8)
+    parser.add_argument("--meta-seed", type=int, default=0)
+    parser.add_argument("--no-shrink", action="store_true")
+    parser.add_argument("--max-shrinks", type=int, default=8)
+    args = parser.parse_args()
+    print(
+        json.dumps(explore_all(
+            args.lanes, args.dispatches, meta_seed=args.meta_seed,
+            shrink=not args.no_shrink, max_shrinks=args.max_shrinks,
+        )),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

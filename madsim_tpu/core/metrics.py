@@ -90,3 +90,14 @@ class RuntimeMetrics:
         for name, n in handle.rng.buggify_fires.items():
             out[f"buggify:{name}"] = out.get(f"buggify:{name}", 0) + n
         return out
+
+    def chaos_occ_fired(self) -> Dict[str, int]:
+        """Per-clause OCCURRENCE fire bitmasks for this run (bit k set when
+        window k of the schedule clause applied) — the host half of the
+        chaos report's occurrence dimension. The device half is the
+        engine's `occ_fired` tensor, surfaced as `occfires_<clause>_k<k>`
+        summary keys; both index occurrences by `NemesisEvent.k`, so a twin
+        test can compare the masks directly."""
+        handle = self._handle
+        driver = getattr(handle, "nemesis", None) if handle else None
+        return dict(driver.occ_fired) if driver is not None else {}

@@ -1,0 +1,767 @@
+"""Explorer: coverage-guided seed & fault-plan search over batched lanes.
+
+`run_batch` spends every lane on a uniformly random seed, so bugs-per-hour
+scales only with raw throughput. Coverage-guided search (AFL/libFuzzer) and
+Swarm Testing (Groce et al., ISSTA 2012 — randomized feature subsets beat
+uniform configurations) both show that steering inputs toward *novel
+behavior* multiplies bugs-per-execution. Batched lanes make population
+search essentially free on this backend: a generation of candidates IS one
+device dispatch, and the nemesis/triage subsystems already expose exactly
+the schedule-pure knobs a mutator needs (clause masks, occurrence masks,
+rate scales, horizons — `TriageCtl`), where suppressing one fault never
+perturbs another's draws.
+
+The loop:
+
+  * the engine accumulates a per-lane coverage bitmap (one bit per hash of
+    node x event-type x payload-magnitude bucket, `BatchedSim(coverage=
+    True)`), a clause x occurrence fire vector (`occ_fired`), and scalar
+    features (pool high-water, state-changing event count) — zero host
+    sync until decode, riding the donated/pipelined chunk path;
+  * the host keeps a `Corpus` ranked by novelty — the bits a lane set that
+    the global union had never seen — and splits the next dispatch's lanes
+    between FRESH seeds (the uniform baseline, sequential so dispatch 0
+    equals the uniform sweep's first chunk), MUTANTS of top-novelty
+    entries (flip an occurrence bit, toggle a clause, scale a message
+    rate, halve the horizon — all through the ctl, so a mutant is its
+    parent's trajectory minus/plus exactly the mutated faults), and
+    SWARM lane-groups sharing a random clause subset;
+  * novel violations flow straight into `triage.shrink_seed` — mutants
+    shrink WITHIN their suppression set (`base_ctl`), so every surfaced
+    violation arrives with a ReproBundle that replays the exact candidate.
+
+Everything the explorer does is a pure function of ONE meta-seed: the
+meta-rng is the same murmur3 counter chain the engines draw from
+(`nemesis.bits32`), candidate populations are built before dispatch, and
+decode order is item order even under the double-buffered pipeline — two
+runs (pipeline on or off) produce identical corpus contents, coverage
+curves and violation sets, which the determinism tests pin.
+
+CLI:  python -m madsim_tpu.explore --workload raft --storm --dispatches 12
+Docs: docs/explore.md.  Bench: benches/explore_bench.py (vs uniform sweep).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .nemesis import (
+    OCC_CLAUSES,
+    OCC_ROW,
+    RATE_CLAUSES,
+    RATE_ROW,
+    TRIAGE_BIT,
+    TRIAGE_CLAUSES,
+    bits32,
+    fold32,
+    key_from_seed,
+    mix32,
+)
+
+# the explorer's single meta-draw site on the shared murmur3 chain (a site
+# is a namespace — keep unique across nemesis.py/engine draw sites)
+META_SITE_DRAW = 301
+
+
+class MetaRng:
+    """Counter-based meta-rng: draw i of meta-seed s is
+    `bits32(key_from_seed(s), META_SITE_DRAW, i)` — the same murmur3
+    mirror both backends execute, so the whole search is a pure function
+    of the meta-seed with no hidden RNG state."""
+
+    def __init__(self, meta_seed: int) -> None:
+        self._key = key_from_seed(int(meta_seed))
+        self._n = 0
+
+    def u32(self) -> int:
+        v = bits32(self._key, META_SITE_DRAW, self._n)
+        self._n += 1
+        return v
+
+    def randint(self, lo: int, hi: int) -> int:
+        """int in [lo, hi) (degenerate range yields lo, like prng.randint)."""
+        return lo + self.u32() % max(hi - lo, 1)
+
+    def coin(self, p: float) -> bool:
+        return self.u32() % 1_000_000 < int(round(p * 1_000_000))
+
+    def choice(self, seq: Sequence) -> Any:
+        return seq[self.u32() % len(seq)]
+
+
+# --------------------------------------------------------------------------
+# candidates — one lane's (seed, fault-plan subset) genome
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One lane of a generation: a seed plus the ctl knobs that carve a
+    fault-plan subset out of the compiled config (see TriageCtl — the
+    shrinker's per-lane machinery doubles as the mutator's)."""
+
+    seed: int
+    off: int = 0  # clause-disable bitmask over TRIAGE_CLAUSES
+    occ_off: Tuple[int, ...] = (0,) * len(OCC_CLAUSES)
+    rate_scale: Tuple[float, ...] = (1.0,) * len(RATE_CLAUSES)
+    horizon_us: int = 0  # 0 = the config's full horizon
+    origin: str = "fresh"  # fresh | mutant | swarm
+
+    def key(self) -> tuple:
+        """Dedupe/set identity (origin is provenance, not genome)."""
+        return (
+            self.seed, self.off, self.occ_off, self.rate_scale,
+            self.horizon_us,
+        )
+
+    def is_default(self) -> bool:
+        return (
+            self.off == 0 and not any(self.occ_off)
+            and all(s == 1.0 for s in self.rate_scale)
+            and self.horizon_us == 0
+        )
+
+    def base_ctl(self) -> Optional[Dict[str, Any]]:
+        """The triage.shrink_seed(base_ctl=...) face of this candidate
+        (None for a default candidate — plain full-plan shrink)."""
+        if self.is_default():
+            return None
+        return {
+            "off_clauses": [
+                n for n in TRIAGE_CLAUSES if self.off & TRIAGE_BIT[n]
+            ],
+            "occ_off": {
+                n: self.occ_off[OCC_ROW[n]]
+                for n in OCC_CLAUSES if self.occ_off[OCC_ROW[n]]
+            },
+            "rate_scale": {
+                n: self.rate_scale[RATE_ROW[n]]
+                for n in RATE_CLAUSES if self.rate_scale[RATE_ROW[n]] != 1.0
+            },
+            "horizon_us": self.horizon_us or None,
+        }
+
+    def describe(self) -> str:
+        bits = [f"seed={self.seed}"]
+        off = [n for n in TRIAGE_CLAUSES if self.off & TRIAGE_BIT[n]]
+        if off:
+            bits.append("off=" + "+".join(off))
+        for n in OCC_CLAUSES:
+            if self.occ_off[OCC_ROW[n]]:
+                bits.append(f"{n}.occ_off={self.occ_off[OCC_ROW[n]]:#x}")
+        for n in RATE_CLAUSES:
+            if self.rate_scale[RATE_ROW[n]] != 1.0:
+                bits.append(f"{n}.scale={self.rate_scale[RATE_ROW[n]]}")
+        if self.horizon_us:
+            bits.append(f"h={self.horizon_us}us")
+        return f"[{self.origin}] " + " ".join(bits)
+
+
+@dataclasses.dataclass
+class CorpusEntry:
+    """A candidate admitted for novelty, with the coverage that earned it."""
+
+    cand: Candidate
+    new_bits: int  # bits this lane added to the union at admission
+    bitmap: np.ndarray  # u32 [COV_WORDS]
+    hiwater: int
+    transitions: int
+    violated: bool
+    dispatch: int  # generation index at admission
+
+
+@dataclasses.dataclass
+class ExploreReport:
+    """One search's record: the coverage curve per dispatch, the corpus,
+    and every unique violation (with its bundle when shrinking ran)."""
+
+    meta_seed: int
+    lanes: int
+    dispatches: int
+    coverage_curve: List[int]  # union bits after each dispatch
+    corpus_curve: List[int]  # corpus size after each dispatch
+    violation_curve: List[int]  # cumulative unique violations
+    violations: List[Dict[str, Any]]
+    coverage_bits: int
+    corpus_size: int
+    seeds_run: int
+    first_violation_dispatch: Optional[int]
+    wall_s: float
+    device_dispatches: int
+    corpus_digest: str = ""  # sha256 over corpus genomes + bitmaps
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    def fingerprint(self) -> str:
+        """sha256 over everything the determinism contract covers: corpus
+        genomes + bitmaps (via `corpus_digest`), coverage/corpus/violation
+        curves, violation genomes. Excludes wall-clock and bundle paths
+        (machine-local)."""
+        h = hashlib.sha256()
+        h.update(repr((
+            self.meta_seed, self.lanes, self.coverage_curve,
+            self.corpus_curve, self.violation_curve, self.corpus_digest,
+            [(v["candidate"], v["dispatch"]) for v in self.violations],
+        )).encode())
+        return h.hexdigest()
+
+    def render(self) -> str:
+        lines = [
+            f"explore meta_seed={self.meta_seed}: {self.dispatches} "
+            f"dispatches x {self.lanes} lanes ({self.seeds_run} lane-runs)",
+            f"  coverage: {self.coverage_bits} bits "
+            f"(curve {self.coverage_curve})",
+            f"  corpus: {self.corpus_size} entries",
+            f"  unique violations: {len(self.violations)}"
+            + (
+                f" (first at dispatch {self.first_violation_dispatch})"
+                if self.violations else ""
+            ),
+        ]
+        for v in self.violations:
+            line = f"    {v['describe']}"
+            if v.get("bundle_path"):
+                line += f" -> {v['bundle_path']}"
+            lines.append(line)
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# the pure-Python coverage mirror (the twin-test face of engine step 7b)
+# --------------------------------------------------------------------------
+
+
+def cov_index(node: int, src: int = -1, kind: int = -1, bucket: int = 0) -> int:
+    """Mirror of the engine's event-class hash: bit index for one event.
+
+    Deliveries hash (dst node, src, msg kind, payload[0] magnitude
+    bucket); timer fires hash (node, -1, -1, 0). All inputs are
+    trace-visible, so `bitmap_from_trace` recomputes a lane's exact device
+    bitmap — the coverage analog of the nemesis schedule-mirror invariant.
+    """
+    from .tpu.engine import COV_BITS, COV_SALT
+
+    ck = fold32(COV_SALT, node)
+    ck = fold32(ck, src)
+    ck = fold32(ck, kind)
+    ck = fold32(ck, bucket)
+    return mix32(ck) % COV_BITS
+
+
+def payload_bucket(payload0: int) -> int:
+    """The engine's AFL-style magnitude bucket: bit_length of the payload
+    word reinterpreted as u32 (32 - clz)."""
+    return (int(payload0) & 0xFFFFFFFF).bit_length()
+
+
+def bitmap_from_trace(records, lane: int = 0) -> np.ndarray:
+    """Recompute one lane's coverage bitmap from a TraceRecord stream
+    (`BatchedSim.run_traced` records, leaves [T, L, ...]).
+
+    Must equal `final_state.cov.bitmap[lane]` bit-for-bit when the sim ran
+    with coverage=True — tests/test_host_twins.py pins this.
+    """
+    from .tpu.engine import COV_WORDS
+
+    msg_fired = np.asarray(records.msg_fired)[:, lane]  # [T,N]
+    timer_fired = np.asarray(records.timer_fired)[:, lane]
+    src = np.asarray(records.msg_src)[:, lane]
+    kind = np.asarray(records.msg_kind)[:, lane]
+    pay0 = np.asarray(records.msg_payload)[:, lane, :, 0]
+    bm = np.zeros((COV_WORDS,), np.uint32)
+    T, N = msg_fired.shape
+    for t in range(T):
+        for n in range(N):
+            if msg_fired[t, n]:
+                idx = cov_index(
+                    n, int(src[t, n]), int(kind[t, n]),
+                    payload_bucket(pay0[t, n]),
+                )
+            elif timer_fired[t, n]:
+                idx = cov_index(n)
+            else:
+                continue
+            bm[idx // 32] |= np.uint32(1) << np.uint32(idx % 32)
+    return bm
+
+
+def popcount_rows(bitmaps: np.ndarray) -> np.ndarray:
+    """Per-row set-bit counts of a u32 bitmap array [..., COV_WORDS]."""
+    return np.unpackbits(
+        np.ascontiguousarray(bitmaps, np.uint32).view(np.uint8), axis=-1
+    ).sum(axis=-1)
+
+
+# --------------------------------------------------------------------------
+# the explorer
+# --------------------------------------------------------------------------
+
+
+class Explorer:
+    """Coverage-guided generation loop over one BatchWorkload.
+
+        ex = Explorer(workload, meta_seed=7, lanes=256)
+        report = ex.run(dispatches=12)
+        print(report.render())
+
+    Each `run` dispatch is one device program launch of `lanes` candidate
+    lanes (chunked + double-buffered above `chunk` lanes, like run_batch).
+    The workload's config decides the mutation vocabulary: nemesis
+    schedule clauses contribute occurrence-mask mutations, message clauses
+    rate-scale mutations, every enabled clause a toggle, and the horizon
+    is always mutable. A config with no chaos degrades gracefully to a
+    coverage-ranked uniform sweep.
+    """
+
+    def __init__(
+        self,
+        workload,
+        meta_seed: int = 0,
+        lanes: int = 256,
+        chunk: Optional[int] = None,
+        fresh_frac: float = 0.5,
+        mutant_frac: float = 0.3,
+        top_k: int = 16,
+        swarm_group: int = 8,
+        first_seed: int = 0,
+        shrink_violations: bool = True,
+        max_shrinks: Optional[int] = None,
+        shrink_kwargs: Optional[Dict[str, Any]] = None,
+        pipeline: bool = True,
+        log: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        from .tpu.engine import BatchedSim
+        from .tpu.spec import SimConfig
+
+        self.workload = workload
+        self.cfg = workload.config or SimConfig()
+        self.meta_seed = int(meta_seed)
+        self.lanes = int(lanes)
+        self.chunk = int(chunk) if chunk else self.lanes
+        self.fresh_frac = float(fresh_frac)
+        self.mutant_frac = float(mutant_frac)
+        self.top_k = int(top_k)
+        self.swarm_group = max(1, int(swarm_group))
+        self.shrink_violations = bool(shrink_violations)
+        # cap on shrink invocations per explorer (None = shrink every novel
+        # violation): a bug class dense in the seed space surfaces dozens of
+        # violations per dispatch, and each shrink costs ~10 dispatches —
+        # past the cap, violations are still recorded (and still count in
+        # the curves/fingerprint), just without a bundle
+        self.max_shrinks = None if max_shrinks is None else int(max_shrinks)
+        self._shrinks_done = 0
+        self.shrink_kwargs = dict(shrink_kwargs or {})
+        self.pipeline = bool(pipeline)
+        self.say = log or (lambda msg: None)
+
+        # ONE sim serves search, shrink and replay: triage threads the ctl
+        # (the mutator's knobs), coverage threads the novelty bitmaps
+        self.sim = BatchedSim(
+            workload.spec, self.cfg, triage=True, coverage=True
+        )
+        self._rng = MetaRng(self.meta_seed)
+        self._next_fresh = int(first_seed)
+        self._full_h = int(self.cfg.horizon_us)
+
+        # the mutation vocabulary this config supports
+        cfg = self.cfg
+        self._sched = [
+            n for n in OCC_CLAUSES if getattr(cfg, f"nem_{n}_enabled")
+        ]
+        self._rate = [
+            n for n, on in (
+                ("loss", cfg.nem_loss_rate > 0),
+                ("dup", cfg.nem_dup_enabled),
+                ("reorder", cfg.nem_reorder_rate > 0),
+            ) if on
+        ]
+        self._togglable = list(self._sched) + list(self._rate)
+        if cfg.nem_skew_enabled:
+            self._togglable.append("skew")
+        if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
+            self._togglable.append("wipe")
+        # legacy trajectory-coupled chaos: clause-level toggles only
+        if cfg.chaos_enabled and "crash" not in self._togglable:
+            self._togglable.append("crash")
+        if cfg.partition_enabled and "partition" not in self._togglable:
+            self._togglable.append("partition")
+
+        # search state
+        self.union = np.zeros((self._cov_words(),), np.uint32)
+        self.corpus: List[CorpusEntry] = []
+        self._seen: set = set()  # candidate genomes ever dispatched
+        self._violated_seeds: set = set()
+        self.violations: List[Dict[str, Any]] = []
+        self.coverage_curve: List[int] = []
+        self.corpus_curve: List[int] = []
+        self.violation_curve: List[int] = []
+        self.seeds_run = 0
+        self.first_violation_dispatch: Optional[int] = None
+        self._gen = 0
+        self._wall_s = 0.0
+
+    @staticmethod
+    def _cov_words() -> int:
+        from .tpu.engine import COV_WORDS
+
+        return COV_WORDS
+
+    # ------------------------------------------------------------ mutation
+
+    def _fresh(self) -> Candidate:
+        c = Candidate(seed=self._next_fresh)
+        self._next_fresh += 1
+        return c
+
+    def _mutate(self, parent: Candidate) -> Candidate:
+        """One mutation step on the fault-plan genome (never the seed: the
+        seed IS the trajectory; the plan subset is what steering can vary
+        without leaving the seed's schedule-pure universe)."""
+        rng = self._rng
+        ops: List[str] = []
+        if self._sched:
+            ops += ["occ"] * 3  # the finest-grained knob gets the weight
+        if self._togglable:
+            ops += ["clause"] * 2
+        if self._rate:
+            ops.append("rate")
+        ops.append("horizon")
+        op = rng.choice(ops)
+        if op == "occ":
+            name = rng.choice(self._sched)
+            k = rng.randint(0, 10)  # early windows dominate short horizons
+            occ = list(parent.occ_off)
+            occ[OCC_ROW[name]] ^= 1 << k
+            return dataclasses.replace(
+                parent, occ_off=tuple(occ), origin="mutant"
+            )
+        if op == "clause":
+            name = rng.choice(self._togglable)
+            return dataclasses.replace(
+                parent, off=parent.off ^ TRIAGE_BIT[name], origin="mutant"
+            )
+        if op == "rate":
+            name = rng.choice(self._rate)
+            rs = list(parent.rate_scale)
+            rs[RATE_ROW[name]] = rng.choice([0.25, 0.5, 1.0])
+            return dataclasses.replace(
+                parent, rate_scale=tuple(rs), origin="mutant"
+            )
+        # horizon: bisect toward the interesting prefix, or restore full
+        h = parent.horizon_us or self._full_h
+        new_h = rng.choice([0, max(h // 2, self._full_h // 8)])
+        return dataclasses.replace(parent, horizon_us=new_h, origin="mutant")
+
+    def _swarm_off(self) -> int:
+        """Swarm Testing: a random clause subset (each enabled clause
+        dropped with p=1/2) shared by one lane-group."""
+        off = 0
+        for name in self._togglable:
+            if self._rng.coin(0.5):
+                off |= TRIAGE_BIT[name]
+        return off
+
+    def _population(self, gen: int) -> List[Candidate]:
+        """The next generation's lanes. Generation 0 is ALL fresh seeds —
+        identical to the uniform sweep's first chunk, so the explorer
+        never pays a steering tax before it has a signal to steer by."""
+        L = self.lanes
+        parents = sorted(
+            (e for e in self.corpus if e.new_bits > 0),
+            key=lambda e: (-e.new_bits, e.dispatch),
+        )[: self.top_k]
+        if gen == 0 or not parents:
+            pop = [self._fresh() for _ in range(L)]
+        else:
+            n_mut = int(L * self.mutant_frac)
+            n_fresh = int(L * self.fresh_frac)
+            n_swarm = L - n_mut - n_fresh if self._togglable else 0
+            n_fresh = L - n_mut - n_swarm
+            pop = [self._fresh() for _ in range(n_fresh)]
+            for _ in range(n_mut):
+                parent = self._rng.choice(parents).cand
+                cand = self._mutate(parent)
+                for _ in range(4):  # a duplicate genome re-runs nothing new
+                    if cand.key() not in self._seen:
+                        break
+                    cand = self._mutate(cand)
+                if cand.key() in self._seen:
+                    cand = self._fresh()
+                # claim the genome immediately: two mutants of the same
+                # parent can draw identical ops WITHIN this generation
+                self._seen.add(cand.key())
+                pop.append(cand)
+            while len(pop) < L:
+                off = self._swarm_off()
+                for _ in range(min(self.swarm_group, L - len(pop))):
+                    pop.append(dataclasses.replace(
+                        self._fresh(), off=off, origin="swarm"
+                    ))
+        for c in pop:
+            self._seen.add(c.key())
+        return pop
+
+    # ------------------------------------------------------------ dispatch
+
+    def _ctl_for(self, pop: List[Candidate]):
+        import jax.numpy as jnp
+
+        from .tpu.engine import TriageCtl
+        from .tpu.spec import REBASE_US
+
+        off = np.asarray([c.off for c in pop], np.int32)
+        occ = np.asarray([list(c.occ_off) for c in pop], np.int32)
+        rs = np.asarray([list(c.rate_scale) for c in pop], np.float32)
+        h = np.asarray(
+            [c.horizon_us or self._full_h for c in pop], np.int64
+        )
+        return TriageCtl(
+            off=jnp.asarray(off),
+            occ=jnp.asarray(occ),
+            rate_scale=jnp.asarray(rs),
+            h_epoch=jnp.asarray((h // REBASE_US).astype(np.int32)),
+            h_off=jnp.asarray((h % REBASE_US).astype(np.int32)),
+        )
+
+    def _run_generation(self, gen: int, pop: List[Candidate]) -> None:
+        """Dispatch one generation (chunked + double-buffered like
+        run_batch: chunk k+1 on device while the host ranks chunk k) and
+        fold its coverage into the corpus."""
+        from .tpu.batch import pipelined
+
+        new_violations: List[Candidate] = []
+
+        def dispatch(lo: int):
+            part = pop[lo:lo + self.chunk]
+            seeds = np.asarray([c.seed for c in part], np.uint32)
+            st = self.sim.run(
+                seeds, max_steps=self.workload.max_steps,
+                ctl=self._ctl_for(part),
+            )
+            return part, st
+
+        def decode(entry) -> None:
+            part, st = entry
+            bitmaps = np.asarray(st.cov.bitmap, np.uint32)
+            hiwater = np.asarray(st.cov.hiwater)
+            transitions = np.asarray(st.cov.transitions)
+            violated = np.asarray(st.violated)
+            self.seeds_run += len(part)
+            for i, cand in enumerate(part):
+                new = bitmaps[i] & ~self.union
+                nb = int(popcount_rows(new[None, :])[0])
+                if nb > 0:
+                    # lane order IS admission order: earlier lanes absorb
+                    # shared novelty, keeping the corpus deterministic
+                    self.union |= bitmaps[i]
+                    self.corpus.append(CorpusEntry(
+                        cand=cand, new_bits=nb, bitmap=bitmaps[i].copy(),
+                        hiwater=int(hiwater[i]),
+                        transitions=int(transitions[i]),
+                        violated=bool(violated[i]), dispatch=gen,
+                    ))
+                if violated[i] and cand.seed not in self._violated_seeds:
+                    self._violated_seeds.add(cand.seed)
+                    new_violations.append(cand)
+
+        pipelined(
+            range(0, len(pop), self.chunk), dispatch, decode,
+            serial=not self.pipeline,
+        )
+        for cand in new_violations:
+            if self.first_violation_dispatch is None:
+                self.first_violation_dispatch = gen
+            self.violations.append(self._record_violation(cand, gen))
+        self.coverage_curve.append(
+            int(popcount_rows(self.union[None, :])[0])
+        )
+        self.corpus_curve.append(len(self.corpus))
+        self.violation_curve.append(len(self.violations))
+        self.say(
+            f"dispatch {gen}: {self.coverage_curve[-1]} union bits, "
+            f"corpus {len(self.corpus)}, violations {len(self.violations)}"
+        )
+
+    def _record_violation(self, cand: Candidate, gen: int) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "candidate": cand.key(),
+            "seed": cand.seed,
+            "origin": cand.origin,
+            "describe": cand.describe(),
+            "dispatch": gen,
+            "bundle_path": None,
+        }
+        if self.shrink_violations and (
+            self.max_shrinks is not None
+            and self._shrinks_done >= self.max_shrinks
+        ):
+            rec["shrink_skipped"] = "max_shrinks reached"
+        elif self.shrink_violations:
+            # straight into triage: ddmin within the candidate's own
+            # suppression set, so the bundle replays this exact lane
+            from . import triage
+
+            self._shrinks_done += 1
+            kwargs = dict(self.shrink_kwargs)
+            kwargs.setdefault("out_dir", triage.default_bundle_dir())
+            try:
+                sr = triage.shrink_seed(
+                    self.workload, cand.seed, sim=self.sim,
+                    base_ctl=cand.base_ctl(), **kwargs,
+                )
+                rec["bundle_path"] = sr.bundle_path
+                rec["violation_step"] = sr.bundle.violation_step
+                rec["kept_atoms"] = [list(a) for a in sr.kept_atoms]
+            except Exception as e:  # noqa: BLE001 - search must outlive triage
+                rec["shrink_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+        return rec
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, dispatches: int) -> ExploreReport:
+        """Run `dispatches` generations (cumulative across calls)."""
+        t0 = time.perf_counter()
+        for _ in range(int(dispatches)):
+            gen = self._gen
+            self._run_generation(gen, self._population(gen))
+            self._gen += 1
+        self._wall_s += time.perf_counter() - t0
+        return self.report()
+
+    def report(self) -> ExploreReport:
+        digest = hashlib.sha256()
+        for e in self.corpus:
+            digest.update(repr((e.cand.key(), e.new_bits, e.dispatch)).encode())
+            digest.update(e.bitmap.tobytes())
+        return ExploreReport(
+            meta_seed=self.meta_seed,
+            lanes=self.lanes,
+            dispatches=self._gen,
+            coverage_curve=list(self.coverage_curve),
+            corpus_curve=list(self.corpus_curve),
+            violation_curve=list(self.violation_curve),
+            violations=list(self.violations),
+            coverage_bits=(
+                self.coverage_curve[-1] if self.coverage_curve else 0
+            ),
+            corpus_size=len(self.corpus),
+            seeds_run=self.seeds_run,
+            first_violation_dispatch=self.first_violation_dispatch,
+            wall_s=round(self._wall_s, 3),
+            device_dispatches=self.sim.dispatch_count,
+            corpus_digest=digest.hexdigest(),
+        )
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+def storm_plan(horizon_us: int):
+    """A default occurrence-rich fault plan scaled to the horizon (the
+    mutation vocabulary needs schedule clauses with several windows)."""
+    from .nemesis import Crash, FaultPlan, LatencySpike, Partition
+
+    return FaultPlan(name="explore-storm", clauses=(
+        Crash(
+            interval_lo_us=horizon_us // 10, interval_hi_us=horizon_us // 3,
+            down_lo_us=horizon_us // 16, down_hi_us=horizon_us // 4,
+        ),
+        Partition(
+            interval_lo_us=horizon_us // 10, interval_hi_us=horizon_us // 3,
+            heal_lo_us=horizon_us // 16, heal_hi_us=horizon_us // 4,
+        ),
+        LatencySpike(
+            interval_lo_us=horizon_us // 8, interval_hi_us=horizon_us // 2,
+            duration_lo_us=horizon_us // 32, duration_hi_us=horizon_us // 8,
+            extra_us=max(horizon_us // 50, 1),
+        ),
+    ))
+
+
+def _named_workload(name: str, virtual_secs: float, storm: bool):
+    import dataclasses as dc
+
+    from .tpu import (
+        chain_workload, kv_workload, paxos_workload, raft_workload,
+        twopc_workload,
+    )
+
+    factories = {
+        "raft": raft_workload, "kv": kv_workload, "twopc": twopc_workload,
+        "paxos": paxos_workload, "chain": chain_workload,
+    }
+    if name not in factories:
+        raise SystemExit(
+            f"unknown workload {name!r} (choose from {sorted(factories)})"
+        )
+    wl = factories[name](virtual_secs=virtual_secs)
+    wl = dc.replace(wl, host_repro=None)
+    if storm:
+        from .tpu import nemesis as tn
+
+        wl = dc.replace(
+            wl, config=tn.compile_plan(
+                storm_plan(int(wl.config.horizon_us)), wl.config
+            ),
+        )
+    return wl
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m madsim_tpu.explore",
+        description="coverage-guided seed & fault-plan search (docs/explore.md)",
+    )
+    parser.add_argument("--workload", default="raft")
+    parser.add_argument("--virtual-secs", type=float, default=2.0)
+    parser.add_argument(
+        "--storm", action="store_true",
+        help="compile an occurrence-rich Crash+Partition+Spike plan onto "
+        "the workload config (the full mutation vocabulary)",
+    )
+    parser.add_argument("--meta-seed", type=int, default=0)
+    parser.add_argument("--dispatches", type=int, default=8)
+    parser.add_argument("--lanes", type=int, default=256)
+    parser.add_argument("--chunk", type=int, default=0)
+    parser.add_argument("--no-shrink", action="store_true")
+    parser.add_argument(
+        "--max-shrinks", type=int, default=None,
+        help="cap shrink invocations (violations past the cap are recorded "
+        "without a bundle)",
+    )
+    parser.add_argument("--no-pipeline", action="store_true")
+    parser.add_argument("--out-dir", default=None)
+    parser.add_argument("--json", action="store_true", help="JSON line only")
+    args = parser.parse_args(argv)
+
+    wl = _named_workload(args.workload, args.virtual_secs, args.storm)
+    shrink_kwargs = {"out_dir": args.out_dir} if args.out_dir else {}
+    ex = Explorer(
+        wl, meta_seed=args.meta_seed, lanes=args.lanes,
+        chunk=args.chunk or None, shrink_violations=not args.no_shrink,
+        max_shrinks=args.max_shrinks,
+        shrink_kwargs=shrink_kwargs, pipeline=not args.no_pipeline,
+        log=None if args.json else lambda m: print(m, flush=True),
+    )
+    report = ex.run(args.dispatches)
+    if args.json:
+        print(report.to_json(), flush=True)
+    else:
+        print(report.render(), flush=True)
+
+
+if __name__ == "__main__":
+    main()

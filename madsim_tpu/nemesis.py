@@ -625,6 +625,11 @@ class NemesisDriver:
         )
         self.applied: List[NemesisEvent] = []
         self.fired: Dict[str, int] = {}
+        # clause -> occurrence bitmask: bit k set when the OPEN half of
+        # window k applied (the host face of the engine's per-lane
+        # `occ_fired`; `NemesisEvent.k` is the shared occurrence index, and
+        # k >= 31 folds into bit 31 exactly like the device tensor)
+        self.occ_fired: Dict[str, int] = {}
         self._installed = False
         # open-window tracking: NetSim's Network keeps ONE clogged_link
         # set, so an overlapping partition heal would silently lift an
@@ -683,6 +688,11 @@ class NemesisDriver:
 
     def _apply(self, ev: NemesisEvent) -> None:
         net = self._netsim()
+        if ev.kind in ("crash", "split", "clog", "spike_on") and ev.k >= 0:
+            clause = CLAUSE_OF_EVENT[ev.kind]
+            self.occ_fired[clause] = self.occ_fired.get(clause, 0) | (
+                1 << min(ev.k, 31)
+            )
         if ev.kind == "crash":
             self.handle.kill(self.node_ids[ev.node])
             self._count("crash")

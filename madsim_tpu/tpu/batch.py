@@ -70,6 +70,29 @@ class BatchWorkload:
     lane_check_sample: int = 8
 
 
+@dataclasses.dataclass
+class LaneCoverage:
+    """Per-lane coverage decoded from a sweep (run_batch(coverage=True)).
+
+    The raw material of the explorer's novelty ranking (madsim_tpu/explore):
+    each lane's event-class bitmap, its clause x occurrence fire bitmasks
+    (None when no nemesis schedule clause is enabled), and the scalar
+    features. Chunked sweeps concatenate in seed order.
+    """
+
+    bitmap: np.ndarray  # u32 [L, engine.COV_WORDS]
+    occ_fired: Optional[np.ndarray]  # u32 [L, len(OCC_CLAUSES)] | None
+    hiwater: np.ndarray  # i32 [L]
+    transitions: np.ndarray  # i32 [L]
+
+    def union_bits(self) -> int:
+        """Distinct event-class bits exercised across all lanes."""
+        from ..explore import popcount_rows
+
+        union = np.bitwise_or.reduce(self.bitmap, axis=0)
+        return int(popcount_rows(union))
+
+
 class BatchDeterminismError(AssertionError):
     """Two runs of the same seed batch diverged (the device analog of the
     reference's MADSIM_TEST_CHECK_DETERMINISM RNG-trace comparison,
@@ -150,6 +173,9 @@ class BatchResult:
     workload: Optional["BatchWorkload"] = None
     bundle: Any = None  # triage.ReproBundle | None
     bundle_path: Optional[str] = None
+    # per-lane coverage (run_batch(coverage=True) only): the explorer's
+    # novelty signal, concatenated across chunks in seed order
+    coverage: Optional[LaneCoverage] = None
     # sweep-overhead visibility without running benches: how many device
     # program launches the sweep itself cost (init + run segments +
     # sharding puts, via BatchedSim.dispatch_count — excludes post-sweep
@@ -283,6 +309,7 @@ def run_batch(
     shrink_on_violation: bool = False,
     shrink_kwargs: Optional[Dict[str, Any]] = None,
     pipeline: bool = True,
+    coverage: bool = False,
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
 
@@ -318,16 +345,22 @@ def run_batch(
     are bit-identical to the serial loop (the device programs and their
     inputs are unchanged; only the host's read order moves), which the
     pipelining-determinism tests pin.
+
+    `coverage` turns on the per-lane coverage instrumentation (the
+    explorer's novelty signal, madsim_tpu/explore.py): the result carries a
+    `LaneCoverage` and the summary a `coverage_bits` union count. Off by
+    default — the bitmap costs a few percent of step time.
     """
     seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
     if seeds_arr.ndim != 1 or seeds_arr.size == 0:
         raise ValueError("seeds must be a non-empty 1-D sequence")
-    sim = BatchedSim(workload.spec, workload.config)
+    sim = BatchedSim(workload.spec, workload.config, coverage=coverage)
     mesh = resolve_mesh(mesh)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
 
     violated_parts: List[np.ndarray] = []
     deadlocked_parts: List[np.ndarray] = []
+    cov_parts: List[tuple] = []  # (bitmap, occ_fired, hiwater, transitions)
     state: Optional[SimState] = None
     totals: Dict[str, float] = {}
     weights: Dict[str, int] = {}
@@ -369,6 +402,14 @@ def run_batch(
         state = st
         violated_parts.append(np.asarray(st.violated))
         deadlocked_parts.append(np.asarray(st.deadlocked))
+        if coverage:
+            cov_parts.append((
+                np.asarray(st.cov.bitmap, np.uint32),
+                None if st.occ_fired is None
+                else np.asarray(st.occ_fired, np.uint32),
+                np.asarray(st.cov.hiwater, np.int32),
+                np.asarray(st.cov.transitions, np.int32),
+            ))
         s = summarize(st, workload.spec)
         if workload.lane_check is not None:
             # deep host-side oracle: every violating lane + a clean sample
@@ -386,6 +427,9 @@ def run_batch(
                 # a per-chunk MINIMUM: summing chunk minima would fabricate
                 # a step index no lane violated at
                 totals[k] = min(totals.get(k, v), v)
+            elif k == "coverage_hiwater":
+                # a per-chunk MAXIMUM (pool-occupancy high water)
+                totals[k] = max(totals.get(k, v), v)
             elif k.startswith("mean_"):
                 # lane-weighted average across chunks, not a sum of means
                 totals[k] = totals.get(k, 0) + v * size
@@ -419,6 +463,20 @@ def run_batch(
         totals["chaos_coverage"] = coverage_report(totals, sim.config)
     totals["dispatches"] = sweep_dispatches
     totals["device_ms"] = round(sweep_ms, 3)
+    cov = None
+    if coverage:
+        cov = LaneCoverage(
+            bitmap=np.concatenate([p[0] for p in cov_parts]),
+            occ_fired=(
+                None if cov_parts[0][1] is None
+                else np.concatenate([p[1] for p in cov_parts])
+            ),
+            hiwater=np.concatenate([p[2] for p in cov_parts]),
+            transitions=np.concatenate([p[3] for p in cov_parts]),
+        )
+        # the union count over ALL lanes (summarize's per-chunk counts sum
+        # bits that chunks may share; the union is the explorer's currency)
+        totals["coverage_bits"] = cov.union_bits()
     result = BatchResult(
         seeds=seeds_arr,
         violated=violated,
@@ -426,6 +484,7 @@ def run_batch(
         summary=totals,
         state=state,
         workload=workload,
+        coverage=cov,
         dispatches=sweep_dispatches,
         device_ms=sweep_ms,
     )

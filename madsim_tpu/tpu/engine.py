@@ -102,6 +102,43 @@ from ..nemesis import (
 )
 
 
+# --------------------------------------------------------------------------
+# coverage instrumentation (the explorer's novelty signal; madsim_tpu/explore)
+# --------------------------------------------------------------------------
+# Each lane accumulates a fixed-width bitmap of EVENT CLASSES it exercised:
+# one bit per hash of (node, event type, state-transition bucket), folded
+# through the same murmur3 chain as every other draw. The encoding is a pure
+# function of trace-visible event fields — (dst node, src, msg kind,
+# payload[0] magnitude bucket) for deliveries, (node,) for timer fires — so
+# the pure-Python mirror in explore.py can recompute a lane's exact bitmap
+# from its TraceRecord stream (the coverage analog of the nemesis
+# schedule-mirror invariant). 8192 bits ~ AFL's map scale for protocols of
+# this size; collisions just merge two classes, which coverage search
+# tolerates by design.
+
+COV_WORDS = 256  # u32 words per lane bitmap
+COV_BITS = COV_WORDS * 32  # 8192 coverage bits
+COV_SALT = 0x5EEDC0DE  # base key of the event-class hash chain
+
+
+class Coverage(NamedTuple):
+    """Per-lane coverage accumulators (present iff BatchedSim(coverage=True)).
+
+    `bitmap` is the event-class bitmap above. The scalars ride along as
+    extra novelty features the bitmap can't express: `hiwater` is the
+    message-pool occupancy high-water mark (queue-pressure regimes),
+    `transitions` counts delivered/timer events whose handler actually
+    CHANGED the node's state (protocol progress vs idle traffic — e.g. a
+    raft lane where every AppendEntries is a no-op heartbeat scores low).
+    Chaos clause x occurrence coverage lives in SimState.occ_fired, which
+    also feeds the per-occurrence chaos report.
+    """
+
+    bitmap: Any  # u32 [L, COV_WORDS]
+    hiwater: Any  # i32 [L] pool-occupancy high-water (main + straggler)
+    transitions: Any  # i32 [L] events that changed node state
+
+
 class MsgPool(NamedTuple):
     """In-flight messages: per-destination validity + per-candidate ring.
 
@@ -275,6 +312,13 @@ class SimState(NamedTuple):
     #            distinct from `overflow` so graceful-degradation
     #            assertions can tell pool pressure from crash fallout)
     fires: Any  # i32 [L, len(FIRE_KINDS)] per-fault-kind chaos fire counts
+    occ_fired: Any  # u32 [L, len(OCC_CLAUSES)] | None — bit k set when
+    #            occurrence k of the schedule clause APPLIED in this lane
+    #            (occurrences >= 31 fold into bit 31; triage caps its atoms
+    #            at bit 30 so the fold never aliases a shrinkable atom).
+    #            None unless a nemesis schedule clause is enabled. This is
+    #            the clause x occurrence half of the coverage signal AND the
+    #            raw data of the per-occurrence chaos report.
     alive: Any  # bool [L,N]
     crashed: Any  # i32 [L] (node id currently down, -1 = none)
     chaos_at: Any  # i32 [L] (next crash/restart event)
@@ -287,6 +331,7 @@ class SimState(NamedTuple):
     strag: Any  # StragPool | None (None unless buggify_delay_rate > 0)
     nem: Any  # NemesisState | None (None unless a nemesis clause is on)
     ctl: Any  # TriageCtl | None (None unless BatchedSim(triage=True))
+    cov: Any  # Coverage | None (None unless BatchedSim(coverage=True))
 
 
 def _first_free(free: jnp.ndarray, K: int) -> jnp.ndarray:
@@ -320,16 +365,19 @@ class BatchedSim:
 
     def __init__(
         self, spec: ProtocolSpec, config: Optional[SimConfig] = None,
-        triage: bool = False,
+        triage: bool = False, coverage: bool = False,
     ) -> None:
         """`triage=True` threads a per-lane `TriageCtl` through the state:
         the same compiled step program then evaluates shrink candidates
         (clauses / occurrences / rates / horizons switched off per lane)
-        as lanes of one dispatch — see madsim_tpu/triage.py. Off by
-        default: normal sweeps pay nothing for it."""
+        as lanes of one dispatch — see madsim_tpu/triage.py. `coverage=True`
+        additionally accumulates the per-lane Coverage bitmap + scalars the
+        explorer's novelty search feeds on (madsim_tpu/explore.py). Both
+        off by default: normal sweeps pay nothing for either."""
         self.spec = spec
         self.config = config or SimConfig()
         self.triage = bool(triage)
+        self.coverage = bool(coverage)
         cfg = self.config
         N = spec.n_nodes
         # fail loudly at construction, not as shape errors deep inside jit
@@ -533,6 +581,13 @@ class BatchedSim:
             or cfg.nem_clog_enabled or cfg.nem_spike_enabled
             or cfg.nem_skew_enabled
         )
+        # occurrence-fire tracking exists iff a nemesis SCHEDULE clause is
+        # on (legacy trajectory-coupled chaos has no occurrence index):
+        # clause x occurrence coverage + the per-occurrence chaos report
+        self._occ_track = (
+            cfg.nem_crash_enabled or cfg.nem_partition_enabled
+            or cfg.nem_clog_enabled or cfg.nem_spike_enabled
+        )
         # scalar-style handlers -> [L,N] batched. `now` is per-(lane,node):
         # under the lookahead window, nodes in one step process events at
         # different virtual times.
@@ -705,6 +760,10 @@ class BatchedSim:
             overflow=jnp.zeros((L,), jnp.int32),
             dead_drops=jnp.zeros((L,), jnp.int32),
             fires=fires,
+            occ_fired=(
+                jnp.zeros((L, len(OCC_CLAUSES)), jnp.uint32)
+                if self._occ_track else None
+            ),
             alive=jnp.ones((L, N), jnp.bool_),
             crashed=jnp.full((L,), -1, jnp.int32),
             chaos_at=chaos_at,
@@ -722,6 +781,14 @@ class BatchedSim:
             strag=strag,
             nem=nem,
             ctl=ctl,
+            cov=(
+                Coverage(
+                    bitmap=jnp.zeros((L, COV_WORDS), jnp.uint32),
+                    hiwater=jnp.zeros((L,), jnp.int32),
+                    transitions=jnp.zeros((L,), jnp.int32),
+                )
+                if self.coverage else None
+            ),
         )
 
     # ------------------------------------------------------------------ step
@@ -1630,6 +1697,30 @@ class BatchedSim:
         _count("reorder", reorder_fires)
         fires = state.fires + jnp.stack(cols, axis=1)
 
+        # clause x occurrence fire bitmasks (the occurrence dimension of the
+        # chaos report and of the explorer's novelty signal). A window's bit
+        # is set when its OPEN half applies; suppressed (triage) occurrences
+        # stay unset, so a shrunk lane's occ_fired is the survivors only.
+        occ_fired = state.occ_fired
+        if occ_fired is not None:
+            ocols = [occ_fired[:, i] for i in range(len(OCC_CLAUSES))]
+
+            def _occ_mark(row, fired, k):
+                bit = jnp.uint32(1) << jnp.clip(k, 0, 31).astype(jnp.uint32)
+                ocols[row] = jnp.where(fired, ocols[row] | bit, ocols[row])
+
+            if cfg.nem_crash_enabled:
+                _occ_mark(OCC_ROW["crash"], ap_crash, state.nem.crash_k)
+            if cfg.nem_partition_enabled:
+                _occ_mark(OCC_ROW["partition"], ap_split, state.nem.part_k)
+            if cfg.nem_clog_enabled:
+                _occ_mark(OCC_ROW["clog"], do_clog & clog_en, state.nem.clog_k)
+            if cfg.nem_spike_enabled:
+                _occ_mark(
+                    OCC_ROW["spike"], do_spike & spike_en, state.nem.spike_k
+                )
+            occ_fired = jnp.stack(ocols, axis=1)
+
         # -- 7. invariants + lane lifecycle --------------------------------
         ok = self._v_check(node, alive, clock)
         new_violation = active & ~ok & ~state.violated
@@ -1655,6 +1746,62 @@ class BatchedSim:
                 (state.epoch == eh) & (clock >= oh)
             )
         done = state.done | deadlocked | reached_horizon | violated
+
+        # -- 7b. coverage accumulation (BatchedSim(coverage=True) only) ----
+        # One bit per exercised event class: hash(dst node, src, msg kind,
+        # payload[0] magnitude bucket) for deliveries, hash(node, -1, -1, 0)
+        # for timer fires — all trace-visible fields, so explore.py's pure
+        # mirror recomputes the exact bitmap from a TraceRecord stream.
+        # Computed BEFORE the epoch rebase: the transition compare below
+        # must not see time_fields shifts as state changes.
+        cov: Optional[Coverage] = state.cov
+        if cov is not None:
+            evt_cov = has_msg | due_t  # [L,N] (active-gated via the picks)
+            src_w = jnp.where(has_msg, m_src, jnp.int32(-1))
+            kind_w = jnp.where(has_msg, m_kind, jnp.int32(-1))
+            p0 = jnp.where(has_msg, m_pay[:, :, 0], 0).astype(jnp.uint32)
+            # magnitude bucket = bit_length(payload[0] as u32): state-bearing
+            # payload words (terms, indices) contribute ~log2 buckets, not a
+            # fresh bit per value — AFL-style bucketing so high-cardinality
+            # counters can't drown structural novelty
+            bucket = jnp.where(
+                has_msg, jnp.int32(32) - jax.lax.clz(p0).astype(jnp.int32), 0
+            )
+            ck = prng.fold(jnp.uint32(COV_SALT), node_ids)  # [L,N]
+            ck = prng.fold(ck, src_w)
+            ck = prng.fold(ck, kind_w)
+            ck = prng.fold(ck, bucket)
+            idx = prng.mix(ck) % jnp.uint32(COV_BITS)  # [L,N]
+            word = (idx // 32).astype(jnp.int32)
+            wbit = jnp.uint32(1) << (idx % 32)
+            bm = cov.bitmap
+            warange = jnp.arange(COV_WORDS, dtype=jnp.int32)[None, :]
+            for ni in range(N):  # N is small + static: unrolled OR-scatter
+                sel = evt_cov[:, ni : ni + 1] & (
+                    warange == word[:, ni : ni + 1]
+                )
+                bm = bm | jnp.where(sel, wbit[:, ni : ni + 1], jnp.uint32(0))
+            # scalar features: pool-occupancy high water + state-changing
+            # event count (protocol progress vs idle traffic)
+            occupancy = new_valid.any(axis=1).sum(axis=1, dtype=jnp.int32)
+            if self._B:
+                occupancy = occupancy + new_strag.valid.sum(
+                    axis=1, dtype=jnp.int32
+                )
+            changed = jnp.zeros((L, N), jnp.bool_)
+            for old_leaf, new_leaf in zip(
+                jax.tree_util.tree_leaves(state.node),
+                jax.tree_util.tree_leaves(node),
+            ):
+                changed = changed | (old_leaf != new_leaf).reshape(
+                    L, N, -1
+                ).any(axis=2)
+            cov = Coverage(
+                bitmap=bm,
+                hiwater=jnp.maximum(cov.hiwater, occupancy),
+                transitions=cov.transitions
+                + (evt_cov & changed).sum(axis=1, dtype=jnp.int32),
+            )
 
         # -- 8. epoch rebase: unbounded virtual time, int32 arithmetic -----
         # (see spec.REBASE_US). Done lanes freeze as-is; sentinel values
@@ -1725,6 +1872,7 @@ class BatchedSim:
             overflow=overflow,
             dead_drops=state.dead_drops + dead_dropped,
             fires=fires,
+            occ_fired=occ_fired,
             alive=alive,
             crashed=crashed,
             chaos_at=chaos_at,
@@ -1742,6 +1890,7 @@ class BatchedSim:
             strag=new_strag,
             nem=new_nem,
             ctl=state.ctl,
+            cov=cov,
         )
         record = TraceRecord(
             clock=clock,
@@ -2002,6 +2151,27 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
     fires = np.asarray(state.fires)
     for i, name in enumerate(FIRE_KINDS):
         out[f"fires_{name}"] = int(fires[:, i].sum())
+    # per-occurrence fire counts (nemesis schedule clauses only): lanes in
+    # which occurrence k of the clause applied — coverage_report renders
+    # these next to the clause totals, and chunked run_batch sums them
+    if state.occ_fired is not None:
+        occ = np.asarray(state.occ_fired, np.uint32)
+        for row, clause in enumerate(OCC_CLAUSES):
+            col = occ[:, row]
+            for k in range(32):
+                n = int(((col >> np.uint32(k)) & 1).sum())
+                if n:
+                    out[f"occfires_{clause}_k{k}"] = n
+    if state.cov is not None:
+        from ..explore import popcount_rows
+
+        bm = np.asarray(state.cov.bitmap, np.uint32)
+        union = np.bitwise_or.reduce(bm, axis=0)
+        out["coverage_bits"] = int(popcount_rows(union))
+        out["coverage_hiwater"] = int(np.asarray(state.cov.hiwater).max())
+        out["coverage_transitions"] = int(
+            np.asarray(state.cov.transitions).sum()
+        )
     if spec is not None and spec.lane_metrics is not None:
         for name, arr in spec.lane_metrics(state.node).items():
             a = np.asarray(arr)

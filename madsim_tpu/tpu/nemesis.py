@@ -32,6 +32,7 @@ from ..nemesis import (
     LinkClog,
     MsgLoss,
     NemesisEvent,
+    OCC_CLAUSES,
     Partition,
     Reorder,
 )
@@ -253,15 +254,39 @@ def enabled_fire_kinds(cfg: SimConfig) -> Tuple[str, ...]:
     return tuple(kinds)
 
 
+def occurrence_fires(summary: Dict[str, Any]) -> Dict[str, Dict[int, int]]:
+    """Per-clause, per-OCCURRENCE lane counts from a batch summary.
+
+    `summarize` emits `occfires_<clause>_k<k>` — how many lanes had
+    occurrence k of the schedule clause actually APPLY (the open half of
+    window k; `NemesisEvent.k` is the same index on the pure schedule and
+    the host driver). This is the occurrence dimension of the chaos report
+    and the clause x occurrence half of the explorer's novelty signal —
+    clause totals alone can't see that every lane fired the SAME first
+    window while the later windows (the ones past the first election, the
+    ones overlapping a heal) never ran."""
+    out: Dict[str, Dict[int, int]] = {}
+    for key, v in summary.items():
+        if not key.startswith("occfires_"):
+            continue
+        clause, _, kpart = key[len("occfires_"):].rpartition("_k")
+        out.setdefault(clause, {})[int(kpart)] = int(v)
+    return out
+
+
 def coverage_report(summary: Dict[str, Any], cfg: SimConfig) -> str:
     """The chaos-coverage line for a batch summary.
 
         seed batch of 1024: crash 312, restart 301, dup 0 => DEAD CLAUSE
+          crash occurrences: k0 312, k1 188, k2 41
 
     An enabled clause with zero fires across a whole seed batch means the
     knobs can never trigger (interval beyond the horizon, rate too low for
     the message volume) — the suite believes it is exploring a failure
-    mode it never executes."""
+    mode it never executes. Schedule clauses additionally report per
+    OCCURRENCE (lanes in which window k applied): a clause whose k0 fires
+    everywhere but whose k1+ never runs is fuzzing one fault instant, not
+    a fault *process*."""
     lanes = summary.get("lanes", "?")
     parts = []
     dead = []
@@ -275,4 +300,11 @@ def coverage_report(summary: Dict[str, Any], cfg: SimConfig) -> str:
     line = f"seed batch of {lanes}: " + ", ".join(parts)
     if dead:
         line += " => DEAD CLAUSE: " + ", ".join(dead)
+    occ = occurrence_fires(summary)
+    for clause in OCC_CLAUSES:
+        ks = occ.get(clause)
+        if ks:
+            line += f"\n  {clause} occurrences: " + ", ".join(
+                f"k{k} {ks[k]}" for k in sorted(ks)
+            )
     return line

@@ -450,8 +450,14 @@ class _Eval:
 def _atom_rows(
     kept: Sequence[Atom], all_atoms: Sequence[Atom], horizon_us: int,
     rate_scale: Optional[Dict[str, float]] = None,
+    extra_occ: Optional[Dict[str, int]] = None,
 ) -> Tuple[int, List[int], List[float], int]:
-    """One candidate row: every atom NOT in `kept` is suppressed."""
+    """One candidate row: every atom NOT in `kept` is suppressed.
+
+    `extra_occ` (clause -> occurrence bitmask) is ORed in unconditionally —
+    a base candidate's suppressions must hold in every row even when the
+    vocabulary collapsed that clause to a single clause-level atom (>31
+    occurrences), where no per-occurrence atom exists to carry them."""
     kept_set = set(kept)
     off = 0
     occ = [0] * len(OCC_CLAUSES)
@@ -463,6 +469,8 @@ def _atom_rows(
             off |= TRIAGE_BIT[name]
         else:
             occ[OCC_ROW[name]] |= 1 << k
+    for name, mask in (extra_occ or {}).items():
+        occ[OCC_ROW[name]] |= int(mask)
     rs = [1.0] * len(RATE_CLAUSES)
     for name, s in (rate_scale or {}).items():
         rs[RATE_ROW[name]] = float(s)
@@ -528,8 +536,18 @@ def shrink_seed(
     trace_tail: int = 40,
     sim=None,
     log: Optional[Callable[[str], None]] = None,
+    base_ctl: Optional[Dict[str, Any]] = None,
 ) -> ShrinkResult:
     """Shrink one violating seed of a BatchWorkload into a ReproBundle.
+
+    `base_ctl` shrinks WITHIN a candidate's suppression set instead of the
+    full plan: keys `off_clauses` (names), `occ_off` (clause -> occurrence
+    bitmask), `rate_scale` (clause -> factor), `horizon_us`. The baseline
+    lane replays exactly that candidate (the explorer's mutants violate
+    under ctl masks the full plan may not reproduce — a bug REQUIRING a
+    suppressed heal is invisible to a full-plan baseline), ddmin minimizes
+    the surviving atoms, and every suppression the base carries stays in
+    the bundle's ctl, so the bundle replays the shrunk candidate exactly.
 
     Pipeline (each numbered item is ONE batched dispatch unless noted):
 
@@ -561,16 +579,32 @@ def shrink_seed(
         raise ValueError("shrink_seed needs a BatchedSim(..., triage=True)")
     ev = _Eval(sim, seed, workload.max_steps, lane_width)
     plan = plan_from_config(cfg)
+    base_ctl = base_ctl or {}
+    base_off = set(base_ctl.get("off_clauses") or ())
+    base_occ: Dict[str, int] = dict(base_ctl.get("occ_off") or {})
+    base_rs: Dict[str, float] = dict(base_ctl.get("rate_scale") or {})
     full_h = int(cfg.horizon_us)
+    if base_ctl.get("horizon_us"):
+        full_h = min(full_h, int(base_ctl["horizon_us"]))
 
-    # -- 1. baseline: full plan + empty plan, one dispatch ------------------
+    def _base_on(atom: Atom) -> bool:
+        name, k = atom
+        if name in base_off:
+            return False
+        return k is None or not (base_occ.get(name, 0) >> k) & 1
+
+    # -- 1. baseline: the (base-suppressed) plan + empty plan, one dispatch -
     base_atoms = enumerate_atoms(plan, cfg, seed, full_h, spec.n_nodes)
-    full_row = _atom_rows(base_atoms, base_atoms, full_h)
-    empty_row = _atom_rows([], base_atoms, full_h)
+    enabled0 = [a for a in base_atoms if _base_on(a)]
+    full_row = _atom_rows(enabled0, base_atoms, full_h, rate_scale=base_rs,
+                          extra_occ=base_occ)
+    empty_row = _atom_rows([], base_atoms, full_h, rate_scale=base_rs,
+                           extra_occ=base_occ)
     base, empty = ev.run([full_row, empty_row])[:2]
     if not base["violated"]:
         raise NotReproducible(
-            f"seed {seed} does not violate under the full configuration "
+            f"seed {seed} does not violate under the "
+            f"{'candidate' if base_ctl else 'full'} configuration "
             f"(horizon {full_h} us) — nothing to shrink"
         )
     trunc_h = min(full_h, base["t_us"] + slack_us)
@@ -585,14 +619,23 @@ def shrink_seed(
         # empty and the empty lane's own violation bisects the horizon.
         # The suppression universe stays base_atoms so the confirmation
         # (and the bundle ctl) really runs chaos-free.
-        universe: List[Atom] = list(base_atoms)
+        all_atoms: List[Atom] = list(base_atoms)
+        universe: List[Atom] = list(enabled0)
         kept: List[Atom] = []
         trunc_h = min(full_h, empty["t_us"] + slack_us)
     else:
-        universe = enumerate_atoms(plan, cfg, seed, trunc_h, spec.n_nodes)
+        # `all_atoms` is the suppression vocabulary at the truncated
+        # horizon; ddmin searches only the base-enabled subset, so base
+        # suppressions stay suppressed in every candidate row
+        all_atoms = enumerate_atoms(plan, cfg, seed, trunc_h, spec.n_nodes)
+        universe = [a for a in all_atoms if _base_on(a)]
 
         def batch_violates(cands: List[List[Atom]]) -> List[bool]:
-            rows = [_atom_rows(c, universe, trunc_h) for c in cands]
+            rows = [
+                _atom_rows(c, all_atoms, trunc_h, rate_scale=base_rs,
+                           extra_occ=base_occ)
+                for c in cands
+            ]
             res = ev.run(rows)
             say(
                 f"ddmin generation: {len(cands)} candidates -> "
@@ -604,15 +647,20 @@ def shrink_seed(
     say(f"ddmin: {len(universe)} atoms -> {len(kept)} kept: {kept}")
 
     # -- k+1. rate reduction for surviving message clauses ------------------
+    # (clauses the base already scaled are left at the base scale: probing
+    # them at the grid's scales could INCREASE fires past the candidate's)
     kept_clauses = {name for name, _ in kept}
     rate_scale: Dict[str, float] = {}
-    rate_targets = [n for n in RATE_CLAUSES if (n, None) in kept]
+    rate_targets = [
+        n for n in RATE_CLAUSES if (n, None) in kept and n not in base_rs
+    ]
     if rate_targets and rate_steps:
         grid: List[Tuple[str, float]] = [
             (n, s) for n in rate_targets for s in rate_steps
         ]
         res = ev.run([
-            _atom_rows(kept, universe, trunc_h, rate_scale={n: s})
+            _atom_rows(kept, all_atoms, trunc_h,
+                       rate_scale={**base_rs, n: s}, extra_occ=base_occ)
             for n, s in grid
         ])
         for n in rate_targets:
@@ -629,9 +677,11 @@ def shrink_seed(
         # re-confirmed (falls back to full rates if it stops violating).
         # A confirmed combination row is byte-identical to the final
         # confirmation below, so it doubles as it — one dispatch saved.
-        ok = ev.run(
-            [_atom_rows(kept, universe, trunc_h, rate_scale=rate_scale)]
-        )[0]
+        ok = ev.run([
+            _atom_rows(kept, all_atoms, trunc_h,
+                       rate_scale={**base_rs, **rate_scale},
+                       extra_occ=base_occ)
+        ])[0]
         if ok["violated"]:
             final = ok
         else:
@@ -641,18 +691,32 @@ def shrink_seed(
 
     # -- k+2. final confirmation under the exact bundle ctl -----------------
     if final is None:
-        final = ev.run(
-            [_atom_rows(kept, universe, trunc_h, rate_scale=rate_scale)]
-        )[0]
+        final = ev.run([
+            _atom_rows(kept, all_atoms, trunc_h,
+                       rate_scale={**base_rs, **rate_scale},
+                       extra_occ=base_occ)
+        ])[0]
     assert final["violated"], "shrunk candidate must still violate"
     final_h = min(trunc_h, final["t_us"] + slack_us)
 
-    # the bundle's ctl spec: everything in the universe minus the kept set
-    dropped = sorted({name for name, _ in universe} - kept_clauses)
+    # the bundle's ctl spec: everything in the vocabulary minus the kept
+    # set (base suppressions merge in here — a clause or occurrence the
+    # candidate already dropped lands in dropped/occ_off like any other)
+    dropped = sorted({name for name, _ in all_atoms} - kept_clauses)
     occ_off: Dict[str, int] = {}
-    for name, k in universe:
+    for name, k in all_atoms:
         if k is not None and (name, k) not in kept and name in kept_clauses:
             occ_off[name] = occ_off.get(name, 0) | (1 << k)
+    # base occurrence suppressions on clauses that survive must stay in the
+    # bundle even when the vocabulary had no per-occurrence atom to carry
+    # them (the >31-occurrence clause-level fallback)
+    for name, mask in base_occ.items():
+        if name not in dropped and mask:
+            occ_off[name] = occ_off.get(name, 0) | int(mask)
+    rate_scale = {
+        n: s for n, s in {**base_rs, **rate_scale}.items()
+        if n in kept_clauses
+    }
 
     # -- trace tail: single-lane microscope of the final candidate ----------
     tail: List[str] = []

@@ -169,6 +169,54 @@ def test_init_is_one_jitted_program():
     assert sim.dispatch_count - before == 2
 
 
+# ------------------------------------------------ coverage instrumentation
+
+
+def test_coverage_bitmap_identical_across_repeats_and_pipeline():
+    """The explorer's novelty signal must be bit-deterministic: the same
+    seeds produce the same per-lane bitmaps, occurrence fires and scalar
+    features on every run, chunked or not, pipelined or serial (the
+    decode order never touches device results)."""
+    wl = _tiny_workload()
+    kw = dict(mesh=None, max_traces=0, repro_on_host=False, coverage=True)
+    a = run_batch(range(48), wl, chunk=16, pipeline=True, **kw)
+    b = run_batch(range(48), wl, chunk=16, pipeline=False, **kw)
+    c = run_batch(range(48), wl, chunk=48, pipeline=True, **kw)
+    for other in (b, c):
+        assert np.array_equal(a.coverage.bitmap, other.coverage.bitmap)
+        assert np.array_equal(a.coverage.hiwater, other.coverage.hiwater)
+        assert np.array_equal(
+            a.coverage.transitions, other.coverage.transitions
+        )
+        assert a.summary["coverage_bits"] == other.summary["coverage_bits"]
+    assert a.coverage.bitmap.shape == (48, 256)
+    assert a.summary["coverage_bits"] == a.coverage.union_bits() > 0
+    # coverage off: no bitmap cost, no coverage field
+    plain = run_batch(
+        range(48), wl, chunk=48, mesh=None, max_traces=0,
+        repro_on_host=False,
+    )
+    assert plain.coverage is None
+    assert "coverage_bits" not in plain.summary
+
+
+def test_coverage_on_donated_path_bit_identical():
+    """Donation must not perturb the coverage accumulators: the donated
+    segment function's Coverage leaves equal an undonated execution of
+    the same body."""
+    spec = make_raft_spec(5)
+    cfg = SimConfig(horizon_us=400_000, loss_rate=0.1)
+    sim = BatchedSim(spec, cfg, coverage=True)
+    seeds = jnp.arange(32)
+    undonated = jax.jit(
+        BatchedSim._run.__wrapped__, static_argnums=(0, 2)
+    )
+    ref = undonated(sim, sim.init(seeds), 600)
+    out = sim._run(sim.init(seeds), 600)
+    assert _leaves_equal(ref.cov, out.cov)
+    assert _leaves_equal(ref, out)
+
+
 # ------------------------------------------------- twopc fused-path parity
 
 

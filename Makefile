@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all chaos-smoke triage-smoke explore-smoke real native bench bench-smoke ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all chaos-smoke triage-smoke explore-smoke real native bench bench-smoke compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -33,8 +33,11 @@ native:          ## (re)build the C++ executor core in place
 bench:           ## the headline JSON line (runs on the live jax backend)
 	$(PY) bench.py
 
-bench-smoke:     ## <60s/workload micro-bench: completion + dispatch budget, never wall-clock
+bench-smoke:     ## <60s/workload micro-bench: completion + dispatch + layout budgets, never wall-clock
 	$(PY) benches/bench_smoke.py
+
+compaction-ab:   ## r8 layout A/B: serial-vs-donated + packed-vs-unpacked bit-identity (<60s, structural)
+	$(PY) benches/compaction_ab.py
 
 ttfb:            ## time-to-first-bug: cold-runtime wall to violation + ReproBundle on planted bugs
 	$(PY) benches/ttfb.py

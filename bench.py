@@ -336,8 +336,15 @@ def bench_roofline(lanes: int, virtual_secs: float, client_rate: float) -> dict:
             "roofline_bytes_per_step_hi": raft.get("bytes_per_step_hi"),
             "roofline_achieved_gbs": raft.get("achieved_gbs"),
             "roofline_pct_of_attainable": raft.get("pct_of_attainable"),
-            # the carry floor: the state pytree must be read+written every
-            # step no matter what — the step's hard lower bound on time
+            "roofline_pct_of_attainable_lo": raft.get(
+                "pct_of_attainable_lo"
+            ),
+            # the carry floor (r8: the hot+cold while_loop carry, NOT the
+            # flat state — ConstState rides loop-invariant and is excluded):
+            # read+written every step no matter what, the step's hard
+            # lower bound on both bytes and time
+            "roofline_carry_floor_bytes": raft.get("carry_floor_bytes"),
+            "roofline_est_over_floor": raft.get("est_over_floor"),
             "roofline_carry_floor_ms": raft.get("carry_floor_ms"),
             "roofline_step_over_floor": raft.get("step_over_floor"),
             "roofline_rows": rows,

@@ -11,7 +11,13 @@ path and asserts:
   * completion with zero violations (the clean specs stay clean),
   * zero pool overflow (the zero-drop discipline at smoke scale),
   * the dispatch budget: init + one sweep segment = 2 device program
-    launches per chunk, exactly (BatchResult.dispatches).
+    launches per chunk, exactly (BatchResult.dispatches),
+  * the LAYOUT budget (r8, docs/state_layout.md): per-workload carry
+    bytes per lane (platform-independent — pure dtype x shape) and the
+    bytes-per-step estimate over the carry floor. A narrowed field
+    silently widening, a bool plane un-packing, or cold state leaking
+    back into per-step traffic fails HERE, not three PRs later in a
+    BENCH regression.
 
 It NEVER asserts wall-clock — that is bench.py's job, on real hardware,
 with the fresh-seed/median discipline. Wall times are printed for eyes
@@ -35,6 +41,23 @@ LANES = 64
 VIRTUAL_SECS = 0.6
 MAX_STEPS = 2_500  # < dispatch_steps (10k): the sweep must be ONE segment
 
+# r8 layout budgets (docs/state_layout.md). carry_bytes_per_lane is the
+# while_loop carry (hot + cold) at THIS smoke config — pure dtype x shape,
+# so identical on every backend; measured values (see docs) get ~10%
+# headroom for benign drift. est_over_floor bounds the step's estimated
+# HBM traffic against the carry's unavoidable read+write: measured
+# 3.1-4.6x on the CPU backend (TPU fuses tighter) — 6.0 catches the big
+# regressions (cold state re-materializing per step costs ~+1x floor,
+# donation loss ~+1x) without flaking on backend variance.
+CARRY_BUDGET_B_PER_LANE = {
+    "raft": 3520,
+    "kv": 6880,
+    "twopc": 1710,
+    "paxos": 1540,
+    "chain": 1670,
+}
+EST_OVER_FLOOR_MAX = 6.0
+
 
 def workloads():
     from madsim_tpu.tpu import chain_workload, raft_workload
@@ -49,6 +72,43 @@ def workloads():
         "paxos": paxos_workload(virtual_secs=VIRTUAL_SECS),
         "chain": chain_workload(virtual_secs=VIRTUAL_SECS),
     }
+
+
+def layout_budget(name: str, wl) -> dict:
+    """The bytes budget: carry bytes/lane (exact) + est_over_floor (XLA
+    buffer-assignment estimate of the sweep-loop body vs 2x carry)."""
+    import jax.numpy as jnp
+
+    import roofline as rl
+    from madsim_tpu.tpu.engine import BatchedSim
+
+    sim = BatchedSim(wl.spec, wl.config)
+    st = sim.init(jnp.arange(LANES, dtype=jnp.uint32))
+    cb = rl.carry_bytes(st)
+    carry = cb["hot_bytes"] + cb["cold_bytes"]
+    mem = rl.mem_bytes_per_step(sim, st)
+    row = {
+        "carry_bytes_per_lane": round(carry / LANES, 1),
+        "bytes_per_step": mem["bytes_per_step"],
+        "est_over_floor": round(mem["bytes_per_step"] / (2 * carry), 2),
+    }
+    errors = []
+    budget = CARRY_BUDGET_B_PER_LANE[name]
+    if row["carry_bytes_per_lane"] > budget:
+        errors.append(
+            f"carry widened: {row['carry_bytes_per_lane']} B/lane > "
+            f"budget {budget} — a SimState leaf grew or un-narrowed "
+            "(run tests/test_state_layout.py for the field name)"
+        )
+    if row["est_over_floor"] > EST_OVER_FLOOR_MAX:
+        errors.append(
+            f"step traffic blew the floor budget: est_over_floor "
+            f"{row['est_over_floor']} > {EST_OVER_FLOOR_MAX} — cold/const "
+            "state re-entered the per-step carry, or donation broke"
+        )
+    if errors:
+        row["errors"] = errors
+    return row
 
 
 def smoke_one(name: str, wl) -> dict:
@@ -95,8 +155,10 @@ def main() -> int:
     failed = False
     for name, wl in workloads().items():
         row = smoke_one(name, wl)
+        row["layout"] = layout_budget(name, wl)
         out[name] = row
-        failed = failed or bool(row.get("errors"))
+        errs = row.get("errors", []) + row["layout"].get("errors", [])
+        failed = failed or bool(errs)
     out["ok"] = not failed
     print(json.dumps(out), flush=True)
     return 1 if failed else 0

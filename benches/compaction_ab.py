@@ -1,0 +1,205 @@
+"""compaction-ab: the r8 layout change, A/B'd structurally in under 60 s.
+
+Two equivalences, each asserted BIT-FOR-BIT on a small lane count (the
+golden-digest / layout-lint suites carry the same contracts as tests;
+this target is the one-command developer check after touching the
+engine's carry):
+
+  serial-vs-donated   the production donated, hot/cold/const-split sweep
+                      (`_run` + while_loop) against an undonated
+                      step-at-a-time scan over the FLAT SimState — the
+                      r7-shaped loop. Donation and the carry split are
+                      executor-level restructurings; one diverging leaf
+                      means a buffer was clobbered or a const leaked.
+
+  packed-vs-unpacked  the compacted layout against BOTH unpacked
+                      references: (a) the same spec with dtype narrowing
+                      STRIPPED, canonical trajectories bit-equal (plane
+                      packing is unconditional, so this leg isolates
+                      narrowing); (b) the canonical golden digest of the
+                      packed engine against the constant RECORDED FROM
+                      the pre-compaction r7 engine (unpacked bool
+                      planes, flat i32 node state) — the cross-version
+                      witness that packing itself changed nothing
+                      (tests/test_state_layout.py pins the same
+                      constants; this target replays the raft one).
+
+Wall-clock is printed for eyes but never asserted (bench.py's job, on
+real hardware). Exit code != 0 on any mismatch.
+
+Usage: python benches/compaction_ab.py  (or `make compaction-ab`)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LANES = 48
+STEPS = 1_200
+
+
+def _chaos_cfg():
+    from madsim_tpu import nemesis
+    from madsim_tpu.tpu import nemesis as tpu_nemesis
+    from madsim_tpu.tpu.spec import SimConfig
+
+    plan = nemesis.FaultPlan(
+        name="compaction-ab",
+        clauses=(
+            nemesis.Crash(interval_lo_us=300_000, interval_hi_us=900_000,
+                          down_lo_us=200_000, down_hi_us=600_000),
+            nemesis.Partition(
+                interval_lo_us=400_000, interval_hi_us=1_200_000,
+                heal_lo_us=300_000, heal_hi_us=900_000,
+            ),
+            nemesis.MsgLoss(rate=0.05),
+        ),
+    )
+    return tpu_nemesis.compile_plan(plan, SimConfig(horizon_us=30_000_000))
+
+
+def _leaf_mismatches(a, b, widen=None):
+    """Names of leaves that differ between two final states (canonical:
+    node widened, packed planes compared as stored words)."""
+    import jax
+    import numpy as np
+
+    bad = []
+    na = widen(a.node) if widen else a.node
+    nb = widen(b.node) if widen else b.node
+    for f, x, y in zip(
+        type(na)._fields, jax.tree_util.tree_leaves(na),
+        jax.tree_util.tree_leaves(nb),
+    ):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            bad.append(f"node.{f}")
+    for f in ("clock", "epoch", "key", "done", "violated", "steps",
+              "events", "overflow", "dead_drops", "fires", "alive_p",
+              "crashed", "chaos_at", "link_ok_p", "partitioned", "part_at",
+              "timer"):
+        if not np.array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        ):
+            bad.append(f)
+    for f in ("deliver", "kind", "payload"):
+        x = np.asarray(getattr(a.msgs, f)).astype(np.int64)
+        y = np.asarray(getattr(b.msgs, f)).astype(np.int64)
+        if not np.array_equal(x, y):
+            bad.append(f"msgs.{f}")
+    import numpy as _np
+    if not _np.array_equal(
+        _np.asarray(a.msgs.valid), _np.asarray(b.msgs.valid)
+    ):
+        bad.append("msgs.valid")
+    return bad
+
+
+def serial_vs_donated(spec, cfg) -> dict:
+    """Production donated split sweep == undonated flat serial scan."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu.engine import BatchedSim
+
+    sim = BatchedSim(spec, cfg)
+    seeds = jnp.arange(LANES, dtype=jnp.uint32)
+
+    t0 = time.perf_counter()
+    donated = sim.run(seeds, max_steps=STEPS, dispatch_steps=STEPS)
+    wall_don = time.perf_counter() - t0
+
+    # the r7-shaped reference loop: flat SimState carry, no donation, no
+    # hot/cold/const split — every step re-emits the whole pytree
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def serial_run(n_steps, state):
+        def body(s, _):
+            return sim._step(s), None
+
+        final, _ = jax.lax.scan(body, state, None, length=n_steps)
+        return final
+
+    t0 = time.perf_counter()
+    state0 = sim.init(seeds)
+    # mirror run()'s early-exit semantics at this scale: STEPS < horizon
+    # exit for these configs, so a fixed-length scan matches while_loop
+    serial = serial_run(STEPS, state0)
+    wall_ser = time.perf_counter() - t0
+
+    bad = _leaf_mismatches(donated, serial)
+    return {
+        "wall_donated_s": round(wall_don, 2),
+        "wall_serial_s": round(wall_ser, 2),
+        "mismatched_leaves": bad,
+    }
+
+
+def packed_vs_unpacked(spec, cfg) -> dict:
+    """Compacted spec == unpacked references: (a) narrowing stripped,
+    canonical trajectories bit-equal; (b) the pinned r7 (unpacked-engine)
+    golden digest reproduced by the packed engine."""
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu.engine import BatchedSim
+
+    seeds = jnp.arange(LANES, dtype=jnp.uint32)
+    wide = dataclasses.replace(spec, narrow_fields=None)
+    simN, simW = BatchedSim(spec, cfg), BatchedSim(wide, cfg)
+    t0 = time.perf_counter()
+    stN = simN.run(seeds, max_steps=STEPS, dispatch_steps=STEPS)
+    wall_n = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    stW = simW.run(seeds, max_steps=STEPS, dispatch_steps=STEPS)
+    wall_w = time.perf_counter() - t0
+    bad = _leaf_mismatches(stN, stW, widen=simN._widen_node)
+
+    # (b) the cross-version packing witness: today's packed engine must
+    # reproduce the canonical digest RECORDED FROM the r7 engine, whose
+    # planes were unpacked bools and whose node state was flat i32 —
+    # plane packing cannot hide behind itself here
+    from tests.test_state_layout import GOLDEN, _golden_one
+
+    golden_ok = True
+    try:
+        _golden_one("raft")
+    except AssertionError:
+        golden_ok = False
+        bad = bad + ["r7-golden-digest(raft)"]
+    return {
+        "wall_packed_s": round(wall_n, 2),
+        "wall_wide_s": round(wall_w, 2),
+        "r7_unpacked_golden_ok": golden_ok,
+        "golden_workloads_pinned": len(GOLDEN),
+        "mismatched_leaves": bad,
+    }
+
+
+def main() -> int:
+    from madsim_tpu.tpu.raft import make_raft_spec
+
+    cfg = _chaos_cfg()
+    spec = make_raft_spec()
+    out = {
+        "lanes": LANES,
+        "steps": STEPS,
+        "serial_vs_donated": serial_vs_donated(spec, cfg),
+        "packed_vs_unpacked": packed_vs_unpacked(spec, cfg),
+    }
+    ok = not (
+        out["serial_vs_donated"]["mismatched_leaves"]
+        or out["packed_vs_unpacked"]["mismatched_leaves"]
+    )
+    out["ok"] = ok
+    print(json.dumps(out), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -144,13 +144,21 @@ class TimeHandle:
         from ..native import AVAILABLE as _native_ok
 
         self.timer = NativeTimer() if _native_ok else Timer()
-        # nemesis per-node clock skew: node_id -> rate (1.0 = no skew),
+        # nemesis per-node clock skew: node_id -> integer ppm (0 = none),
         # installed by NemesisDriver. RELATIVE waits made by a skewed
         # node's tasks (sleep / add_timer_ns deadlines) stretch or shrink
-        # by the rate — the node's local clock runs fast or slow while the
-        # simulation clock stays the single global truth. Absolute-deadline
-        # timers (add_timer_at_ns — network deliveries, backoff retries)
-        # are wire/simulator time and are never skewed.
+        # by (1 + ppm * 1e-6) — the node's local clock runs fast or slow
+        # while the simulation clock stays the single global truth.
+        # Absolute-deadline timers (add_timer_at_ns — network deliveries,
+        # backoff retries) are wire/simulator time and are never skewed.
+        # Integer ppm, not a float rate (r8): exact-int truncation is the
+        # SAME rule the device engine's scale_delay_ppm applies. NOTE the
+        # faces still truncate at their own granularity (ns here, us on
+        # the device), so a given delay's stretch can differ by up to
+        # 1 us — what the shared rule buys is exactness (no float-mantissa
+        # loss on long-horizon timers) and a common spec for both
+        # implementations, not cross-face timer bit-equality (the twin
+        # contract compares skew ASSIGNMENTS, not event times).
         self.node_skew: Optional[dict] = None
 
     # ---- reads ----
@@ -191,7 +199,12 @@ class TimeHandle:
         return self.add_timer_ns(to_nanos(delay_seconds), callback)
 
     def skew_delay_ns(self, delay_ns: int) -> int:
-        """Scale a relative delay by the current task's node clock skew."""
+        """Scale a relative delay by the current task's node clock skew:
+        delay + trunc(delay * |ppm| / 1e6) * sign(ppm), in exact integer
+        arithmetic — the host-side mirror of the device engine's
+        scale_delay_ppm (tpu/engine.py). The old `int(delay * rate)`
+        float path both lost integer precision for large delays and
+        rounded differently than the device's truncation rule."""
         if not self.node_skew:
             return delay_ns
         from . import context
@@ -199,10 +212,11 @@ class TimeHandle:
         task = context.try_current_task()
         if task is None:
             return delay_ns
-        rate = self.node_skew.get(task.node.id)
-        if rate is None:
+        ppm = self.node_skew.get(task.node.id)
+        if not ppm:
             return delay_ns
-        return int(delay_ns * rate)
+        adj = delay_ns * abs(ppm) // 1_000_000
+        return delay_ns + adj if ppm >= 0 else delay_ns - adj
 
     def add_timer_ns(self, delay_ns: int, callback: Callable[[], None]) -> TimerEntry:
         deadline = self.clock.elapsed_ns + self.skew_delay_ns(max(0, delay_ns))

@@ -663,8 +663,11 @@ class NemesisDriver:
             net.update_config(self.plan.to_net_config(net.network.config))
         skew = self.plan.skew_ppm(self.seed, len(self.node_ids))
         if any(skew):
+            # integer ppm straight through (r8): vtime.skew_delay_ns
+            # applies the exact-int truncation rule shared with the
+            # device engine's scale_delay_ppm
             self.handle.time.node_skew = {
-                nid: 1.0 + ppm * 1e-6
+                nid: ppm
                 for nid, ppm in zip(self.node_ids, skew)
                 if ppm != 0
             }

@@ -1,0 +1,60 @@
+"""Bit-packed bool planes: u32 words for the carry, bool tensors in the step.
+
+The r8 compaction (docs/state_layout.md): XLA materializes `bool` as one
+byte per element, so the engine's validity planes — `alive [L,N]`,
+`link_ok [L,N,N]` and especially the message pool's `valid [L,N,CK]` —
+cost 8x their information content in carry bytes, and the carry is read
+AND written every fused step. The SimState at rest therefore stores these
+planes packed 32-to-a-word along their last axis; `BatchedSim._step`
+unpacks them into bool tensors on entry and repacks on exit. Both
+directions are pure elementwise shift/mask arithmetic on uint32 (the same
+op vocabulary as the murmur3 draw chain in prng.py), so XLA fuses them
+into the surrounding step work — the bool plane lives only inside the
+fused kernel, never in HBM-resident state.
+
+Packing is strictly value-preserving: `unpack_bits(pack_bits(m), K) == m`
+for every bool tensor (tests/test_state_layout.py pins the round-trip),
+so the compacted engine's trajectories are bit-identical to the r7
+layout's.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def packed_words(k: int) -> int:
+    """Words needed to hold `k` bits (ceil(k / 32))."""
+    return -(-k // 32)
+
+
+def pack_bits(mask: jnp.ndarray) -> jnp.ndarray:
+    """bool [..., K] -> u32 [..., ceil(K/32)], little-endian bit order
+    (bit j of word w holds element w * 32 + j; trailing pad bits are 0)."""
+    K = mask.shape[-1]
+    W = packed_words(K)
+    pad = W * 32 - K
+    if pad:
+        mask = jnp.concatenate(
+            [mask, jnp.zeros(mask.shape[:-1] + (pad,), jnp.bool_)], axis=-1
+        )
+    bits = mask.reshape(mask.shape[:-1] + (W, 32)).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    # the shifted bits are disjoint, so a sum IS the bitwise OR — and sum
+    # is a plain fusable reduce
+    return (bits << shifts).sum(axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jnp.ndarray, k: int) -> jnp.ndarray:
+    """u32 [..., W] -> bool [..., k] (inverse of pack_bits)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> shifts) & jnp.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (words.shape[-1] * 32,))
+    return flat[..., :k] != 0
+
+
+def full_mask_word(n: int) -> int:
+    """The packed representation of n all-true bits in one word (n <= 32)."""
+    if not 0 <= n <= 32:
+        raise ValueError(f"n must be in [0, 32], got {n}")
+    return (1 << n) - 1 if n < 32 else 0xFFFFFFFF

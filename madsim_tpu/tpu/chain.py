@@ -366,6 +366,21 @@ def make_chain_spec(
         lane_metrics=lane_metrics,
         msg_kind_names=("FWD", "HACK", "WREQ", "RREQ", "RRSP", "CACK"),
         time_fields=("fw_t", "fw_echo", "creq_t", "wm_t", "la_tinv"),
+        # r8 carry compaction (docs/state_layout.md): fw_valid is a bool
+        # flag, *_kind ops are {0, OP_READ, OP_WRITE}, fw_writer a node id
+        # (< 32 by the engine's packed-plane cap), keys index [0, K).
+        # Versions (kv_ver/vnext/fw_ver/wm_ver/la_ver) stay i32: they
+        # advance once per committed write per key with no hard cadence
+        # floor, and the write-monotonicity oracle compares them — a
+        # wrapped version IS a violation, so no latent bound is allowed.
+        narrow_fields={
+            "fw_valid": jnp.uint8,
+            "fw_writer": jnp.uint8,
+            "creq_kind": jnp.uint8,
+            "la_kind": jnp.uint8,
+            **({"fw_key": jnp.uint8, "creq_key": jnp.uint8,
+                "la_key": jnp.uint8} if K <= 255 else {}),
+        },
     ))
 
 

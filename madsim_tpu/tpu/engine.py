@@ -70,7 +70,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from . import prng
+from . import bitpack, prng
 from .spec import INF_GUARD, INF_US, Outbox, ProtocolSpec, REBASE_US, SimConfig
 from ..nemesis import (
     COIN_DENOM,
@@ -154,22 +154,41 @@ class MsgPool(NamedTuple):
     step, so its bytes are a top step cost — and first-free placement
     needs roughly half the depth of strict rotation for burst traffic
     (measured: raft reply bursts need K=4 rotating, K=2 first-free).
+
+    r8 compaction (docs/state_layout.md): the validity plane is stored
+    BIT-PACKED along the slot axis (bool costs a full byte in HBM and the
+    pool is rewritten every step), and `kind` is u8 at rest for specs
+    that DECLARE msg_kind_names (the dense [0, len) enum every in-tree
+    spec uses; BatchedSim validates len <= 256). A spec without declared
+    kind names might use sparse values >= 256, which a u8 cast would
+    silently wrap — those keep i32 kinds (BatchedSim._kind_dtype). The
+    step unpacks/widens on entry and repacks on exit; use the `valid`
+    property for the bool view outside the step.
     """
 
-    valid: Any  # bool [L,N,CK]  (CK = C * K ring slots)
+    valid_p: Any  # u32 [L,N,ceil(CK/32)] packed validity bits over the ring
     deliver: Any  # i32 [L,CK] (offset us)
-    kind: Any  # i32 [L,CK]
+    kind: Any  # u8 [L,CK] (i32 when msg_kind_names is undeclared)
     payload: Any  # i32 [L,CK,P]
+
+    @property
+    def valid(self):
+        """bool [L,N,CK] validity view (unpacks valid_p)."""
+        return bitpack.unpack_bits(self.valid_p, self.deliver.shape[-1])
 
 
 class StragPool(NamedTuple):
     """Heavy-tail straggler side pool: one region of K4 slots per candidate
-    position ([L, C, K4] flattened to [L, B]); dst is dynamic (stored)."""
+    position ([L, C, K4] flattened to [L, B]); dst is dynamic (stored).
+    `valid` stays an unpacked bool plane — the side pool only exists while
+    buggify_delay_rate > 0 and is ~N x smaller than the main pool; dst is
+    u8 at rest (node ids < 32, engine-enforced) and kind follows the main
+    pool's dtype rule (u8 iff msg_kind_names is declared)."""
 
     valid: Any  # bool [L,B]
     deliver: Any  # i32 [L,B]
-    dst: Any  # i32 [L,B]
-    kind: Any  # i32 [L,B]
+    dst: Any  # u8 [L,B]
+    kind: Any  # u8 [L,B] (i32 when msg_kind_names is undeclared)
     payload: Any  # i32 [L,B,P]
 
 
@@ -200,7 +219,12 @@ class NemesisState(NamedTuple):
     spike_at: Any  # i32 [L] next latency-spike toggle
     spiking: Any  # bool [L]
     spike_k: Any  # i32 [L]
-    skew: Any  # f32 [L,N] per-node timer rate (1.0 = none) | None
+    skew_ppm: Any  # i32 [L,N] per-node timer rate skew in ppm (0 = none)
+    #           | None. Integer ppm, not an f32 rate: the r8 precision fix
+    #           — f32 multiply loses integer microseconds above 2^24 us
+    #           (~16.7 virtual seconds); scale_delay_ppm is exact for every
+    #           i32 delay. Loop-invariant: drawn once per (seed, node) at
+    #           init, hoisted out of the sweep carry by split_state.
 
 
 class TriageCtl(NamedTuple):
@@ -292,6 +316,26 @@ class TraceRecord(NamedTuple):
 
 
 class SimState(NamedTuple):
+    """The full per-lane state pytree (the sweep carry).
+
+    r8 layout discipline (docs/state_layout.md, tests/test_state_layout.py):
+    the fields split three ways for the sweep loop —
+
+      HOT    mutated by (nearly) every step: clocks, keys, pools, timers,
+             chaos cursors, node state. Carried through the while_loop.
+      COLD   write-rarely / accumulate-only metadata (violation records,
+             counters, fire masks, coverage): still carried (XLA aliases
+             the carry in place) but grouped in ColdState so the layout
+             lint can hold its growth separately.
+      CONST  loop-invariant (key0, ctl, skew_ppm): split OUT of the
+             while_loop carry entirely by split_state — the step reads
+             them as invariant operands and never rewrites them, so they
+             stop being re-materialized by every fused step.
+
+    Bool planes (alive, link_ok, pool validity) are stored bit-packed
+    (bitpack.py); the `alive` / `link_ok` properties give the bool view.
+    """
+
     clock: Any  # i32 [L] (offset us; see epoch)
     epoch: Any  # i32 [L] rebase count (abs = epoch * REBASE_US + clock)
     key: Any  # u32 [L] (hash-chain, prng.py)
@@ -319,19 +363,116 @@ class SimState(NamedTuple):
     #            None unless a nemesis schedule clause is enabled. This is
     #            the clause x occurrence half of the coverage signal AND the
     #            raw data of the per-occurrence chaos report.
-    alive: Any  # bool [L,N]
+    alive_p: Any  # u32 [L,1] packed node-liveness bits (N <= 32)
     crashed: Any  # i32 [L] (node id currently down, -1 = none)
     chaos_at: Any  # i32 [L] (next crash/restart event)
-    link_ok: Any  # bool [L,N,N] (directed link up; the clog masks)
+    link_ok_p: Any  # u32 [L,N,1] packed directed-link-up bits, row = src
     partitioned: Any  # bool [L] (a partition is currently active)
     part_at: Any  # i32 [L] (next partition split/heal event)
     timer: Any  # i32 [L,N]
-    node: Any  # protocol pytree, leaves [L,N,...]
+    node: Any  # protocol pytree, leaves [L,N,...] (fields named in
+    #           spec.narrow_fields are stored at their narrow dtypes and
+    #           widened to i32 before every handler call)
     msgs: MsgPool
     strag: Any  # StragPool | None (None unless buggify_delay_rate > 0)
     nem: Any  # NemesisState | None (None unless a nemesis clause is on)
     ctl: Any  # TriageCtl | None (None unless BatchedSim(triage=True))
     cov: Any  # Coverage | None (None unless BatchedSim(coverage=True))
+
+    @property
+    def alive(self):
+        """bool [L,N] node-liveness view (unpacks alive_p)."""
+        return bitpack.unpack_bits(self.alive_p, self.timer.shape[1])
+
+    @property
+    def link_ok(self):
+        """bool [L,N,N] directed-link view (unpacks link_ok_p)."""
+        return bitpack.unpack_bits(self.link_ok_p, self.timer.shape[1])
+
+
+class ColdState(NamedTuple):
+    """The accumulate-only half of the sweep carry (see SimState). Grouped
+    so the state-layout lint budgets hot and cold bytes separately and the
+    split is visible in the compiled program's carry structure."""
+
+    violation_at: Any
+    violation_epoch: Any
+    violation_step: Any
+    deadlocked: Any
+    steps: Any
+    events: Any
+    overflow: Any
+    dead_drops: Any
+    fires: Any
+    occ_fired: Any
+    cov: Any
+
+
+COLD_FIELDS = ColdState._fields
+
+
+class ConstState(NamedTuple):
+    """Loop-invariant lane state, split OUT of the sweep carry: the step
+    reads these but never writes them, so keeping them in the while_loop
+    carry made every fused step re-emit them as outputs (copied bytes per
+    step, and per-segment donation rotation). key0 feeds every
+    schedule-pure nemesis draw; ctl is the triage shrinker's per-lane
+    switchboard; skew_ppm the per-(seed, node) clock-skew assignment."""
+
+    key0: Any
+    ctl: Any
+    skew_ppm: Any
+
+
+def split_state(state: SimState):
+    """SimState -> (hot, cold, const) for the sweep loop. Pure pytree
+    restructuring: no data moves, the leaves are the same buffers."""
+    nem = state.nem
+    hot = state._replace(
+        key0=None, ctl=None,
+        nem=None if nem is None else nem._replace(skew_ppm=None),
+        **{f: None for f in COLD_FIELDS},
+    )
+    cold = ColdState(*(getattr(state, f) for f in COLD_FIELDS))
+    const = ConstState(
+        key0=state.key0, ctl=state.ctl,
+        skew_ppm=None if nem is None else nem.skew_ppm,
+    )
+    return hot, cold, const
+
+
+def merge_state(hot: SimState, cold: ColdState, const: ConstState) -> SimState:
+    """(hot, cold, const) -> flat SimState (inverse of split_state)."""
+    nem = hot.nem
+    if nem is not None:
+        nem = nem._replace(skew_ppm=const.skew_ppm)
+    return hot._replace(
+        key0=const.key0, ctl=const.ctl, nem=nem,
+        **dict(zip(COLD_FIELDS, cold)),
+    )
+
+
+def scale_delay_ppm(d: jnp.ndarray, ppm) -> jnp.ndarray:
+    """Stretch a non-negative i32 microsecond delay by (1 + ppm * 1e-6),
+    EXACTLY, in pure int32 arithmetic: d + trunc(d * |ppm| / 1e6) * sign.
+
+    Replaces the r1 `(d.astype(f32) * rate).astype(i32)` path, which
+    loses integer precision once d exceeds 2^24 us (~16.7 virtual
+    seconds — well inside a 30 s horizon). The 64-bit product d * ppm is
+    decomposed into i32-safe partial products: with d = q * 1e6 + r,
+    r = r1 * 1e3 + r0 and |ppm| = p1 * 1e3 + p0, every term below stays
+    under 2^31 for d < 2^31 and |ppm| < 1e6 (the SimConfig validation
+    bound). The host runtime mirrors the same truncation in
+    core/vtime.skew_delay_ns (exact there via Python ints).
+    """
+    ppm = jnp.asarray(ppm, jnp.int32)
+    mag = jnp.abs(ppm)
+    q, r = d // 1_000_000, d % 1_000_000
+    r1, r0 = r // 1000, r % 1000
+    p1, p0 = mag // 1000, mag % 1000
+    frac = ((r1 * p0 + r0 * p1) * 1000 + r0 * p0) // 1_000_000
+    adj = q * mag + r1 * p1 + frac
+    return jnp.where(ppm >= 0, d + adj, d - adj)
 
 
 def _first_free(free: jnp.ndarray, K: int) -> jnp.ndarray:
@@ -383,6 +524,55 @@ class BatchedSim:
         # fail loudly at construction, not as shape errors deep inside jit
         if N < 2:
             raise ValueError(f"spec.n_nodes must be >= 2, got {N}")
+        if N > 32:
+            # the packed alive/link_ok planes keep one u32 word per row
+            # (and spec.majority's bitmask already caps quorum specs at 31)
+            raise ValueError(
+                f"spec.n_nodes must be <= 32 (packed bool planes), got {N}"
+            )
+        if spec.msg_kind_names is not None and len(spec.msg_kind_names) > 256:
+            raise ValueError(
+                "message kinds must fit u8 (pool `kind` is stored narrow): "
+                f"got {len(spec.msg_kind_names)} named kinds"
+            )
+        # pool `kind` narrows to u8 only for specs that DECLARE their kind
+        # vocabulary (msg_kind_names = the dense [0, len) enum every
+        # in-tree spec uses, validated <= 256 above); an undeclared spec
+        # might use sparse kind values >= 256, which a blind u8 cast would
+        # silently wrap — those keep i32 kinds.
+        self._kind_dtype = (
+            jnp.uint8 if spec.msg_kind_names is not None else jnp.int32
+        )
+        # node-state leaves the spec declares narrow (docs/state_layout.md):
+        # stored at the narrow dtype in the carry, widened back to i32
+        # before every handler call — handlers stay wall-to-wall i32.
+        self._narrow = dict(spec.narrow_fields or {})
+        bad = set(self._narrow) & set(spec.time_fields)
+        if bad:
+            raise ValueError(
+                "time_fields hold absolute epoch-rebased times and must "
+                f"stay i32 — remove {sorted(bad)} from narrow_fields"
+            )
+        if self._narrow and spec.narrow_horizon_us is not None:
+            # rate-argument narrow bounds ("one tid per coordinator-timer
+            # floor") only hold up to the spec-declared horizon; past it
+            # a narrow counter would wrap SILENTLY — refuse instead.
+            # Clock skew shrinks every relative timer delay by up to
+            # (1 - max_ppm * 1e-6), speeding the bounding cadence up by
+            # the same factor, so the cap derates with the config's skew.
+            cap = spec.narrow_horizon_us
+            if cfg.nem_skew_enabled:
+                cap = cap * (1_000_000 - cfg.nem_skew_max_ppm) // 1_000_000
+            if cfg.horizon_us > cap:
+                raise ValueError(
+                    f"horizon_us={cfg.horizon_us} exceeds this spec's "
+                    f"narrow-dtype safe horizon ({cap} us"
+                    + (" after clock-skew derating"
+                       if cfg.nem_skew_enabled else "")
+                    + "): strip spec.narrow_fields (dataclasses.replace("
+                    "spec, narrow_fields=None)) for long soaks, or "
+                    "shorten the horizon"
+                )
         if spec.payload_width < 1 or spec.max_out < 1 or spec.max_out_msg < 1:
             raise ValueError(
                 "spec payload_width / max_out / max_out_msg must be >= 1 "
@@ -630,6 +820,47 @@ class BatchedSim:
         # the sweep.
         self.dispatch_count = 0
 
+    # ------------------------------------------------ node-state narrowing
+    # spec.narrow_fields: {field -> narrow dtype}. The carry stores those
+    # leaves narrow; the step widens them back to i32 before every handler
+    # call, so spec handler arithmetic is untouched (and the narrowing is
+    # value-preserving by the spec's declared bound — a field that can go
+    # negative must declare a SIGNED narrow dtype). The layout lint
+    # (tests/test_state_layout.py) pins the narrowing-invariance: a spec
+    # run with narrow_fields stripped must produce bit-identical
+    # trajectories.
+
+    def _narrow_node(self, node):
+        if not self._narrow:
+            return node
+        return node._replace(**{
+            f: getattr(node, f).astype(dt) for f, dt in self._narrow.items()
+        })
+
+    def _widen_node(self, node):
+        if not self._narrow:
+            return node
+        return node._replace(**{
+            f: getattr(node, f).astype(jnp.int32) for f in self._narrow
+        })
+
+    def _check_narrow(self, node) -> None:
+        for f, dt in self._narrow.items():
+            if not hasattr(node, f):
+                raise ValueError(
+                    f"narrow_fields names unknown node-state field {f!r}"
+                )
+            if getattr(node, f).dtype != jnp.int32:
+                raise ValueError(
+                    f"narrow_fields[{f!r}]: only i32 fields can be "
+                    f"narrowed (field is {getattr(node, f).dtype})"
+                )
+            if jnp.dtype(dt).itemsize >= 4:
+                raise ValueError(
+                    f"narrow_fields[{f!r}] = {jnp.dtype(dt)} is not "
+                    "narrower than i32"
+                )
+
     # ------------------------------------------------------------------ init
 
     def _init(self, seeds: jnp.ndarray, ctl=None) -> SimState:
@@ -651,34 +882,34 @@ class BatchedSim:
         node_keys = prng.fold(key[:, None], jnp.arange(N, dtype=jnp.uint32))
         node_state, timer = self._v_init(node_keys, jnp.arange(N, dtype=jnp.int32))
         timer = jnp.asarray(timer, jnp.int32)
+        self._check_narrow(node_state)
 
         # per-node clock skew (nemesis): timer rate drawn once per
-        # (seed, node) — the same formula FaultPlan.skew_ppm mirrors
+        # (seed, node) — the same formula FaultPlan.skew_ppm mirrors.
+        # Stored as integer ppm; delays stretch via scale_delay_ppm (exact
+        # int32 math — the f32 rate multiply lost microseconds past 2^24).
         fires = jnp.zeros((L, len(FIRE_KINDS)), jnp.int32)
-        skew = None
+        skew_ppm = None
         if cfg.nem_skew_enabled:
             ppm = prng.randint(
                 key[:, None], NEM_SITE_SKEW, -cfg.nem_skew_max_ppm,
                 cfg.nem_skew_max_ppm + 1,
                 index=jnp.arange(N, dtype=jnp.uint32)[None, :],
             )  # [L,N]
-            skew = jnp.float32(1.0) + ppm.astype(jnp.float32) * jnp.float32(1e-6)
             skew_applied = ppm != 0
             if self.triage:
-                # a skew-disabled lane runs every node at rate 1.0; the ppm
+                # a skew-disabled lane runs every node at ppm 0; the ppm
                 # draws still happen (sites untouched), they just don't apply
                 en_skew = _clause_on(ctl, "skew")
-                skew = jnp.where(en_skew[:, None], skew, jnp.float32(1.0))
+                ppm = jnp.where(en_skew[:, None], ppm, jnp.int32(0))
                 skew_applied = skew_applied & en_skew[:, None]
+            skew_ppm = ppm
             fires = fires.at[:, FIRE_INDEX["skew"]].set(
                 skew_applied.sum(axis=1, dtype=jnp.int32)
             )
             # initial timers are armed at local t=0: scale the delay
             sk_ok = (timer >= 0) & (timer < INF_GUARD)
-            timer = jnp.where(
-                sk_ok, (timer.astype(jnp.float32) * skew).astype(jnp.int32),
-                timer,
-            )
+            timer = jnp.where(sk_ok, scale_delay_ppm(timer, skew_ppm), timer)
 
         if cfg.nem_crash_enabled:
             # occurrence-indexed: the first crash interval is draw k=0 of
@@ -728,7 +959,7 @@ class BatchedSim:
                     else jnp.full((L,), INF_US, jnp.int32)
                 ),
                 spiking=zb, spike_k=zi,
-                skew=skew,
+                skew_ppm=skew_ppm,
             )
         else:
             nem = None
@@ -737,8 +968,8 @@ class BatchedSim:
             strag = StragPool(
                 valid=jnp.zeros((L, self._B), jnp.bool_),
                 deliver=jnp.full((L, self._B), INF_US, jnp.int32),
-                dst=jnp.zeros((L, self._B), jnp.int32),
-                kind=jnp.zeros((L, self._B), jnp.int32),
+                dst=jnp.zeros((L, self._B), jnp.uint8),
+                kind=jnp.zeros((L, self._B), self._kind_dtype),
                 payload=jnp.zeros((L, self._B, spec.payload_width), jnp.int32),
             )
         else:
@@ -764,18 +995,24 @@ class BatchedSim:
                 jnp.zeros((L, len(OCC_CLAUSES)), jnp.uint32)
                 if self._occ_track else None
             ),
-            alive=jnp.ones((L, N), jnp.bool_),
+            alive_p=jnp.full(
+                (L, 1), bitpack.full_mask_word(N), jnp.uint32
+            ),
             crashed=jnp.full((L,), -1, jnp.int32),
             chaos_at=chaos_at,
-            link_ok=jnp.ones((L, N, N), jnp.bool_),
+            link_ok_p=jnp.full(
+                (L, N, 1), bitpack.full_mask_word(N), jnp.uint32
+            ),
             partitioned=jnp.zeros((L,), jnp.bool_),
             part_at=part_at,
             timer=timer,
-            node=node_state,
+            node=self._narrow_node(node_state),
             msgs=MsgPool(
-                valid=jnp.zeros((L, N, CK), jnp.bool_),
+                valid_p=jnp.zeros(
+                    (L, N, bitpack.packed_words(CK)), jnp.uint32
+                ),
                 deliver=jnp.full((L, CK), INF_US, jnp.int32),
-                kind=jnp.zeros((L, CK), jnp.int32),
+                kind=jnp.zeros((L, CK), self._kind_dtype),
                 payload=jnp.zeros((L, CK, spec.payload_width), jnp.int32),
             ),
             strag=strag,
@@ -796,6 +1033,16 @@ class BatchedSim:
     def _step(self, state: SimState) -> SimState:
         return self._step_traced(state)[0]
 
+    def _step_split(self, hot: SimState, cold: ColdState, const: ConstState):
+        """One step in the sweep loop's (hot, cold | const) form: const is
+        an invariant OPERAND, not part of the returned carry — the compiled
+        loop body reads key0/ctl/skew_ppm but never re-emits them. This is
+        the program benches/roofline.py accounts bytes for (the step the
+        sweep actually runs); merge/split are free pytree restructuring."""
+        s2, rec = self._step_traced(merge_state(hot, cold, const))
+        h2, c2, _ = split_state(s2)
+        return h2, c2, rec
+
     def _step_traced(self, state: SimState) -> Tuple[SimState, TraceRecord]:
         """One engine step + the step's TraceRecord.
 
@@ -808,11 +1055,21 @@ class BatchedSim:
         strag: Optional[StragPool] = state.strag
         narange = jnp.arange(N, dtype=jnp.int32)
 
+        # -- 0. unpack the compacted carry (r8, docs/state_layout.md):
+        # bit-packed bool planes -> bool tensors, narrow node leaves ->
+        # i32. Pure elementwise shifts/converts that fuse into the step;
+        # the wide forms live only inside this kernel and are repacked at
+        # the end, so the HBM-resident carry stays narrow.
+        valid = bitpack.unpack_bits(msgs.valid_p, CK)  # bool [L,N,CK]
+        alive = bitpack.unpack_bits(state.alive_p, N)  # bool [L,N]
+        link_ok = bitpack.unpack_bits(state.link_ok_p, N)  # bool [L,N,N]
+        node0 = self._widen_node(state.node)
+
         # -- 1. advance each lane to its next event window -----------------
         # (the advance_to_next_event analog, time/mod.rs:45-60, batched).
-        # Node n's pending messages are the static slice msgs.valid[:, n, :]
+        # Node n's pending messages are the static slice valid[:, n, :]
         # over the shared ring — no destination matching (see MsgPool).
-        t_pend = jnp.where(msgs.valid, msgs.deliver[:, None, :], INF_US)  # [L,N,CK]
+        t_pend = jnp.where(valid, msgs.deliver[:, None, :], INF_US)  # [L,N,CK]
         tmsg_n = t_pend.min(axis=2)  # [L,N]
         if self._B:
             sd_oh = strag.dst[:, :, None] == narange[None, None, :]  # [L,B,N]
@@ -820,8 +1077,8 @@ class BatchedSim:
             t_sn = jnp.where(sd_oh, ts_b[:, :, None], INF_US)  # [L,B,N]
             tmsg_strag = t_sn.min(axis=1)  # [L,N]
             tmsg_n = jnp.minimum(tmsg_n, tmsg_strag)
-        tmsg_n = jnp.where(state.alive, tmsg_n, INF_US)
-        ttmr_n = jnp.where(state.alive, state.timer, INF_US)  # [L,N]
+        tmsg_n = jnp.where(alive, tmsg_n, INF_US)
+        ttmr_n = jnp.where(alive, state.timer, INF_US)  # [L,N]
         t_next = jnp.minimum(
             jnp.minimum(jnp.minimum(tmsg_n.min(axis=1), ttmr_n.min(axis=1)),
                         state.chaos_at),
@@ -893,7 +1150,7 @@ class BatchedSim:
         t_evt = jnp.where(has_msg, tmsg_n, jnp.where(due_t, ttmr_n, t_next[:, None]))
 
         # main-pool slot choice: among this node's earliest-time slots
-        head = msgs.valid & (t_pend == tmsg_n[:, :, None])  # [L,N,CK]
+        head = valid & (t_pend == tmsg_n[:, :, None])  # [L,N,CK]
         if cfg.sched_randomize:
             # random tie-break among equal-timestamp due messages — the
             # scheduling-nondeterminism amplifier (utils/mpsc.rs:71-84):
@@ -937,14 +1194,14 @@ class BatchedSim:
         pick_oh = jnp.arange(CK)[None, None, :] == slot[:, :, None]  # [L,N,CK]
         pick_ohi = pick_oh.astype(jnp.int32)
         m_src = (self._src_of_slot[None, None, :] * pick_ohi).sum(2)
-        m_kind = (msgs.kind[:, None, :] * pick_ohi).sum(2)
+        m_kind = (msgs.kind.astype(jnp.int32)[:, None, :] * pick_ohi).sum(2)
         m_pay = (msgs.payload[:, None, :, :] * pick_ohi[:, :, :, None]).sum(2)
         if self._B:
             s_pick = (
                 jnp.arange(self._B)[None, None, :] == s_slot[:, :, None]
             ).astype(jnp.int32)  # [L,N,B]
             sm_src = (self._src_of_b[None, None, :] * s_pick).sum(2)
-            sm_kind = (strag.kind[:, None, :] * s_pick).sum(2)
+            sm_kind = (strag.kind.astype(jnp.int32)[:, None, :] * s_pick).sum(2)
             sm_pay = (strag.payload[:, None, :, :] * s_pick[:, :, :, None]).sum(2)
             m_src = jnp.where(strag_win, sm_src, m_src)
             m_kind = jnp.where(strag_win, sm_kind, m_kind)
@@ -1000,7 +1257,7 @@ class BatchedSim:
             # window collapses to it on chaos steps), never an earlier
             # clock — a restart timer must not be armed in the past
             ns_r, timer_r = self._v_on_restart(
-                state.node, node_ids, t_next, rkeys
+                node0, node_ids, t_next, rkeys
             )
             if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
                 # crash-with-state-wipe: the marked node restarts from
@@ -1034,7 +1291,7 @@ class BatchedSim:
             evt = has_msg | due_t
             evt_kind = jnp.where(has_msg, m_kind, jnp.int32(-1))
             ns_e, out_e, timer_e = self._v_on_event(
-                state.node, node_ids, m_src, evt_kind, m_pay, t_evt, mkeys
+                node0, node_ids, m_src, evt_kind, m_pay, t_evt, mkeys
             )
 
             def merge(old, e, r):
@@ -1046,18 +1303,18 @@ class BatchedSim:
                 return out
 
             if any_crash:
-                node = jax.tree_util.tree_map(merge, state.node, ns_e, ns_r)
+                node = jax.tree_util.tree_map(merge, node0, ns_e, ns_r)
             else:
                 node = jax.tree_util.tree_map(
-                    lambda old, e: merge(old, e, None), state.node, ns_e
+                    lambda old, e: merge(old, e, None), node0, ns_e
                 )
             timer_m = timer_t = timer_e
         else:
             ns_m, out_m, timer_m = self._v_on_message(
-                state.node, node_ids, m_src, m_kind, m_pay, t_evt, mkeys
+                node0, node_ids, m_src, m_kind, m_pay, t_evt, mkeys
             )
             ns_t, out_t, timer_t = self._v_on_timer(
-                state.node, node_ids, t_evt, tkeys
+                node0, node_ids, t_evt, tkeys
             )
 
             def merge(old, m, t, r):
@@ -1071,28 +1328,28 @@ class BatchedSim:
 
             if any_crash:
                 node = jax.tree_util.tree_map(
-                    merge, state.node, ns_m, ns_t, ns_r
+                    merge, node0, ns_m, ns_t, ns_r
                 )
             else:
                 node = jax.tree_util.tree_map(
                     lambda old, m, t: merge(old, m, t, None),
-                    state.node, ns_m, ns_t,
+                    node0, ns_m, ns_t,
                 )
         # message handlers return a negative timer to keep the current
         # deadline; timer handlers return a negative value to disarm
         if cfg.nem_skew_enabled:
             # per-node clock skew: a handler's ABSOLUTE deadline encodes a
             # relative delay from its own event time — stretch/shrink that
-            # delay by the node's rate (sentinels and keep/disarm negatives
-            # pass through untouched). f32 is exact for the delay
-            # magnitudes that matter and bit-stable per backend.
-            skewrate = state.nem.skew  # f32 [L,N]
+            # delay by the node's ppm rate (sentinels and keep/disarm
+            # negatives pass through untouched). Integer ppm math
+            # (scale_delay_ppm) is EXACT for every i32 delay; the old f32
+            # rate multiply dropped microseconds once deadlines passed
+            # 2^24 us, i.e. ~16.7 s into any lane's virtual time.
+            skew_ppm_now = state.nem.skew_ppm  # i32 [L,N]
 
             def skew_deadline(deadline, now):
                 d = deadline - now
-                stretched = now + (d.astype(jnp.float32) * skewrate).astype(
-                    jnp.int32
-                )
+                stretched = now + scale_delay_ppm(d, skew_ppm_now)
                 ok = (deadline >= 0) & (deadline < INF_GUARD) & (d > 0)
                 return jnp.where(ok, stretched, deadline)
 
@@ -1113,7 +1370,7 @@ class BatchedSim:
             timer = jnp.where(restart_mask, timer_r, timer)
         # consume the delivered slot (reusing the extraction one-hots)
         consumed_main = has_msg & ~strag_win  # [L,N]
-        valid = msgs.valid & ~(pick_oh & consumed_main[:, :, None])
+        valid = valid & ~(pick_oh & consumed_main[:, :, None])
         if self._B:
             s_oh = (s_pick > 0) & strag_win[:, :, None]  # [L,N,B]
             svalid = strag.valid & ~s_oh.any(axis=1)
@@ -1126,7 +1383,7 @@ class BatchedSim:
         )
 
         # -- 5. crash/restart chaos (Handle::kill/restart analog) ----------
-        alive = state.alive
+        # (`alive` was unpacked from the carry at step 0)
         crashed, chaos_at = state.crashed, state.chaos_at
         tr_crash = jnp.full((L,), -1, jnp.int32)
         tr_restart = jnp.full((L,), -1, jnp.int32)
@@ -1188,8 +1445,8 @@ class BatchedSim:
                 )
 
         # -- 5b. partition chaos: random bipartition splits, later heals ----
-        # (the clog_link masks of network.rs:261-269, lane-batched)
-        link_ok = state.link_ok
+        # (the clog_link masks of network.rs:261-269, lane-batched;
+        # `link_ok` was unpacked from the carry at step 0)
         partitioned, part_at = state.partitioned, state.part_at
         tr_split = jnp.zeros((L,), jnp.bool_)
         tr_heal = jnp.zeros((L,), jnp.bool_)
@@ -1266,7 +1523,7 @@ class BatchedSim:
             link_ok = jnp.where(
                 ap_split[:, None, None],
                 same_side,
-                jnp.where(ap_heal[:, None, None], True, state.link_ok),
+                jnp.where(ap_heal[:, None, None], True, link_ok),
             )
             partitioned = (state.partitioned | do_split) & ~do_heal
             tr_split, tr_heal = ap_split, ap_heal
@@ -1548,13 +1805,23 @@ class BatchedSim:
             place_i = place.astype(jnp.int32)
 
             def put(ring_vals, cand_vals):
-                cv = cand_vals.reshape((L, N, E) + cand_vals.shape[2:])
+                # the one-hot multiply runs in i32 (u8 products could wrap);
+                # the result narrows back to the ring's at-rest dtype
+                cv = cand_vals.astype(jnp.int32).reshape(
+                    (L, N, E) + cand_vals.shape[2:]
+                )
                 if cand_vals.ndim == 2:
                     inc = (place_i * cv[:, :, :, None]).sum(2)
-                    return jnp.where(ring_w, inc.reshape(L, CK), ring_vals)
+                    return jnp.where(
+                        ring_w,
+                        inc.reshape(L, CK).astype(ring_vals.dtype),
+                        ring_vals,
+                    )
                 inc = (place_i[:, :, :, :, None] * cv[:, :, :, None, :]).sum(2)
                 return jnp.where(
-                    ring_w[:, :, None], inc.reshape(L, CK, P), ring_vals
+                    ring_w[:, :, None],
+                    inc.reshape(L, CK, P).astype(ring_vals.dtype),
+                    ring_vals,
                 )
 
             # validity bits: dst d references slot s iff the send that
@@ -1633,7 +1900,7 @@ class BatchedSim:
         new_deliver = put(
             jnp.where(valid.any(1), msgs.deliver, INF_US), deliver_at
         )
-        new_kind = put(msgs.kind, cand_kind)
+        new_kind = put(msgs.kind, cand_kind.astype(self._kind_dtype))
         new_payload = put(msgs.payload, cand_pay)
 
         # straggler pack: region c owns K4 slots of the side pool
@@ -1659,8 +1926,8 @@ class BatchedSim:
             new_strag = StragPool(
                 valid=svalid | swritten,
                 deliver=sput(jnp.where(svalid, strag.deliver, INF_US), deliver_at),
-                dst=sput(strag.dst, cand_dst),
-                kind=sput(strag.kind, cand_kind),
+                dst=sput(strag.dst, cand_dst.astype(jnp.uint8)),
+                kind=sput(strag.kind, cand_kind.astype(self._kind_dtype)),
                 payload=sput(strag.payload, cand_pay),
             )
         else:
@@ -1790,7 +2057,7 @@ class BatchedSim:
                 )
             changed = jnp.zeros((L, N), jnp.bool_)
             for old_leaf, new_leaf in zip(
-                jax.tree_util.tree_leaves(state.node),
+                jax.tree_util.tree_leaves(node0),
                 jax.tree_util.tree_leaves(node),
             ):
                 changed = changed | (old_leaf != new_leaf).reshape(
@@ -1839,7 +2106,7 @@ class BatchedSim:
                 ),
                 spiking=spiking if spiking is not None else nst.spiking,
                 spike_k=nem_spike_k if nem_spike_k is not None else nst.spike_k,
-                skew=nst.skew,
+                skew_ppm=nst.skew_ppm,
             )
         else:
             new_nem = None
@@ -1873,16 +2140,16 @@ class BatchedSim:
             dead_drops=state.dead_drops + dead_dropped,
             fires=fires,
             occ_fired=occ_fired,
-            alive=alive,
+            alive_p=bitpack.pack_bits(alive),
             crashed=crashed,
             chaos_at=chaos_at,
-            link_ok=link_ok,
+            link_ok_p=bitpack.pack_bits(link_ok),
             partitioned=partitioned,
             part_at=part_at,
             timer=timer,
-            node=node,
+            node=self._narrow_node(node),
             msgs=MsgPool(
-                valid=new_valid,
+                valid_p=bitpack.pack_bits(new_valid),
                 deliver=new_deliver,
                 kind=new_kind,
                 payload=new_payload,
@@ -1934,16 +2201,24 @@ class BatchedSim:
         jax.jit, static_argnums=(0, 2), donate_argnums=(1,)
     )
     def _run(self, state: SimState, max_steps: int) -> SimState:
+        # hot/cold/const split (r8): the while_loop carries only the hot +
+        # cold pytrees; ConstState (key0, ctl, skew_ppm) rides as a
+        # loop-invariant operand, so the fused step stops rewriting those
+        # bytes every iteration and the donated segment stops rotating
+        # them through fresh buffers at every dispatch boundary.
+        hot, cold, const = split_state(state)
+
         def cond(carry):
-            s, i = carry
-            return jnp.logical_and(i < max_steps, jnp.any(~s.done))
+            h, _c, i = carry
+            return jnp.logical_and(i < max_steps, jnp.any(~h.done))
 
         def body(carry):
-            s, i = carry
-            return self._step(s), i + 1
+            h, c, i = carry
+            h2, c2, _ = self._step_split(h, c, const)
+            return h2, c2, i + 1
 
-        final, _ = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
-        return final
+        h, c, _ = jax.lax.while_loop(cond, body, (hot, cold, jnp.int32(0)))
+        return merge_state(h, c, const)
 
     def run(
         self, seeds, max_steps: int = 100_000, dispatch_steps: int = 10_000,
@@ -2020,12 +2295,15 @@ class BatchedSim:
     @functools.partial(jax.jit, static_argnums=(0, 2))
     def run_steps(self, state: SimState, n_steps: int) -> SimState:
         """Fixed-step scan (benchmark-friendly: no host syncs)."""
+        hot, cold, const = split_state(state)
 
-        def body(s, _):
-            return self._step(s), None
+        def body(carry, _):
+            h, c = carry
+            h2, c2, _ = self._step_split(h, c, const)
+            return (h2, c2), None
 
-        final, _ = jax.lax.scan(body, state, None, length=n_steps)
-        return final
+        (h, c), _ = jax.lax.scan(body, (hot, cold), None, length=n_steps)
+        return merge_state(h, c, const)
 
     # donated like _run: run_traced hands the freshly-built init state in
     # and never touches it again (the [T, 1, ...] record stream is a new
@@ -2034,11 +2312,15 @@ class BatchedSim:
         jax.jit, static_argnums=(0, 2), donate_argnums=(1,)
     )
     def _run_traced(self, state: SimState, n_steps: int):
-        def body(s, _):
-            s2, rec = self._step_traced(s)
-            return s2, rec
+        hot, cold, const = split_state(state)
 
-        return jax.lax.scan(body, state, None, length=n_steps)
+        def body(carry, _):
+            h, c = carry
+            h2, c2, rec = self._step_split(h, c, const)
+            return (h2, c2), rec
+
+        (h, c), recs = jax.lax.scan(body, (hot, cold), None, length=n_steps)
+        return merge_state(h, c, const), recs
 
     def run_traced(self, seed: int, max_steps: int = 20_000, ctl=None):
         """Re-run ONE seed with full event capture (the violation microscope).
@@ -2120,6 +2402,77 @@ def abs_time_us(state: SimState):
     )
 
 
+def _sum64(x: jnp.ndarray, axis=0):
+    """Exact lane sum of a non-negative i32 tensor WITHOUT int64 (x64 mode
+    is off): split into 16-bit halves, sum each in u32 — hi * 2^16 + lo is
+    recombined host-side as a Python int. Both partials stay below 2^32
+    only for lanes <= 65536 (values < 2^31), so that bound is ENFORCED:
+    a bigger batch must be summarized in chunks (run_batch already
+    chunks), not allowed to wrap the u32 partials silently."""
+    if x.shape[axis] > 65536:
+        raise ValueError(
+            f"_sum64: lane axis {x.shape[axis]} > 65536 would overflow "
+            "the u32 partial sums — summarize in chunks"
+        )
+    xu = x.astype(jnp.uint32)
+    return (
+        jnp.sum(xu >> 16, axis=axis, dtype=jnp.uint32),
+        jnp.sum(xu & jnp.uint32(0xFFFF), axis=axis, dtype=jnp.uint32),
+    )
+
+
+def _join64(hi, lo) -> int:
+    import numpy as np
+
+    return int(np.asarray(hi, np.int64) * 65536 + np.asarray(lo, np.int64))
+
+
+def _summary_reduction(state: SimState) -> dict:
+    """The decode-side fusion (r8): every per-summary reduction — lane
+    counters, chaos fire totals, per-occurrence fire counts, coverage
+    popcounts — folded into ONE jitted device program. summarize()
+    previously pulled a dozen full [L, ...] tensors to the host and
+    reduced them in numpy; a chunked sweep paid those transfers per chunk.
+    Now the device reduces and the host reads back only scalars/rows."""
+    violated = state.violated
+    out = {
+        "violations": jnp.sum(violated, dtype=jnp.int32),
+        "deadlocked": jnp.sum(state.deadlocked, dtype=jnp.int32),
+        "events64": _sum64(state.events),
+        "overflow64": _sum64(state.overflow),
+        "dead_drops64": _sum64(state.dead_drops),
+        "steps64": _sum64(state.steps),
+        "epoch64": _sum64(state.epoch),
+        "clock64": _sum64(state.clock),
+        # earliest first-violation step over violating lanes: the triage
+        # shrinker's run-to-step truncation anchor (INT32_MAX = none)
+        "first_violation_step": jnp.min(
+            jnp.where(violated, state.violation_step, jnp.int32(2**31 - 1))
+        ),
+        "fires64": _sum64(state.fires, axis=0),  # ([K], [K])
+    }
+    if state.occ_fired is not None:
+        # per-(clause row, occurrence bit) lane counts [R, 32]
+        bits = (
+            state.occ_fired[:, :, None]
+            >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+        ) & jnp.uint32(1)
+        out["occ_counts"] = bits.sum(axis=0, dtype=jnp.int32)
+    if state.cov is not None:
+        out["cov_union"] = jax.lax.reduce(
+            state.cov.bitmap, jnp.uint32(0), jax.lax.bitwise_or, (0,)
+        )  # [COV_WORDS]
+        out["cov_union_bits"] = jax.lax.population_count(
+            out["cov_union"]
+        ).sum(dtype=jnp.int32)
+        out["cov_hiwater"] = jnp.max(state.cov.hiwater)
+        out["cov_transitions64"] = _sum64(state.cov.transitions)
+    return out
+
+
+_SUMMARY_RED = jax.jit(_summary_reduction)
+
+
 def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
     """Host-side summary of a finished batch (bug reports with repro info).
 
@@ -2127,51 +2480,52 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
     spec reports how many lanes saturated their fixed-capacity log (a lane
     whose log stopped appending is a lane that stopped finding bugs; that
     must be visible, not silent).
+
+    All batch-wide reductions run on device in one fused decode program
+    (`_summary_reduction`); the host pulls back only the reduced rows plus
+    the [L] violation bitmap (for lane indices).
     """
     import numpy as np
 
+    red = _SUMMARY_RED(state)
     violated = np.asarray(state.violated)
+    L = int(violated.shape[0])
+    steps_total = _join64(*red["steps64"])
+    vt_total_us = (
+        _join64(*red["epoch64"]) * REBASE_US + _join64(*red["clock64"])
+    )
     out = {
-        "lanes": int(violated.shape[0]),
-        "violations": int(violated.sum()),
+        "lanes": L,
+        "violations": int(red["violations"]),
         "violation_lanes": np.nonzero(violated)[0].tolist()[:32],
-        "deadlocked": int(np.asarray(state.deadlocked).sum()),
-        "total_events": int(np.asarray(state.events).sum()),
-        "total_overflow": int(np.asarray(state.overflow).sum()),
-        "total_dead_drops": int(np.asarray(state.dead_drops).sum()),
-        "mean_steps": float(np.asarray(state.steps).mean()),
-        "mean_virtual_secs": float(abs_time_us(state).mean()) / 1e6,
+        "deadlocked": int(red["deadlocked"]),
+        "total_events": _join64(*red["events64"]),
+        "total_overflow": _join64(*red["overflow64"]),
+        "total_dead_drops": _join64(*red["dead_drops64"]),
+        "mean_steps": steps_total / L,
+        "mean_virtual_secs": vt_total_us / L / 1e6,
     }
-    if violated.any():
-        # earliest first-violation step over violating lanes: the triage
-        # shrinker's run-to-step truncation anchor
-        vs = np.asarray(state.violation_step)
-        out["first_violation_step"] = int(vs[violated].min())
+    if out["violations"]:
+        out["first_violation_step"] = int(red["first_violation_step"])
     # per-fault-kind chaos fire counts (the coverage report's raw data)
-    fires = np.asarray(state.fires)
+    f_hi, f_lo = red["fires64"]
+    f_hi, f_lo = np.asarray(f_hi, np.int64), np.asarray(f_lo, np.int64)
     for i, name in enumerate(FIRE_KINDS):
-        out[f"fires_{name}"] = int(fires[:, i].sum())
+        out[f"fires_{name}"] = int(f_hi[i] * 65536 + f_lo[i])
     # per-occurrence fire counts (nemesis schedule clauses only): lanes in
     # which occurrence k of the clause applied — coverage_report renders
     # these next to the clause totals, and chunked run_batch sums them
     if state.occ_fired is not None:
-        occ = np.asarray(state.occ_fired, np.uint32)
+        occ_counts = np.asarray(red["occ_counts"])
         for row, clause in enumerate(OCC_CLAUSES):
-            col = occ[:, row]
             for k in range(32):
-                n = int(((col >> np.uint32(k)) & 1).sum())
+                n = int(occ_counts[row, k])
                 if n:
                     out[f"occfires_{clause}_k{k}"] = n
     if state.cov is not None:
-        from ..explore import popcount_rows
-
-        bm = np.asarray(state.cov.bitmap, np.uint32)
-        union = np.bitwise_or.reduce(bm, axis=0)
-        out["coverage_bits"] = int(popcount_rows(union))
-        out["coverage_hiwater"] = int(np.asarray(state.cov.hiwater).max())
-        out["coverage_transitions"] = int(
-            np.asarray(state.cov.transitions).sum()
-        )
+        out["coverage_bits"] = int(red["cov_union_bits"])
+        out["coverage_hiwater"] = int(red["cov_hiwater"])
+        out["coverage_transitions"] = _join64(*red["cov_transitions64"])
     if spec is not None and spec.lane_metrics is not None:
         for name, arr in spec.lane_metrics(state.node).items():
             a = np.asarray(arr)

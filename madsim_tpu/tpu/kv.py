@@ -676,6 +676,31 @@ def make_kv_spec(
             "last_hb", "claim_t", "pend_tinv", "pend_t", "creq_t",
             "h_tinv", "h_trsp", "wm_t", "la_tinv", "la_trsp",
         ),
+        # r8 carry compaction (docs/state_layout.md). Bounds: role is a
+        # 3-state enum; *_kind ops are {0, OP_READ, OP_WRITE}; acks are
+        # N-bit quorum masks; keys index [0, K); recover_left counts keys
+        # still to re-commit (<= K); pend_recover is a bool flag. epoch is
+        # HARD-bounded by the REV_STRIDE overflow analysis above (epoch *
+        # REV_STRIDE must stay under 2^31 => epoch < 65536 = exactly u16).
+        # wcount/revisions/values stay i32: wcount is only soft-bounded
+        # (rev_stride_pressure_lanes warns, nothing caps it) and values
+        # encode nid * 100_000 + ccount. The big h_* history rings narrow
+        # where their vocab does (h_kind, h_key).
+        narrow_fields={
+            "role": jnp.uint8,
+            "pend_kind": jnp.uint8,
+            "creq_kind": jnp.uint8,
+            "h_kind": jnp.uint8,
+            "pend_recover": jnp.uint8,
+            "epoch": jnp.uint16,
+            **({"claim_acks": jnp.uint8, "pend_acks": jnp.uint8}
+               if N <= 8 else
+               {"claim_acks": jnp.uint16, "pend_acks": jnp.uint16}
+               if N <= 16 else {}),
+            **({"pend_key": jnp.uint8, "creq_key": jnp.uint8,
+                "h_key": jnp.uint8, "recover_left": jnp.uint8}
+               if K <= 255 else {}),
+        },
     )
 
 

@@ -300,6 +300,19 @@ def make_paxos_spec(
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
         msg_kind_names=("PREPARE", "PROMISE", "ACCEPT", "ACCEPTED", "DECIDED"),
+        # r8 carry compaction (docs/state_layout.md): only the provably
+        # bounded fields narrow. prop_phase is a 3-state enum; acks an
+        # N-bit quorum mask. Ballots/round stay i32 on purpose: the retry
+        # timer draw is randint(0, retry_hi) with NO lower bound, so a
+        # pathological lane can mint rounds every step and no u16/i16
+        # ballot bound survives an adversarial horizon (contrast raft,
+        # whose election_lo_us floor makes u16 terms safe). Values stay
+        # i32: prop_val encodes nid * 100_000 + round.
+        narrow_fields={
+            "prop_phase": jnp.uint8,
+            **({"acks": jnp.uint8} if N <= 8 else
+               {"acks": jnp.uint16} if N <= 16 else {}),
+        },
     ))
 
 

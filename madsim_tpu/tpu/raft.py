@@ -667,6 +667,35 @@ def make_raft_spec(
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
         msg_kind_names=("REQUEST_VOTE", "VOTE_RESP", "APPEND", "APPEND_RESP", "SNAP"),
+        # r8 carry compaction (docs/state_layout.md): bounded fields are
+        # STORED narrow and widened to i32 before every handler call, so
+        # the handler bodies above never see these dtypes. Bounds:
+        #   role 0..2, reply_parity 0|1, voted_for -1..N-1 (signed!),
+        #   votes = N-bit mask (N <= 8 on this spec family fits u8);
+        #   term/base_term/log_term: u16, safe up to narrow_horizon_us
+        #   below (the engine enforces it). Unbounded counters (log
+        #   indices, commit, next_cmd, chain hashes) stay wide.
+        narrow_fields={
+            "role": jnp.uint8,
+            "reply_parity": jnp.uint8,
+            "voted_for": jnp.int8,
+            **({"votes": jnp.uint8} if N <= 8 else
+               {"votes": jnp.uint16} if N <= 16 else {}),
+            "term": jnp.uint16,
+            "base_term": jnp.uint16,
+            "log_term": jnp.uint16,
+        },
+        # the u16 term bound is a RATE argument, so it only holds up to
+        # this horizon — the engine refuses longer-soak configs rather
+        # than wrap terms. The rate: each NODE self-increments at most
+        # once per election_lo (every election deadline, including the
+        # restart path, draws >= election_lo), but nodes ADOPT the global
+        # max term before bumping, so under sustained churn the global
+        # max can ratchet up to N times per election_lo window — hence
+        # the / N (default N=5: 65535 * 150 ms / 5 ~ 33 nonstop virtual
+        # minutes; the engine further derates for clock skew, which can
+        # shrink timer floors by up to max_ppm * 1e-6)
+        narrow_horizon_us=65_535 * election_lo_us // N,
     )
 
 

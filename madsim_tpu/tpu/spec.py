@@ -234,6 +234,26 @@ class ProtocolSpec:
     # arithmetic stays valid across unbounded virtual time. Fields never
     # compared against `now` (counters, revisions, ids) must NOT be listed.
     time_fields: tuple = ()
+    # OPTIONAL storage narrowing (r8 carry compaction, docs/state_layout.md):
+    # {field name -> narrow jnp dtype} for i32 node-state fields whose value
+    # range provably fits the narrow type (roles, vote bitmasks, bounded
+    # terms/ballots, small enums). The ENGINE owns the cast: declared
+    # fields are stored narrow in the carry — the dominant per-step HBM
+    # traffic — and widened back to i32 before every handler call, so
+    # handler arithmetic never sees the narrow dtype. Rules: a field that
+    # can go negative MUST use a signed narrow dtype (u8-casting a -1
+    # corrupts it), and time_fields may never be narrowed. Narrowing is
+    # value-preserving by construction — tests/test_state_layout.py pins
+    # that a spec with narrow_fields stripped runs bit-identically.
+    narrow_fields: Any = None
+    # OPTIONAL narrowing horizon cap (us). Some narrow bounds are RATE
+    # arguments ("one tid per txn_gap/2", "one term per election_lo")
+    # that only hold up to a horizon. A spec whose table leans on such a
+    # bound declares the safe horizon from its own parameters; BatchedSim
+    # refuses a config whose horizon_us exceeds it (strip narrow_fields
+    # or shorten the horizon) instead of letting a legal long-soak config
+    # silently wrap a narrow counter. None = table is horizon-independent.
+    narrow_horizon_us: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -399,6 +399,34 @@ def make_twopc_spec(
         check_invariants=check_invariants,
         lane_metrics=lane_metrics,
         msg_kind_names=("PREPARE", "VOTE", "OUTCOME", "DREQ"),
+        # r8 carry compaction (docs/state_layout.md). vote_mask is an
+        # N-bit yes-voter mask; o_val/v_val hold {NONE, COMMIT, ABORT}.
+        # tids (tid_cur and both rings, -1 = empty => SIGNED narrow) are
+        # i16, safe up to narrow_horizon_us below (the engine enforces
+        # it). decided stays i32 (diagnostics counter, same growth but no
+        # need to shave 4 bytes at the cost of a latent bound).
+        narrow_fields={
+            **({"vote_mask": jnp.uint8} if N <= 8 else
+               {"vote_mask": jnp.uint16} if N <= 16 else {}),
+            "o_val": jnp.uint8,
+            "v_val": jnp.uint8,
+            "tid_cur": jnp.int16,
+            "o_tid": jnp.int16,
+            "v_tid": jnp.int16,
+        },
+        # the i16 tid bound is a RATE argument, so it only holds up to
+        # this horizon — the engine refuses a longer soak rather than
+        # wrap tids into the -1-sentinel range. The rate: a mint needs a
+        # coordinator TIMER fire, and every coordinator re-arm in this
+        # spec — init, post-start (txn_gap/2), presumed-abort retry and
+        # the crash-RESTART path (both 1_000 us) — draws >= 1_000 us, so
+        # even restart-storm chaos cannot mint faster than 1/ms: 32767
+        # mints ~ 32.7 nonstop virtual seconds (the engine further
+        # derates for clock skew, which shrinks timer floors by up to
+        # max_ppm * 1e-6). The cadence-argument bound (one per
+        # txn_gap/2 ~ 10.9 min) holds for calm configs but NOT under
+        # aggressive crash plans, so the guard uses the hard floor.
+        narrow_horizon_us=32_767 * 1_000,
     )
 
 

@@ -152,9 +152,8 @@ def test_raft_fault_plan_chaos_stream_agrees_host_vs_tpu():
     import jax.numpy as jnp
 
     st = sim.init(jnp.asarray([SEED], jnp.uint32))
-    dev_ppm = np.round(
-        (np.asarray(st.nem.skew)[0] - 1.0) * 1e6
-    ).astype(int).tolist()
+    # r8: the device stores integer ppm directly (no f32 rate round-trip)
+    dev_ppm = np.asarray(st.nem.skew_ppm)[0].astype(int).tolist()
     assert dev_ppm == plan.skew_ppm(SEED, N)
     del dataclasses
 

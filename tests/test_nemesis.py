@@ -401,6 +401,69 @@ def test_clock_skew_perturbs_trajectories_but_stays_safe():
     assert (ev_a != ev_b).any(), "10% clock skew changed nothing"
 
 
+def test_skew_integer_ppm_exact_long_horizon():
+    """The r8 precision fix (ISSUE 6): timer skew is exact integer ppm
+    math for EVERY i32 microsecond delay. The r1-r7 path cast through
+    `float32 * rate`, whose 24-bit mantissa quantizes delays above
+    2^24 us (~16.7 virtual seconds) to multiples of 2, 4, 8... — a
+    20-minute soak timer lost up to ~64 us per arming, silently, per
+    node. scale_delay_ppm must agree with arbitrary-precision Python int
+    truncation everywhere; the old formula provably does NOT."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu.engine import scale_delay_ppm
+
+    rng = np.random.default_rng(8)
+    # the long-horizon band is the regression: delays well past 2^24 us,
+    # up to the i32 ceiling, plus the boundary and small-delay bands
+    delays = np.concatenate([
+        rng.integers(0, 1 << 24, 200),
+        np.asarray([(1 << 24) - 1, 1 << 24, (1 << 24) + 1]),
+        rng.integers(1 << 24, 2**31 - 1, 400),
+        np.asarray([2**31 - 1, 0, 1]),
+    ]).astype(np.int64)
+    ppms = np.concatenate([
+        rng.integers(-999_999, 1_000_000, 20),
+        np.asarray([0, 1, -1, 999_999, -999_999, 250_000]),
+    ]).astype(np.int64)
+
+    def exact(d, ppm):  # arbitrary-precision ground truth
+        adj = int(d) * abs(int(ppm)) // 1_000_000
+        return int(d) + adj if ppm >= 0 else int(d) - adj
+
+    for ppm in ppms:
+        # guard: the adjusted delay must stay in i32 for the comparison
+        ds = delays[np.asarray(
+            [abs(exact(d, ppm)) < 2**31 for d in delays]
+        )]
+        got = np.asarray(
+            scale_delay_ppm(jnp.asarray(ds, jnp.int32), jnp.int32(ppm)),
+            np.int64,
+        )
+        want = np.asarray([exact(d, ppm) for d in ds], np.int64)
+        np.testing.assert_array_equal(got, want, err_msg=f"ppm={ppm}")
+    # the host mirror (core/vtime.skew_delay_ns) applies the same
+    # truncation RULE in Python ints (the `exact` expression), but at ns
+    # granularity vs the device's us — a given delay's stretch can still
+    # differ by up to 1 us between faces, so this is a shared-spec
+    # exactness guarantee, NOT cross-face timer bit-equality (the twin
+    # suite compares skew assignments, never event times).
+
+    # ...and the OLD f32 path fails this long-horizon band: above 2^24 us
+    # the float mantissa cannot represent every integer microsecond
+    big = np.arange((1 << 25), (1 << 25) + 64, dtype=np.int64)
+    ppm = 1
+    old = (big.astype(np.float32) * np.float32(1.0 + ppm * 1e-6)).astype(
+        np.int64
+    )
+    new = np.asarray([exact(d, ppm) for d in big], np.int64)
+    assert (old != new).any(), (
+        "the f32 skew path is suddenly exact above 2^24 us — if float64 "
+        "crept in, the device/host bit-identity argument changed; revisit"
+    )
+
+
 @pytest.mark.chaos
 def test_duplication_delivers_more_events_than_it_sends():
     """With a heavy dup rate, delivered-event counts must rise against the

@@ -1,0 +1,415 @@
+"""The r8 state-layout lint + golden trajectory digests (ISSUE 6).
+
+Three guarantees, each of which a future PR can silently break:
+
+1. **The layout table.** Every SimState leaf's dtype is DECLARED here; a
+   leaf that widens (u8 -> i32, packed u32 plane -> bool) fails the test
+   with the offending field named. This is the lint that keeps the carry
+   from re-inflating — the whole r8 seeds/s win is these bytes
+   (docs/state_layout.md).
+
+2. **Value preservation.** Bit-packing and dtype narrowing are storage
+   transforms only: packed planes round-trip exactly, and a spec run
+   with its `narrow_fields` stripped produces bit-identical trajectories.
+
+3. **Golden digests.** A canonical (layout-independent: everything
+   widened to i64, planes unpacked) digest of a 1500-step chaotic
+   trajectory is pinned for all five workloads. The SAME constants were
+   produced by the pre-compaction r7 engine — layout-version r8 changed
+   the bytes at rest, not one trajectory. Narrowing that legitimately
+   changes a digest must re-bless these constants with a layout-version
+   note here and in docs/state_layout.md. (The ONE intentional behavior
+   change of r8 — f32 clock-skew math -> exact integer ppm — is excluded
+   by construction: the digest plan carries no ClockSkew clause. Its
+   regression coverage lives in test_nemesis.py::test_skew_*.)
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import nemesis
+from madsim_tpu.tpu import nemesis as tpu_nemesis
+from madsim_tpu.tpu import bitpack
+from madsim_tpu.tpu.chain import make_chain_spec
+from madsim_tpu.tpu.engine import (
+    BatchedSim,
+    COLD_FIELDS,
+    ConstState,
+    merge_state,
+    split_state,
+    summarize,
+)
+from madsim_tpu.tpu.kv import make_kv_spec
+from madsim_tpu.tpu.paxos import make_paxos_spec
+from madsim_tpu.tpu.raft import make_raft_spec
+from madsim_tpu.tpu.spec import SimConfig
+from madsim_tpu.tpu.twopc import make_twopc_spec
+
+SPECS = {
+    "raft": make_raft_spec,
+    "paxos": make_paxos_spec,
+    "kv": make_kv_spec,
+    "twopc": make_twopc_spec,
+    "chain": make_chain_spec,
+}
+
+# ---------------------------------------------------------------- the table
+#
+# Declared dtype for every SimState leaf of the reference raft sim
+# (default spec, default config, L=4 lanes, N=5 nodes). Shapes are given
+# as (L, N)-relative so the table survives config tweaks; dtypes are
+# EXACT. A new engine field must be added here deliberately — the test
+# fails on any leaf the table does not cover.
+L, N = 4, 5
+LAYOUT = {
+    "clock": ("int32", (L,)),
+    "epoch": ("int32", (L,)),
+    "key": ("uint32", (L,)),
+    "key0": ("uint32", (L,)),
+    "done": ("bool", (L,)),
+    "violated": ("bool", (L,)),
+    "violation_at": ("int32", (L,)),
+    "violation_epoch": ("int32", (L,)),
+    "violation_step": ("int32", (L,)),
+    "deadlocked": ("bool", (L,)),
+    "steps": ("int32", (L,)),
+    "events": ("int32", (L,)),
+    "overflow": ("int32", (L,)),
+    "dead_drops": ("int32", (L,)),
+    "fires": ("int32", (L, 11)),
+    "occ_fired": None,
+    # bit-packed planes (bitpack.py): bool would cost 8x in the carry
+    "alive_p": ("uint32", (L, 1)),
+    "crashed": ("int32", (L,)),
+    "chaos_at": ("int32", (L,)),
+    "link_ok_p": ("uint32", (L, N, 1)),
+    "partitioned": ("bool", (L,)),
+    "part_at": ("int32", (L,)),
+    "timer": ("int32", (L, N)),
+    # raft node pytree — narrow per spec.narrow_fields (raft.py)
+    "node.term": ("uint16", (L, N)),
+    "node.voted_for": ("int8", (L, N)),
+    "node.role": ("uint8", (L, N)),
+    "node.votes": ("uint8", (L, N)),
+    "node.base": ("int32", (L, N)),
+    "node.head": ("int32", (L, N)),
+    "node.base_hash": ("int32", (L, N)),
+    "node.base_term": ("uint16", (L, N)),
+    "node.log_term": ("uint16", (L, N, 24)),
+    "node.log_cmd": ("int32", (L, N, 24)),
+    "node.log_chain": ("uint32", (L, N, 24)),
+    "node.log_len": ("int32", (L, N)),
+    "node.commit": ("int32", (L, N)),
+    "node.next_idx": ("int32", (L, N, N)),
+    "node.match_idx": ("int32", (L, N, N)),
+    "node.next_cmd": ("int32", (L, N)),
+    "node.reply_parity": ("uint8", (L, N)),
+    # message pool: packed validity, u8 kinds, i32 times/payload
+    "msgs.valid_p": ("uint32", (L, N, 2)),
+    "msgs.deliver": ("int32", (L, 50)),
+    "msgs.kind": ("uint8", (L, 50)),
+    "msgs.payload": ("int32", (L, 50, 6)),
+    "strag": None,
+    "nem": None,
+    "ctl": None,
+    "cov": None,
+}
+
+
+def _walk(prefix, obj, out):
+    if obj is None or not hasattr(obj, "_fields"):
+        out[prefix] = obj
+        return
+    for f in obj._fields:
+        _walk(f if not prefix else f"{prefix}.{f}", getattr(obj, f), out)
+
+
+def test_simstate_layout_table():
+    """Every leaf matches its declared dtype/shape; no undeclared leaves.
+
+    THE layout lint: silently widening any leaf (or un-packing a plane)
+    re-inflates the sweep carry and fails here by name.
+    """
+    sim = BatchedSim(make_raft_spec())
+    st = sim.init(jnp.arange(L, dtype=jnp.uint32))
+    leaves: dict = {}
+    _walk("", st, leaves)
+    undeclared = set(leaves) - set(LAYOUT)
+    assert not undeclared, (
+        f"SimState grew undeclared leaves {sorted(undeclared)} — declare "
+        "their dtype in LAYOUT (and justify it in docs/state_layout.md)"
+    )
+    missing = set(LAYOUT) - set(leaves)
+    assert not missing, f"declared leaves vanished: {sorted(missing)}"
+    for name, want in LAYOUT.items():
+        got = leaves[name]
+        if want is None:
+            assert got is None, f"{name}: expected None, got {got!r}"
+            continue
+        dt, shape = want
+        assert str(got.dtype) == dt, (
+            f"layout regression: {name} is {got.dtype}, declared {dt} — "
+            "if intentional, update LAYOUT + docs/state_layout.md"
+        )
+        assert tuple(got.shape) == shape, (
+            f"{name}: shape {tuple(got.shape)} != declared {shape}"
+        )
+
+
+def test_cold_const_split_partition():
+    """split_state/merge_state is a lossless partition of SimState: every
+    leaf lands in exactly one of hot/cold/const, and merge inverts it."""
+    sim = BatchedSim(make_raft_spec())
+    st = sim.init(jnp.arange(L, dtype=jnp.uint32))
+    hot, cold, const = split_state(st)
+    # hot nulls out everything cold/const carries
+    for f in COLD_FIELDS:
+        assert getattr(hot, f) is None, f"{f} leaked into the hot carry"
+    for f in ConstState._fields:
+        if f == "skew_ppm":
+            continue  # lives under nem, None without a skew clause
+        assert getattr(hot, f) is None, f"{f} leaked into the hot carry"
+    back = merge_state(hot, cold, const)
+    la, lb = jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(back)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------- bit packing
+
+
+@pytest.mark.parametrize("k", [1, 5, 31, 32, 33, 50, 64, 100])
+def test_pack_roundtrip(k):
+    rng = np.random.default_rng(k)
+    m = jnp.asarray(rng.random((7, 3, k)) < 0.5)
+    packed = bitpack.pack_bits(m)
+    assert packed.dtype == jnp.uint32
+    assert packed.shape == (7, 3, bitpack.packed_words(k))
+    out = bitpack.unpack_bits(packed, k)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(m))
+    # trailing pad bits stay zero (packed words compare equal iff planes do)
+    repacked = bitpack.pack_bits(out)
+    np.testing.assert_array_equal(np.asarray(repacked), np.asarray(packed))
+
+
+def test_full_mask_word():
+    for n in range(33):
+        w = bitpack.full_mask_word(n)
+        got = bitpack.unpack_bits(jnp.asarray([w], jnp.uint32), 32)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.arange(32) < n
+        )
+    with pytest.raises(ValueError):
+        bitpack.full_mask_word(33)
+
+
+# ------------------------------------------------- narrowing invariance
+
+CHAOS_PLAN = nemesis.FaultPlan(
+    name="layout",
+    clauses=(
+        nemesis.Crash(interval_lo_us=300_000, interval_hi_us=900_000,
+                      down_lo_us=200_000, down_hi_us=600_000),
+        nemesis.Partition(interval_lo_us=400_000, interval_hi_us=1_200_000,
+                          heal_lo_us=300_000, heal_hi_us=900_000),
+        nemesis.MsgLoss(rate=0.05),
+    ),
+)
+
+
+def _run_pair(spec, lanes=16, steps=1200):
+    """Run (narrow, wide-stripped) twins and return both final states."""
+    assert spec.narrow_fields, f"{spec.name}: narrow table missing"
+    cfg = tpu_nemesis.compile_plan(CHAOS_PLAN, SimConfig(horizon_us=30_000_000))
+    wide = dataclasses.replace(spec, narrow_fields=None)
+    seeds = jnp.arange(lanes, dtype=jnp.uint32)
+    simN, simW = BatchedSim(spec, cfg), BatchedSim(wide, cfg)
+    stN = simN.run(seeds, max_steps=steps, dispatch_steps=steps)
+    stW = simW.run(seeds, max_steps=steps, dispatch_steps=steps)
+    return simN, stN, stW
+
+
+def _assert_states_match(simN, stN, stW):
+    nodeN = simN._widen_node(stN.node)
+    for f, a, b in zip(
+        type(nodeN)._fields,
+        jax.tree_util.tree_leaves(nodeN),
+        jax.tree_util.tree_leaves(stW.node),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"node.{f} diverged"
+        )
+    for f in ("clock", "steps", "events", "violated", "done", "timer",
+              "crashed", "fires"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(stN, f)), np.asarray(getattr(stW, f)),
+            err_msg=f"{f} diverged",
+        )
+    np.testing.assert_array_equal(
+        np.asarray(stN.alive), np.asarray(stW.alive), err_msg="alive"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(stN.msgs.valid), np.asarray(stW.msgs.valid),
+        err_msg="msgs.valid",
+    )
+
+
+@pytest.mark.chaos
+def test_narrowing_invariance_raft():
+    _assert_states_match(*_run_pair(make_raft_spec()))
+
+
+@pytest.mark.chaos
+def test_narrowing_invariance_twopc():
+    _assert_states_match(*_run_pair(make_twopc_spec()))
+
+
+@pytest.mark.deep
+@pytest.mark.chaos
+@pytest.mark.parametrize("name", ["paxos", "kv", "chain"])
+def test_narrowing_invariance_rest(name):
+    _assert_states_match(*_run_pair(SPECS[name]()))
+
+
+def test_narrow_fields_validation():
+    """The engine rejects bad narrow tables loudly at construction."""
+    spec = make_raft_spec()
+    with pytest.raises(ValueError, match="time_fields"):
+        BatchedSim(dataclasses.replace(
+            spec,
+            time_fields=("term",),
+            narrow_fields={"term": jnp.uint16},
+        ))
+    with pytest.raises(ValueError, match="unknown node-state field"):
+        BatchedSim(dataclasses.replace(
+            spec, narrow_fields={"nonesuch": jnp.uint8}
+        )).init(jnp.arange(2, dtype=jnp.uint32))
+    with pytest.raises(ValueError, match="not narrower"):
+        BatchedSim(dataclasses.replace(
+            spec, narrow_fields={"commit": jnp.float32}
+        )).init(jnp.arange(2, dtype=jnp.uint32))
+
+
+def test_narrow_horizon_cap_enforced():
+    """Rate-argument narrow bounds (twopc's 1-tid-per-timer-floor i16,
+    raft's N-per-election_lo u16) only hold up to the spec-declared
+    horizon; a longer soak must be REFUSED, not allowed to wrap counters
+    silently — and clock skew (which shrinks timer floors) derates it."""
+    tp = make_twopc_spec()
+    assert tp.narrow_horizon_us is not None
+    # at the cap: fine
+    BatchedSim(tp, SimConfig(horizon_us=tp.narrow_horizon_us))
+    with pytest.raises(ValueError, match="safe horizon"):
+        BatchedSim(tp, SimConfig(horizon_us=tp.narrow_horizon_us + 1))
+    # stripping the table re-admits the long soak (wide i32 counters)
+    BatchedSim(
+        dataclasses.replace(tp, narrow_fields=None),
+        SimConfig(horizon_us=tp.narrow_horizon_us + 1),
+    )
+    rf = make_raft_spec()
+    with pytest.raises(ValueError, match="safe horizon"):
+        BatchedSim(rf, SimConfig(horizon_us=rf.narrow_horizon_us + 1))
+    # a 20% skew shrinks timer floors by up to 20% — a horizon at the
+    # unskewed cap must now be refused (derated cap), and one inside the
+    # derated cap accepted
+    skewed = SimConfig(
+        horizon_us=tp.narrow_horizon_us, nem_skew_max_ppm=200_000
+    )
+    with pytest.raises(ValueError, match="skew-derating|safe horizon"):
+        BatchedSim(tp, skewed)
+    BatchedSim(tp, dataclasses.replace(
+        skewed, horizon_us=tp.narrow_horizon_us * 8 // 10
+    ))
+
+
+def test_kind_dtype_follows_declared_vocabulary():
+    """Pool `kind` narrows to u8 only when the spec declares its kind
+    vocabulary (msg_kind_names, dense <= 256); undeclared specs might use
+    sparse values >= 256, which a blind u8 cast would silently wrap."""
+    named = BatchedSim(make_raft_spec())
+    st = named.init(jnp.arange(2, dtype=jnp.uint32))
+    assert st.msgs.kind.dtype == jnp.uint8
+    anon = BatchedSim(
+        dataclasses.replace(make_raft_spec(), msg_kind_names=None)
+    )
+    st2 = anon.init(jnp.arange(2, dtype=jnp.uint32))
+    assert st2.msgs.kind.dtype == jnp.int32
+
+
+def test_sum64_lane_bound_enforced():
+    """_sum64's u32 partials only stay exact for <= 65536 lanes; a bigger
+    axis must raise, not wrap."""
+    from madsim_tpu.tpu.engine import _sum64
+
+    _sum64(jnp.zeros((8,), jnp.int32))
+    with pytest.raises(ValueError, match="65536"):
+        _sum64(jnp.zeros((65537,), jnp.int32))
+
+
+# --------------------------------------------------------- golden digests
+#
+# Pinned canonical digests of a 1500-step, 16-lane chaotic trajectory.
+# Layout-version r8: these constants were produced IDENTICALLY by the
+# pre-compaction (r7, flat i32/bool) engine and the compacted engine —
+# verified on both trees before pinning. Changing any of them requires a
+# layout-version note here and in docs/state_layout.md.
+GOLDEN = {
+    "raft": "2a0e81ea9e273a54298b0bc11e44f377ef8861607ad320278695700bf0df861b",
+    "paxos": "b32a304d0682bcc183b4b3d1382816bb6187c74d8f145d082e0198dec44efa8b",
+    "kv": "2249bd64d3fd1aac94376125169167e7ae6f35fea51dfa06c0db38453ba58c9c",
+    "twopc": "38b8eae7cd3944363dcac58cda088791727370d2892a28c8b978ab80c57a1666",
+    "chain": "c6e860898bca578503460a96d3fdd9d9a21b7ea7b17313c0e4fd10ab785d1f86",
+}
+
+
+def canonical_digest(state) -> str:
+    """Layout-independent trajectory digest: every field widened to i64,
+    packed planes unpacked, narrow node leaves included as their VALUES
+    (so any value-corrupting narrowing changes the digest, but a pure
+    storage change cannot)."""
+    h = hashlib.sha256()
+    for name in ("clock", "epoch", "key", "done", "violated",
+                 "violation_step", "steps", "events", "overflow",
+                 "dead_drops", "crashed", "partitioned", "timer",
+                 "alive", "link_ok"):
+        h.update(np.ascontiguousarray(
+            np.asarray(getattr(state, name)).astype(np.int64)))
+    for leaf in jax.tree_util.tree_leaves(state.node):
+        h.update(np.ascontiguousarray(np.asarray(leaf).astype(np.int64)))
+    for part in (state.msgs.valid, state.msgs.deliver, state.msgs.kind,
+                 state.msgs.payload, state.fires):
+        h.update(np.ascontiguousarray(np.asarray(part).astype(np.int64)))
+    return h.hexdigest()
+
+
+def _golden_one(name):
+    cfg = tpu_nemesis.compile_plan(CHAOS_PLAN, SimConfig(horizon_us=30_000_000))
+    sim = BatchedSim(SPECS[name](), cfg)
+    st = sim.run(jnp.arange(16, dtype=jnp.uint32), max_steps=1500,
+                 dispatch_steps=1500)
+    assert canonical_digest(st) == GOLDEN[name], (
+        f"{name}: golden trajectory digest changed — if this narrowing/"
+        "layout change is intentional, re-bless with a layout-version "
+        "note (see module docstring)"
+    )
+    # the digest must describe a real run, not an idle one
+    assert summarize(st)["total_events"] > 0
+
+
+@pytest.mark.chaos
+def test_golden_digest_raft():
+    _golden_one("raft")
+
+
+@pytest.mark.deep
+@pytest.mark.chaos
+@pytest.mark.parametrize("name", ["paxos", "kv", "twopc", "chain"])
+def test_golden_digest_rest(name):
+    _golden_one(name)

@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all chaos-smoke triage-smoke explore-smoke real native bench bench-smoke compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all chaos-smoke triage-smoke explore-smoke campaign-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -21,6 +21,12 @@ triage-smoke:    ## tiny seeded shrink of a planted raft bug + bundle replay
 
 explore-smoke:   ## coverage-guided search smoke: monotone coverage + meta-seed determinism (CPU)
 	$(PY) -m pytest tests/test_explore.py -q -m "chaos and not slow"
+
+campaign-smoke:  ## mini campaign: kill -> resume fingerprint match, dedup, merge/cmin, regression replay
+	$(PY) -m pytest tests/test_campaign.py -q -m "chaos and not slow"
+
+regression:      ## replay the regression corpus of deduped bug bundles green
+	$(PY) -m madsim_tpu.campaign regress $(if $(REGRESSION_DIR),--dir $(REGRESSION_DIR),)
 
 test-all: test deep
 
@@ -44,6 +50,9 @@ ttfb:            ## time-to-first-bug: cold-runtime wall to violation + ReproBun
 
 explore-bench:   ## explorer vs uniform sweep: coverage/dispatch + first-bug dispatches on planted bugs
 	$(PY) benches/explore_bench.py
+
+campaign-bench:  ## campaign-layer overheads: checkpoint/resume wall, merge+cmin throughput (<60s, structural)
+	$(PY) benches/campaign_bench.py
 
 dryrun:          ## multi-chip sharding dry run on a virtual 8-device mesh
 	cd /tmp && $(PY) $(CURDIR)/__graft_entry__.py

@@ -1,0 +1,156 @@
+"""Campaign-layer overheads: what persistence costs, and what cmin saves.
+
+The campaign layer's pitch (docs/campaign.md) is that persistence is
+near-free on this backend because a corpus entry is just a genome and a
+checkpoint is an exact replayable cursor. This bench puts numbers on that:
+
+    checkpoint_write_s / resume_load_s   full checkpoint round-trip wall
+                                         (corpus + union + seen + manifest)
+    resume_fingerprint_ok                the STRUCTURAL claim: resume(k).
+                                         run(k') fingerprints identically
+                                         to the uninterrupted k+k' run
+    corpus_entries / corpus_bytes        what the checkpoint carries
+    cmin_candidates / cmin_kept          merged-corpus minimization: lanes
+    cmin_replay_s / cmin_dispatches      replayed (one batched program),
+                                         kept fraction, union preserved
+    slice_overhead_pct                   (checkpoint + resume) vs one
+                                         explorer generation's wall
+
+Structural on CPU containers like every r6+ bench: the assertions (not the
+wall numbers) are the contract — fingerprint match and union preservation
+are hard failures, wall-clock is reported, never asserted.
+
+Usage: python benches/campaign_bench.py [--lanes 64] [--generations 3]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+def _repo_root_on_path() -> None:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
+_repo_root_on_path()
+
+
+def storm_raft_workload(virtual_secs: float = 2.0):
+    """A clean (no planted bug) raft config under the storm plan: the
+    campaign machinery exercised end to end without shrink costs."""
+    from madsim_tpu.explore import _named_workload
+
+    return _named_workload("raft", virtual_secs, True)
+
+
+def bench_campaign(
+    lanes: int = 64, generations: int = 3, virtual_secs: float = 2.0,
+) -> dict:
+    import numpy as np
+
+    from madsim_tpu import campaign
+    from madsim_tpu.explore import popcount_rows
+    from madsim_tpu.tpu.engine import BatchedSim
+
+    wl = storm_raft_workload(virtual_secs)
+    sim = BatchedSim(wl.spec, wl.config, triage=True, coverage=True)
+    root = tempfile.mkdtemp(prefix="campaign_bench_")
+    out: dict = {"lanes": lanes, "generations": generations}
+    try:
+        # -- uninterrupted reference + per-generation wall --------------
+        t0 = time.perf_counter()
+        full = campaign.Campaign(
+            wl, os.path.join(root, "full"), meta_seed=0, lanes=lanes,
+            shrink=False, sim=sim,
+        )
+        rep_full = full.run(generations)
+        gen_wall_s = (time.perf_counter() - t0) / max(generations, 1)
+        out["generation_wall_s"] = round(gen_wall_s, 3)
+        out["coverage_bits"] = rep_full.coverage_bits
+        out["corpus_entries"] = rep_full.corpus_size
+
+        # -- checkpoint write / resume load -----------------------------
+        part = campaign.Campaign(
+            wl, os.path.join(root, "part"), meta_seed=0, lanes=lanes,
+            shrink=False, sim=sim,
+        )
+        part.run(max(generations - 1, 1))
+        t0 = time.perf_counter()
+        part.checkpoint()
+        out["checkpoint_write_s"] = round(time.perf_counter() - t0, 4)
+        out["corpus_bytes"] = sum(
+            os.path.getsize(os.path.join(root, "part", f))
+            for f in os.listdir(os.path.join(root, "part"))
+            if os.path.isfile(os.path.join(root, "part", f))
+        )
+        t0 = time.perf_counter()
+        resumed = campaign.Campaign.resume(
+            os.path.join(root, "part"), workload=wl, sim=sim
+        )
+        out["resume_load_s"] = round(time.perf_counter() - t0, 4)
+        rep_res = resumed.run(
+            generations - max(generations - 1, 1)
+        ) if generations > 1 else resumed.report()
+        ok = rep_res.fingerprint() == rep_full.fingerprint()
+        out["resume_fingerprint_ok"] = ok
+        assert ok, "resume diverged from the uninterrupted run"
+        out["slice_overhead_pct"] = round(
+            100 * (out["checkpoint_write_s"] + out["resume_load_s"])
+            / max(gen_wall_s, 1e-9), 2,
+        )
+
+        # -- merge + cmin -----------------------------------------------
+        campaign.export_explorer(
+            os.path.join(root, "a"), full.ex, {"kind": "custom"}
+        )
+        campaign.export_explorer(
+            os.path.join(root, "b"), resumed.ex, {"kind": "custom"}
+        )
+        entries, _ = campaign.merge_corpora(
+            [os.path.join(root, "a"), os.path.join(root, "b")]
+        )
+        t0 = time.perf_counter()
+        res = campaign.minimize(
+            wl, entries, sim=sim, lane_width=min(lanes, 64)
+        )
+        out["cmin_replay_s"] = round(time.perf_counter() - t0, 3)
+        out["cmin_candidates"] = res["replayed"]
+        out["cmin_kept"] = len(res["kept"])
+        out["cmin_dispatches"] = res["dispatches"]
+        out["cmin_union_bits"] = res["merged_bits"]
+        # the union-preservation assertion already ran inside minimize();
+        # re-assert here so the bench is a standalone witness
+        union = np.zeros_like(res["union"])
+        for e in res["kept"]:
+            union |= e.bitmap
+        assert int(popcount_rows(union[None, :])[0]) == res["merged_bits"]
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lanes", type=int, default=64)
+    parser.add_argument("--generations", type=int, default=3)
+    parser.add_argument("--virtual-secs", type=float, default=2.0)
+    args = parser.parse_args()
+    print(
+        json.dumps(bench_campaign(
+            args.lanes, args.generations, args.virtual_secs
+        )),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1482 @@
+"""Campaign mode: persistent corpus, bug dedup, and a fuzz-service front end.
+
+The explorer (madsim_tpu/explore.py) made search coverage-guided, but it
+lives one process at a time: the corpus, the coverage union and every found
+violation evaporate on exit. Production fuzz farms (ClusterFuzz/OSS-Fuzz)
+are *campaigns*: long-running, resumable, corpus-persistent, with bugs
+deduplicated by behavior class instead of raw input. The DST determinism
+this repo reproduces makes campaigns cheap to do right — a corpus entry is
+just `(seed, ctl genome)`, replayable bit-identically forever, so:
+
+  * **Checkpoints are exact.** `Explorer.snapshot()` captures the whole
+    search state (MetaRng counter cursor, fresh-seed cursor, union bitmap,
+    corpus with bitmaps, seen-genome set, violations); kill → resume
+    reproduces the uninterrupted run's `ExploreReport.fingerprint()` to
+    the bit, in-process or cross-process.
+  * **Corpus merge + minimization is one batched dispatch.** AFL's `cmin`
+    over our lanes: replay every candidate of the merged corpora with
+    `coverage=True` (chunked lanes of one compiled program), then greedily
+    keep the minimal lane set whose bitmap union equals the merged union —
+    asserted by popcount AND exact array equality, here and in the tests.
+  * **Bugs dedup by signature, not seed.** A seed-dense bug class (the
+    planted raft re-stamp surfaces dozens of violating seeds per dispatch)
+    collapses to ONE `BugRecord` with N witness seeds; the first witness
+    per candidate-shape group is ddmin-shrunk and its minimal clause
+    profile keys the record (see `bug_signature`). Records feed a
+    regression corpus of ReproBundles replayed green by `make regression`.
+  * **The service loop is the fuzz-farm front end.**
+    `python -m madsim_tpu.campaign serve --dir D` accepts queued workload
+    requests (JSON files dropped in `D/queue/` — no new deps), time-slices
+    the device between campaigns round-robin, streams one ExploreReport
+    JSON line per slice, and checkpoints between slices, so a kill at any
+    slice boundary resumes exactly.
+
+On-disk format: docs/campaign.md.  CLI:
+
+    python -m madsim_tpu.campaign run --workload raft --storm --generations 8 --dir D
+    python -m madsim_tpu.campaign merge --out MERGED D1 D2 ...
+    python -m madsim_tpu.campaign regress [--dir D]
+    python -m madsim_tpu.campaign serve --dir D
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import hashlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .explore import (
+    Candidate,
+    CorpusEntry,
+    Explorer,
+    ExploreReport,
+    canon_genome,
+    ctl_for,
+    popcount_rows,
+)
+
+CAMPAIGN_FORMAT = "madsim-tpu-campaign/1"
+
+MANIFEST = "manifest.json"
+CORPUS = "corpus.jsonl"
+SEEN = "seen.jsonl"
+VIOLATIONS = "violations.jsonl"
+BUGS = "bugs.jsonl"
+REPORT = "report.json"
+REPORTS_STREAM = "reports.jsonl"
+BUNDLE_DIR = "bundles"
+REGRESSION_DIR = "regression"
+
+
+# --------------------------------------------------------------------------
+# small file plumbing (atomic writes: a kill mid-checkpoint must leave the
+# previous checkpoint readable, which is the whole point of checkpoints)
+# --------------------------------------------------------------------------
+
+
+def _write_text(path: str, text: str) -> str:
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def _write_json(path: str, doc: Any) -> str:
+    return _write_text(path, json.dumps(doc, indent=2, sort_keys=True) + "\n")
+
+
+def _jsonl(text: str) -> List[Any]:
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def _read_jsonl(path: str) -> List[Any]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return _jsonl(f.read())
+
+
+# --------------------------------------------------------------------------
+# workload references — how a manifest names the thing it fuzzes
+# --------------------------------------------------------------------------
+
+
+def build_workload(ref: Dict[str, Any]):
+    """Rebuild a BatchWorkload from a manifest's workload reference.
+
+    Only `kind: "named"` refs (the CLI/service vocabulary) are
+    constructible here; a campaign over a custom in-code workload writes
+    `kind: "custom"` and must be resumed with `Campaign.resume(dir,
+    workload=...)` — the config hash check still guards the match."""
+    if ref.get("kind") != "named":
+        raise ValueError(
+            "manifest workload is not CLI-constructible "
+            f"({ref.get('kind')!r}); pass workload= to Campaign.resume"
+        )
+    from .explore import _named_workload
+
+    try:
+        return _named_workload(
+            str(ref["name"]), float(ref.get("virtual_secs", 2.0)),
+            bool(ref.get("storm", False)),
+        )
+    except SystemExit as e:
+        # _named_workload speaks CLI (SystemExit on unknown names); as a
+        # library error that MUST be catchable — the service's per-request
+        # guard catches Exception, and SystemExit would kill the loop
+        raise ValueError(str(e)) from None
+
+
+def spec_for(name: str, virtual_secs: float = 2.0):
+    """ProtocolSpec factory for named workloads — the `spec_ref` target
+    baked into campaign bundles ("madsim_tpu.campaign:spec_for"), so
+    `python -m madsim_tpu.repro bundle.json` works from any process."""
+    from .explore import _named_workload
+
+    return _named_workload(name, virtual_secs, False).spec
+
+
+def named_workload_ref(
+    name: str, virtual_secs: float, storm: bool,
+) -> Dict[str, Any]:
+    return {
+        "kind": "named", "name": name,
+        "virtual_secs": float(virtual_secs), "storm": bool(storm),
+    }
+
+
+# --------------------------------------------------------------------------
+# bug signatures — the dedup key
+# --------------------------------------------------------------------------
+
+
+def clause_profile(kept_atoms: Sequence[Tuple[str, Optional[int]]]) -> List[list]:
+    """The SHAPE of a shrunk minimal fault plan: per clause, how many
+    occurrence atoms survived ddmin (-1 = the whole-clause atom survived —
+    the >31-occurrence fallback or a message-level clause). Occurrence
+    *indices* are deliberately dropped: which crash window triggers a bug
+    varies seed to seed, but the minimal plan's shape (e.g. "exactly one
+    partition occurrence") is the bug class's stable behavioral core."""
+    prof: Dict[str, int] = {}
+    for name, k in kept_atoms:
+        if k is None:
+            prof[name] = -1
+        elif prof.get(name) != -1:
+            prof[name] = prof.get(name, 0) + 1
+    return [[n, c] for n, c in sorted(prof.items())]
+
+
+def bug_signature(
+    spec_name: str,
+    violation_kind: str,
+    kept_atoms: Sequence[Tuple[str, Optional[int]]],
+) -> str:
+    """The stable dedup key of a bug class: sha256 over (workload spec,
+    violation kind, shrunk-plan clause profile).
+
+    Design note (docs/campaign.md#dedup): the raw coverage-bitmap digest
+    of a violating lane is seed-unique — two witnesses of the SAME bug
+    take different trajectories — so keying on it would make dedup a
+    no-op. The signature keys on the shrunk minimal plan's clause profile
+    instead (the behavior class ddmin distills), and each witness records
+    its exact `cov_digest` as per-seed evidence on the BugRecord."""
+    payload = {
+        "spec": str(spec_name),
+        "kind": str(violation_kind),
+        "clauses": clause_profile(kept_atoms),
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+def coarse_key(spec_name: str, violation_kind: str, genome) -> str:
+    """Pre-shrink grouping key: (spec, kind, candidate ctl genome minus
+    the seed). Every fresh-seed violation of one workload shares it, so a
+    seed-dense bug pays ONE shrink and every further seed attaches as a
+    witness; distinct ctl shapes (mutants/swarm) form their own groups and
+    merge post-shrink when their signatures coincide."""
+    _, off, occ, rs, h = canon_genome(genome)
+    payload = {
+        "spec": str(spec_name), "kind": str(violation_kind),
+        "ctl": [off, list(occ), list(rs), h],
+    }
+    return "coarse-" + hashlib.sha256(
+        json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+@dataclasses.dataclass
+class BugRecord:
+    """One deduplicated bug class: the signature that keys it, the shrunk
+    repro of its first witness, and every witness seed since."""
+
+    signature: str
+    spec_name: str
+    violation_kind: str
+    clause_profile: List[list]
+    witnesses: List[Dict[str, Any]]  # {seed, candidate, dispatch, origin, cov_digest}
+    bundle_path: Optional[str]
+    campaign: str
+    first_generation: int
+    coarse_keys: List[str]
+    shrink_error: Optional[str] = None
+
+    @property
+    def witness_seeds(self) -> List[int]:
+        return [int(w["seed"]) for w in self.witnesses]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(doc: Dict[str, Any]) -> "BugRecord":
+        fields = {f.name for f in dataclasses.fields(BugRecord)}
+        unknown = set(doc) - fields
+        if unknown:
+            raise ValueError(f"unknown BugRecord fields: {sorted(unknown)}")
+        return BugRecord(**{k: doc[k] for k in fields if k in doc})
+
+
+# --------------------------------------------------------------------------
+# checkpoint save/load
+# --------------------------------------------------------------------------
+
+
+_SIDECAR_KEYS = ("corpus", "seen", "violations", "bugs", "report")
+
+
+def _sidecar_names(gen_tag: str) -> Dict[str, str]:
+    """Generation-stamped sidecar file names: two checkpoints never share
+    a file, so the manifest replace below is a true commit point."""
+    return {
+        "corpus": f"corpus.{gen_tag}.jsonl",
+        "seen": f"seen.{gen_tag}.jsonl",
+        "violations": f"violations.{gen_tag}.jsonl",
+        "bugs": f"bugs.{gen_tag}.jsonl",
+        "report": f"report.{gen_tag}.json",
+    }
+
+
+def _sha256(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def save_checkpoint(
+    dir: str,
+    snapshot: Dict[str, Any],
+    manifest_extra: Dict[str, Any],
+    bugs: Sequence[BugRecord] = (),
+    report: Optional[ExploreReport] = None,
+) -> str:
+    """Write one campaign checkpoint with a whole-checkpoint commit point.
+
+    Sidecar files (corpus/seen/violations/bugs/report) are written first
+    under NEW generation-stamped names with their sha256 recorded; the
+    manifest — which names the exact files and digests — is replaced
+    LAST, atomically. A kill anywhere mid-checkpoint therefore leaves the
+    previous manifest pointing at the previous (untouched) sidecars: no
+    torn mix of generation-N cursors with generation-N-1 corpus can ever
+    load. Sidecars no manifest references are garbage-collected only
+    AFTER the new manifest commits."""
+    os.makedirs(dir, exist_ok=True)
+    texts = {
+        "corpus": "".join(
+            json.dumps(d, sort_keys=True) + "\n"
+            for d in snapshot.get("corpus", [])
+        ),
+        "seen": "".join(
+            json.dumps({"genome": g}, sort_keys=True) + "\n"
+            for g in snapshot.get("seen", [])
+        ),
+        "violations": "".join(
+            json.dumps(d, sort_keys=True) + "\n"
+            for d in snapshot.get("violations", [])
+        ),
+        "bugs": "".join(
+            json.dumps(b.to_dict(), sort_keys=True) + "\n" for b in bugs
+        ),
+    }
+    if report is not None:
+        texts["report"] = json.dumps(
+            report.to_dict(), indent=2, sort_keys=True
+        ) + "\n"
+    # the tag is generation PLUS a content digest: a re-checkpoint at the
+    # same generation but different content (e.g. bugs absorbed without a
+    # new explorer generation) writes FRESH names instead of rewriting
+    # files the committed manifest still references — identical content
+    # rewrites identical bytes, so the commit-point guarantee holds in
+    # every kill window
+    blob = hashlib.sha256()
+    for key in sorted(texts):
+        blob.update(key.encode())
+        blob.update(texts[key].encode())
+    gen_tag = f"{int(snapshot.get('generation', 0))}-{blob.hexdigest()[:8]}"
+    names = _sidecar_names(gen_tag)
+    files: Dict[str, str] = {}
+    digests: Dict[str, str] = {}
+    for key, text in texts.items():
+        _write_text(os.path.join(dir, names[key]), text)
+        files[key] = names[key]
+        digests[key] = _sha256(text)
+    manifest = {
+        "format": CAMPAIGN_FORMAT,
+        "files": files,
+        "file_sha256": digests,
+        "state": {
+            k: v for k, v in snapshot.items()
+            if k not in ("corpus", "seen", "violations")
+        },
+        **manifest_extra,
+    }
+    _write_json(os.path.join(dir, MANIFEST), manifest)  # the commit point
+    _gc_stale_sidecars(dir, keep=set(files.values()))
+    return dir
+
+
+def _gc_stale_sidecars(dir: str, keep: set) -> None:
+    for key in _SIDECAR_KEYS:
+        for path in glob.glob(os.path.join(dir, f"{key}.*.json*")):
+            if os.path.basename(path) not in keep:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass  # best-effort: a stale file is dead weight, not harm
+
+
+def _read_sidecar(dir: str, manifest: Dict[str, Any], key: str,
+                  legacy_name: str) -> str:
+    """Read one manifest-named sidecar, verifying its digest — a torn,
+    partially-copied or hand-edited checkpoint must fail LOUDLY, never
+    resume divergently."""
+    files = manifest.get("files") or {}
+    name = files.get(key, legacy_name)
+    path = os.path.join(dir, name)
+    if not os.path.exists(path):
+        if key in files:
+            # the manifest committed this file: its absence means a
+            # partial copy or external deletion, not "nothing to load"
+            raise AssertionError(
+                f"checkpoint file {name} referenced by the manifest is "
+                "missing — partial copy or torn checkpoint"
+            )
+        return ""
+    with open(path) as f:
+        text = f.read()
+    want = (manifest.get("file_sha256") or {}).get(key)
+    if want and _sha256(text) != want:
+        raise AssertionError(
+            f"checkpoint file {name} does not match its manifest digest — "
+            "torn or corrupt checkpoint"
+        )
+    return text
+
+
+def load_checkpoint(dir: str) -> Dict[str, Any]:
+    """Load a checkpoint directory back into {manifest, snapshot, bugs},
+    verifying every sidecar against the manifest's digests."""
+    with open(os.path.join(dir, MANIFEST)) as f:
+        manifest = json.load(f)
+    fmt = manifest.get("format", "")
+    if fmt != CAMPAIGN_FORMAT:
+        raise ValueError(
+            f"unsupported campaign format {fmt!r} (want {CAMPAIGN_FORMAT!r})"
+        )
+    snapshot = dict(manifest.get("state", {}))
+    snapshot["corpus"] = _jsonl(_read_sidecar(dir, manifest, "corpus", CORPUS))
+    snapshot["seen"] = [
+        d["genome"] for d in _jsonl(_read_sidecar(dir, manifest, "seen", SEEN))
+    ]
+    snapshot["violations"] = _jsonl(
+        _read_sidecar(dir, manifest, "violations", VIOLATIONS)
+    )
+    bugs = [
+        BugRecord.from_dict(d)
+        for d in _jsonl(_read_sidecar(dir, manifest, "bugs", BUGS))
+    ]
+    return {"manifest": manifest, "snapshot": snapshot, "bugs": bugs}
+
+
+def export_explorer(
+    dir: str,
+    ex: Explorer,
+    workload_ref: Optional[Dict[str, Any]] = None,
+    campaign_id: Optional[str] = None,
+) -> str:
+    """Write a bare Explorer's state as a campaign checkpoint (the explore
+    CLI's `--out`): the one-shot run becomes a resumable, merge-importable
+    artifact. `seen_violations` is left at 0, so a later
+    `Campaign.resume(dir).run(k)` dedups the recorded violations into
+    BugRecords on its first slice."""
+    report = ex.report()
+    extra = {
+        "campaign_id": campaign_id or default_campaign_id(ex),
+        "workload": workload_ref or {"kind": "custom"},
+        "config_hash": ex.cfg.hash(),
+        "spec_name": ex.workload.spec.name,
+        "params": explorer_params(ex),
+        "seen_violations": 0,
+        "kind": "campaign",
+    }
+    return save_checkpoint(dir, ex.snapshot(), extra, bugs=(), report=report)
+
+
+def explorer_params(ex: Explorer) -> Dict[str, Any]:
+    """The Explorer constructor parameters a resume must replay (the
+    snapshot carries state; these carry configuration)."""
+    return {
+        "meta_seed": ex.meta_seed,
+        "lanes": ex.lanes,
+        "chunk": ex.chunk,
+        "fresh_frac": ex.fresh_frac,
+        "mutant_frac": ex.mutant_frac,
+        "top_k": ex.top_k,
+        "swarm_group": ex.swarm_group,
+        "pipeline": ex.pipeline,
+    }
+
+
+def default_campaign_id(ex: Explorer) -> str:
+    """Deterministic campaign identity: same workload config + meta-seed
+    IS the same (replayable) campaign."""
+    return (
+        f"{ex.workload.spec.name}-m{ex.meta_seed}-{ex.cfg.hash()[:8]}"
+    )
+
+
+# --------------------------------------------------------------------------
+# the campaign
+# --------------------------------------------------------------------------
+
+
+class Campaign:
+    """A persistent, resumable fuzz campaign over one workload.
+
+        c = Campaign(workload, dir="/data/c1", meta_seed=7, lanes=256)
+        c.run(8)           # 8 explorer generations + bug dedup
+        c.checkpoint()     # exact resume point on disk
+        ...
+        c2 = Campaign.resume("/data/c1")   # (named workloads rebuild
+        c2.run(8)                          #  themselves from the manifest)
+
+    The campaign owns violation triage: its Explorer runs with
+    `shrink_violations=False` and every slice's new violations flow
+    through the dedup layer — grouped by `coarse_key`, the first witness
+    of each new group ddmin-shrunk (within its candidate's suppression
+    set) into a ReproBundle stamped with the `bug_signature`, groups whose
+    signatures coincide merged into one `BugRecord`. Bundles land in
+    `<dir>/bundles/` and are copied into the regression corpus
+    (`<dir>/regression/` unless `regression_dir` points at a shared one),
+    which `make regression` replays green.
+    """
+
+    def __init__(
+        self,
+        workload,
+        dir: str,
+        meta_seed: int = 0,
+        lanes: int = 256,
+        chunk: Optional[int] = None,
+        campaign_id: Optional[str] = None,
+        workload_ref: Optional[Dict[str, Any]] = None,
+        shrink: bool = True,
+        max_shrinks: int = 8,
+        lane_width: int = 16,
+        spec_ref: Optional[str] = None,
+        spec_kwargs: Optional[Dict[str, Any]] = None,
+        regression_dir: Optional[str] = None,
+        sim=None,
+        pipeline: bool = True,
+        log: Optional[Callable[[str], None]] = None,
+        explorer_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.workload = workload
+        self.dir = str(dir)
+        self.shrink = bool(shrink)
+        self.max_shrinks = int(max_shrinks)
+        self.lane_width = int(lane_width)
+        self.spec_ref = spec_ref
+        self.spec_kwargs = dict(spec_kwargs or {})
+        self.say = log or (lambda msg: None)
+        self.ex = Explorer(
+            workload, meta_seed=meta_seed, lanes=lanes, chunk=chunk,
+            shrink_violations=False, pipeline=pipeline, sim=sim, log=log,
+            **(explorer_kwargs or {}),
+        )
+        self.campaign_id = campaign_id or default_campaign_id(self.ex)
+        self.workload_ref = workload_ref or {"kind": "custom"}
+        # producer default mirrors the `regress` consumer's: an explicit
+        # arg wins, then $MADSIM_REGRESSION_DIR (so `make regression` under
+        # the same env replays exactly what campaigns produced), then the
+        # self-contained per-campaign dir
+        self.regression_dir = (
+            regression_dir
+            or os.environ.get("MADSIM_REGRESSION_DIR")
+            or os.path.join(self.dir, REGRESSION_DIR)
+        )
+        self.bundles_dir = os.path.join(self.dir, BUNDLE_DIR)
+        self.bugs: List[BugRecord] = []
+        self._by_sig: Dict[str, BugRecord] = {}
+        self._by_coarse: Dict[str, BugRecord] = {}
+        self._seen_violations = 0
+        self._shrinks_done = 0
+
+    # ------------------------------------------------------------ identity
+
+    @property
+    def generation(self) -> int:
+        return self.ex._gen
+
+    @property
+    def spec_name(self) -> str:
+        return self.workload.spec.name
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, generations: int) -> ExploreReport:
+        """Run `generations` explorer generations, then dedup the slice's
+        new violations into BugRecords (shrinking at most `max_shrinks`
+        first-witnesses over the campaign's lifetime)."""
+        report = self.ex.run(int(generations))
+        self._absorb_violations()
+        return report
+
+    def report(self) -> ExploreReport:
+        return self.ex.report()
+
+    def _absorb_violations(self) -> None:
+        new = self.ex.violations[self._seen_violations:]
+        self._seen_violations = len(self.ex.violations)
+        for rec in new:
+            genome = canon_genome(rec["candidate"])
+            gen = int(rec["dispatch"])
+            witness = {
+                "seed": int(rec["seed"]),
+                "candidate": list(genome),
+                "dispatch": gen,
+                "origin": rec.get("origin", "fresh"),
+                "cov_digest": rec.get("cov_digest"),
+            }
+            record = self._by_coarse.get(
+                coarse_key(self.spec_name, "invariant", genome)
+            )
+            if record is None:
+                record = self._new_record(rec, genome, gen)
+            record.witnesses.append(witness)
+
+    def _new_record(self, rec, genome, gen: int) -> BugRecord:
+        """Resolve a violation whose coarse group is new: shrink its first
+        witness to compute the full signature (budget permitting), merge
+        into an existing record when the signature matches, else open one."""
+        ck = coarse_key(self.spec_name, "invariant", genome)
+        signature = ck  # the weak fallback key when no shrink runs
+        profile: List[list] = []
+        kind = "invariant"
+        bundle_path = None
+        shrink_error = None
+        if self.shrink and self._shrinks_done < self.max_shrinks:
+            from . import triage
+
+            self._shrinks_done += 1
+            cand = Candidate(
+                seed=genome[0], off=genome[1], occ_off=genome[2],
+                rate_scale=genome[3], horizon_us=genome[4],
+            )
+            os.makedirs(self.bundles_dir, exist_ok=True)
+            try:
+                sr = triage.shrink_seed(
+                    self.workload, genome[0], sim=self.ex.sim,
+                    base_ctl=cand.base_ctl(), out_dir=self.bundles_dir,
+                    lane_width=self.lane_width, spec_ref=self.spec_ref,
+                    spec_kwargs=self.spec_kwargs or None,
+                )
+                kind = sr.bundle.violation_kind
+                profile = clause_profile(sr.kept_atoms)
+                signature = bug_signature(
+                    self.spec_name, kind, sr.kept_atoms
+                )
+                sr.bundle.stamp(signature, self.campaign_id, gen)
+                if sr.bundle_path:
+                    sr.bundle.save(sr.bundle_path)
+                    bundle_path = sr.bundle_path
+                    os.makedirs(self.regression_dir, exist_ok=True)
+                    reg_path = os.path.join(
+                        self.regression_dir, os.path.basename(sr.bundle_path)
+                    )
+                    sr.bundle.save(reg_path)
+                self.say(
+                    f"bug {signature[:12]}: shrunk seed {genome[0]} "
+                    f"({len(sr.kept_atoms)} atoms kept) -> {bundle_path}"
+                )
+            except Exception as e:  # noqa: BLE001 - dedup must outlive triage
+                shrink_error = f"{type(e).__name__}: {str(e)[:160]}"
+        existing = self._by_sig.get(signature)
+        if existing is not None:
+            # a different candidate shape shrank to the same minimal class
+            existing.coarse_keys.append(ck)
+            self._by_coarse[ck] = existing
+            return existing
+        record = BugRecord(
+            signature=signature,
+            spec_name=self.spec_name,
+            violation_kind=kind,
+            clause_profile=profile,
+            witnesses=[],
+            bundle_path=bundle_path,
+            campaign=self.campaign_id,
+            first_generation=gen,
+            coarse_keys=[ck],
+            shrink_error=shrink_error,
+        )
+        self.bugs.append(record)
+        self._by_sig[signature] = record
+        self._by_coarse[ck] = record
+        return record
+
+    # ---------------------------------------------------------- checkpoint
+
+    def checkpoint(self) -> str:
+        extra = {
+            "campaign_id": self.campaign_id,
+            "workload": self.workload_ref,
+            "config_hash": self.ex.cfg.hash(),
+            "spec_name": self.spec_name,
+            "params": explorer_params(self.ex),
+            "campaign_params": {
+                "shrink": self.shrink,
+                "max_shrinks": self.max_shrinks,
+                "lane_width": self.lane_width,
+                "spec_ref": self.spec_ref,
+                "spec_kwargs": self.spec_kwargs,
+                # persisted so a resume keeps feeding the SAME (possibly
+                # shared) regression corpus without re-passing the flag
+                "regression_dir": self.regression_dir,
+            },
+            "seen_violations": self._seen_violations,
+            "shrinks_done": self._shrinks_done,
+            "kind": "campaign",
+        }
+        return save_checkpoint(
+            self.dir, self.ex.snapshot(), extra, bugs=self.bugs,
+            report=self.ex.report(),
+        )
+
+    @classmethod
+    def resume(
+        cls,
+        dir: str,
+        workload=None,
+        sim=None,
+        regression_dir: Optional[str] = None,
+        log: Optional[Callable[[str], None]] = None,
+    ) -> "Campaign":
+        """Rebuild a campaign from its checkpoint: same workload (rebuilt
+        from the manifest for named workloads, else passed in), same
+        explorer parameters, exact search state — `resume(d).run(k)`
+        fingerprints identically to the uninterrupted run."""
+        ck = load_checkpoint(dir)
+        man = ck["manifest"]
+        if man.get("kind") == "merged":
+            raise ValueError(
+                "a merged corpus has no meta-rng cursor to resume; import "
+                "it via merge, or start a fresh campaign over it"
+            )
+        if workload is None:
+            workload = build_workload(man["workload"])
+        params = dict(man["params"])
+        cparams = dict(man.get("campaign_params") or {})
+        spec_ref = cparams.get("spec_ref")
+        spec_kwargs = cparams.get("spec_kwargs")
+        if spec_ref is None and man["workload"].get("kind") == "named":
+            # checkpoints written without campaign params (an `explore
+            # --out` export) would otherwise shrink bundles that carry no
+            # spec factory — and `campaign regress` could never replay them
+            spec_ref = "madsim_tpu.campaign:spec_for"
+            spec_kwargs = {
+                "name": man["workload"]["name"],
+                "virtual_secs": man["workload"].get("virtual_secs", 2.0),
+            }
+        c = cls(
+            workload, dir,
+            meta_seed=int(params["meta_seed"]),
+            lanes=int(params["lanes"]),
+            chunk=int(params["chunk"]),
+            campaign_id=man["campaign_id"],
+            workload_ref=man["workload"],
+            shrink=bool(cparams.get("shrink", True)),
+            max_shrinks=int(cparams.get("max_shrinks", 8)),
+            lane_width=int(cparams.get("lane_width", 16)),
+            spec_ref=spec_ref,
+            spec_kwargs=spec_kwargs,
+            regression_dir=regression_dir or cparams.get("regression_dir"),
+            sim=sim,
+            pipeline=bool(params.get("pipeline", True)),
+            log=log,
+            explorer_kwargs={
+                k: params[k] for k in
+                ("fresh_frac", "mutant_frac", "top_k", "swarm_group")
+                if k in params
+            },
+        )
+        got = c.ex.cfg.hash()
+        want = man.get("config_hash")
+        if want and got != want:
+            raise ValueError(
+                f"workload config hash {got} does not match the "
+                f"checkpoint's {want} — resuming a different configuration "
+                "would silently fork the campaign"
+            )
+        c.ex.restore(ck["snapshot"])
+        c.bugs = list(ck["bugs"])
+        for b in c.bugs:
+            c._by_sig[b.signature] = b
+            for k in b.coarse_keys:
+                c._by_coarse[k] = b
+        c._seen_violations = int(man.get("seen_violations", 0))
+        c._shrinks_done = int(man.get("shrinks_done", 0))
+        return c
+
+
+# --------------------------------------------------------------------------
+# corpus merge + cmin minimization
+# --------------------------------------------------------------------------
+
+
+def load_report(dir: str) -> Optional[ExploreReport]:
+    """The checkpoint's latest ExploreReport (None if none was saved)."""
+    with open(os.path.join(dir, MANIFEST)) as f:
+        manifest = json.load(f)
+    text = _read_sidecar(dir, manifest, "report", REPORT)
+    return ExploreReport.from_dict(json.loads(text)) if text else None
+
+
+def load_corpus(dir: str) -> List[CorpusEntry]:
+    with open(os.path.join(dir, MANIFEST)) as f:
+        manifest = json.load(f)
+    return [
+        CorpusEntry.from_dict(d)
+        for d in _jsonl(_read_sidecar(dir, manifest, "corpus", CORPUS))
+    ]
+
+
+def merge_corpora(dirs: Sequence[str]) -> Tuple[List[CorpusEntry], List[dict]]:
+    """Concatenate the corpora of several campaign directories, first
+    occurrence of each genome winning, and verify they fuzzed the SAME
+    workload spec and compiled configuration (a corpus entry is only
+    replayable against the draw layout that produced it — and config_hash
+    covers only the SimConfig, so the spec name is checked separately)."""
+    entries: List[CorpusEntry] = []
+    manifests: List[dict] = []
+    seen: set = set()
+    hashes = set()
+    spec_names = set()
+    for d in dirs:
+        with open(os.path.join(d, MANIFEST)) as f:
+            man = json.load(f)
+        manifests.append(man)
+        if man.get("config_hash"):
+            hashes.add(man["config_hash"])
+        if man.get("spec_name"):
+            spec_names.add(man["spec_name"])
+        for e in load_corpus(d):
+            key = canon_genome(e.cand.key())
+            if key in seen:
+                continue
+            seen.add(key)
+            entries.append(e)
+    if len(hashes) > 1:
+        raise ValueError(
+            f"corpora were fuzzed under {len(hashes)} different configs "
+            f"({sorted(hashes)}) — merge is only defined within one config"
+        )
+    if len(spec_names) > 1:
+        raise ValueError(
+            f"corpora come from different workload specs "
+            f"({sorted(spec_names)}) — their coverage spaces are unrelated"
+        )
+    return entries, manifests
+
+
+def minimize(
+    workload,
+    entries: Sequence[CorpusEntry],
+    sim=None,
+    lane_width: int = 64,
+    verify_bitmaps: bool = True,
+    log: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """AFL-`cmin` as a batched dispatch: replay every candidate lane with
+    coverage on (chunks of ONE compiled program, padded to `lane_width`),
+    then greedily keep the minimal lane set whose bitmap union equals the
+    merged union. The preservation claim is ASSERTED here — popcount and
+    exact array equality — not just tested.
+
+    Returns {kept: [CorpusEntry], union, merged_bits, kept_bits,
+    replayed, dispatches}. Kept entries carry their REPLAYED bitmaps and
+    keep their admission metadata.
+    """
+    from .tpu.batch import pipelined
+    from .tpu.engine import BatchedSim
+
+    say = log or (lambda msg: None)
+    if not entries:
+        return {
+            "kept": [], "union": None, "merged_bits": 0, "kept_bits": 0,
+            "replayed": 0, "dispatches": 0,
+        }
+    if sim is None:
+        sim = BatchedSim(
+            workload.spec, workload.config, triage=True, coverage=True
+        )
+    elif not (sim.triage and sim.coverage):
+        raise ValueError(
+            "minimize needs a BatchedSim(..., triage=True, coverage=True)"
+        )
+    full_h = int(sim.config.horizon_us)
+    lane_width = max(2, int(lane_width))
+    bitmaps: List[np.ndarray] = []
+    dispatches = 0
+
+    def dispatch(lo: int):
+        nonlocal dispatches
+        part = list(entries[lo:lo + lane_width])
+        n = len(part)
+        pad = lane_width - n
+        part = part + [part[0]] * pad  # pad lanes are discarded at decode
+        cands = [e.cand for e in part]
+        seeds = np.asarray([c.seed for c in cands], np.uint32)
+        st = sim.run(
+            seeds, max_steps=workload.max_steps,
+            ctl=ctl_for(cands, full_h),
+        )
+        dispatches += 1
+        return n, st
+
+    def decode(entry) -> None:
+        n, st = entry
+        bm = np.asarray(st.cov.bitmap, np.uint32)
+        for i in range(n):
+            bitmaps.append(bm[i].copy())
+
+    pipelined(range(0, len(entries), lane_width), dispatch, decode)
+
+    if verify_bitmaps:
+        for e, bm in zip(entries, bitmaps):
+            if not np.array_equal(e.bitmap, bm):
+                raise AssertionError(
+                    f"corpus entry (seed {e.cand.seed}) replayed to a "
+                    "different coverage bitmap than it recorded — the "
+                    "corpus and this config/engine disagree (schema "
+                    "drift, or a corrupt corpus line)"
+                )
+
+    merged_union = np.zeros_like(bitmaps[0])
+    for bm in bitmaps:
+        merged_union |= bm
+    merged_bits = int(popcount_rows(merged_union[None, :])[0])
+
+    # greedy cover in deterministic order: densest bitmap first (ties by
+    # genome) — each pick keeps a lane only if it still adds new bits
+    counts = popcount_rows(np.stack(bitmaps))
+    order = sorted(
+        range(len(entries)),
+        key=lambda i: (-int(counts[i]), canon_genome(entries[i].cand.key())),
+    )
+    kept_idx: List[int] = []
+    union = np.zeros_like(merged_union)
+    covered = 0
+    for i in order:
+        new = bitmaps[i] & ~union
+        if not new.any():
+            continue
+        kept_idx.append(i)
+        union |= bitmaps[i]
+        covered = int(popcount_rows(union[None, :])[0])
+        if covered == merged_bits:
+            break
+    # the acceptance invariant, enforced in production code (an explicit
+    # raise, not `assert` — it must survive python -O): minimization
+    # provably preserves the coverage union
+    if covered != merged_bits or not np.array_equal(union, merged_union):
+        raise AssertionError(
+            f"cmin dropped coverage: kept-set union has {covered} bits, "
+            f"the merged union {merged_bits}"
+        )
+    kept_idx.sort()
+    kept = [
+        dataclasses.replace(entries[i], bitmap=bitmaps[i]) for i in kept_idx
+    ]
+    say(
+        f"cmin: {len(entries)} candidates -> {len(kept)} kept, "
+        f"{merged_bits} union bits preserved, {dispatches} dispatches"
+    )
+    return {
+        "kept": kept, "union": union, "merged_bits": merged_bits,
+        "kept_bits": covered, "replayed": len(entries),
+        "dispatches": dispatches,
+    }
+
+
+def merge_and_minimize(
+    dirs: Sequence[str],
+    out_dir: str,
+    workload=None,
+    sim=None,
+    lane_width: int = 64,
+    log: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """Merge several campaign corpora and write the cmin-minimized corpus
+    to `out_dir` (manifest kind "merged": importable, not resumable — a
+    merged corpus has no single meta-rng cursor)."""
+    entries, manifests = merge_corpora(dirs)
+    if workload is None:
+        workload = build_workload(manifests[0]["workload"])
+    res = minimize(
+        workload, entries, sim=sim, lane_width=lane_width, log=log
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    union_hex = (
+        res["union"].tobytes().hex() if res["union"] is not None else ""
+    )
+    corpus_text = "".join(
+        json.dumps(e.to_dict(), sort_keys=True) + "\n" for e in res["kept"]
+    )
+    # content-addressed like save_checkpoint's sidecars: re-merging into
+    # the same out_dir never rewrites a file the old manifest references
+    corpus_name = f"corpus.merged-{_sha256(corpus_text)[:8]}.jsonl"
+    _write_text(os.path.join(out_dir, corpus_name), corpus_text)
+    # manifest last: the commit point, like save_checkpoint
+    _write_json(os.path.join(out_dir, MANIFEST), {
+        "format": CAMPAIGN_FORMAT,
+        "kind": "merged",
+        "files": {"corpus": corpus_name},
+        "file_sha256": {"corpus": _sha256(corpus_text)},
+        "merged_from": [m.get("campaign_id") for m in manifests],
+        "workload": manifests[0].get("workload"),
+        "config_hash": manifests[0].get("config_hash"),
+        "spec_name": manifests[0].get("spec_name"),
+        "union": union_hex,
+        "merged_bits": res["merged_bits"],
+        "kept": len(res["kept"]),
+        "candidates": res["replayed"],
+    })
+    _gc_stale_sidecars(out_dir, keep={corpus_name})
+    return res
+
+
+# --------------------------------------------------------------------------
+# regression replay
+# --------------------------------------------------------------------------
+
+
+def default_regression_dir() -> str:
+    return os.environ.get(
+        "MADSIM_REGRESSION_DIR",
+        os.path.join(os.getcwd(), ".madsim_regression"),
+    )
+
+
+def regress(
+    dir: Optional[str] = None,
+    spec=None,
+    repeats: int = 1,
+    out=print,
+) -> Dict[str, Any]:
+    """Replay every ReproBundle in a regression corpus and report which
+    stayed green (still violate exactly as recorded — a 'failure' here
+    means a PRIOR BUG'S REPRO STOPPED REPRODUCING, i.e. schema drift or an
+    engine change ate a bug). Given a campaign directory, its
+    `regression/` subdir is used. An empty/missing dir is vacuously green.
+    """
+    from . import repro
+
+    dir = dir or default_regression_dir()
+    if os.path.exists(os.path.join(dir, MANIFEST)):
+        # a campaign dir: replay the regression corpus ITS checkpoint
+        # names (which may be a shared dir), not a guessed subpath
+        with open(os.path.join(dir, MANIFEST)) as f:
+            man = json.load(f)
+        dir = (man.get("campaign_params") or {}).get(
+            "regression_dir"
+        ) or os.path.join(dir, REGRESSION_DIR)
+    bundles = sorted(glob.glob(os.path.join(dir, "*.json")))
+    failures: List[Dict[str, str]] = []
+    for path in bundles:
+        try:
+            bundle = repro.ReproBundle.load(path)
+            repro.replay_device(bundle, spec=spec, repeats=repeats, out=out)
+        except Exception as e:  # noqa: BLE001 - report every bundle
+            failures.append({
+                "bundle": path, "error": f"{type(e).__name__}: {str(e)[:200]}"
+            })
+            out(f"REGRESSION RED: {path}: {e}")
+    out(
+        f"regression: {len(bundles) - len(failures)}/{len(bundles)} bundles "
+        f"green ({dir})"
+    )
+    return {"dir": dir, "bundles": len(bundles), "failures": failures}
+
+
+# --------------------------------------------------------------------------
+# the service loop — queued requests, time-sliced campaigns
+# --------------------------------------------------------------------------
+
+
+def check_resume_conflicts(manifest: Dict[str, Any],
+                           given: Dict[str, Any]) -> None:
+    """Refuse to resume a checkpoint under explicitly different search
+    parameters — silently continuing a different search is the one
+    mistake no fingerprint catches. `given` holds only the knobs the
+    caller EXPLICITLY provided (CLI flags typed, request keys present);
+    omitted knobs always defer to the checkpoint."""
+    params = manifest.get("params") or {}
+    ref = manifest.get("workload") or {}
+    conflicts = []
+    for key in ("meta_seed", "lanes", "chunk"):
+        if key in given and int(given[key]) != params.get(key):
+            conflicts.append(
+                f"{key} {given[key]} != checkpoint {params.get(key)}"
+            )
+    if "workload" in given and str(given["workload"]) != ref.get("name"):
+        conflicts.append(
+            f"workload {given['workload']!r} != checkpoint "
+            f"{ref.get('name')!r}"
+        )
+    if "virtual_secs" in given and \
+            float(given["virtual_secs"]) != ref.get("virtual_secs"):
+        conflicts.append(
+            f"virtual_secs {given['virtual_secs']} != checkpoint "
+            f"{ref.get('virtual_secs')}"
+        )
+    if "storm" in given and bool(given["storm"]) != bool(
+        ref.get("storm", False)
+    ):
+        conflicts.append(
+            f"storm {given['storm']} != checkpoint {ref.get('storm')}"
+        )
+    if conflicts:
+        raise ValueError(
+            "request conflicts with the existing checkpoint: "
+            + "; ".join(conflicts)
+        )
+
+
+def _explicit_request_params(request: Dict[str, Any]) -> Dict[str, Any]:
+    """The knobs a service request explicitly pins (chunk 0/null means
+    'default', like the CLI flag, so it never counts as explicit)."""
+    given = {
+        k: request[k]
+        for k in ("workload", "virtual_secs", "storm", "meta_seed", "lanes")
+        if request.get(k) is not None
+    }
+    if request.get("chunk"):
+        given["chunk"] = request["chunk"]
+    return given
+
+
+def _default_factory(request: Dict[str, Any], campaign_dir: str,
+                     regression_dir: str, log) -> Campaign:
+    name = str(request.get("workload", "raft"))
+    virtual_secs = float(request.get("virtual_secs", 2.0))
+    storm = bool(request.get("storm", False))
+    if os.path.exists(os.path.join(campaign_dir, MANIFEST)):
+        with open(os.path.join(campaign_dir, MANIFEST)) as f:
+            man = json.load(f)
+        check_resume_conflicts(man, _explicit_request_params(request))
+        c = Campaign.resume(
+            campaign_dir, regression_dir=regression_dir, log=log
+        )
+        # triage knobs are runtime policy, not search identity (they never
+        # touch the explorer fingerprint) — an explicit request overrides
+        if "shrink" in request:
+            c.shrink = bool(request["shrink"])
+        if request.get("max_shrinks") is not None:
+            c.max_shrinks = int(request["max_shrinks"])
+        return c
+    wl = build_workload(named_workload_ref(name, virtual_secs, storm))
+    return Campaign(
+        wl, campaign_dir,
+        meta_seed=int(request.get("meta_seed", 0)),
+        lanes=int(request.get("lanes", 256)),
+        chunk=int(request["chunk"]) if request.get("chunk") else None,
+        campaign_id=request.get("id"),
+        workload_ref=named_workload_ref(name, virtual_secs, storm),
+        shrink=bool(request.get("shrink", True)),
+        max_shrinks=int(request.get("max_shrinks", 8)),
+        spec_ref="madsim_tpu.campaign:spec_for",
+        spec_kwargs={"name": name, "virtual_secs": virtual_secs},
+        regression_dir=regression_dir,
+        log=log,
+    )
+
+
+def serve(
+    dir: str,
+    poll_s: float = 0.5,
+    slice_generations: int = 1,
+    max_rounds: Optional[int] = None,
+    idle_rounds: Optional[int] = None,
+    out=print,
+    log: Optional[Callable[[str], None]] = None,
+    factory: Optional[Callable[..., Any]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Dict[str, Any]:
+    """The fuzz-farm front end: watch `<dir>/queue/` for request files,
+    time-slice the device between active campaigns round-robin
+    (`slice_generations` explorer generations per turn), stream ONE JSON
+    line per slice ({campaign, generation, fingerprint, report}), and
+    checkpoint after every slice — a kill at any slice boundary resumes
+    exactly where it stopped.
+
+    Request file (JSON): {"id"?, "workload", "virtual_secs"?, "storm"?,
+    "meta_seed"?, "lanes"?, "chunk"?, "generations", "shrink"?,
+    "max_shrinks"?}. Requests move queue/ -> active/ -> done/. No new
+    dependencies: the queue is the filesystem (the "JSON on a watch-dir"
+    face; anything that can write a file can submit work).
+
+    `max_rounds` / `idle_rounds` bound the loop for tests and cron-style
+    runs; the default (None/None) serves forever.
+    """
+    if int(slice_generations) < 1:
+        raise ValueError(
+            f"slice_generations must be >= 1 (got {slice_generations}): a "
+            "zero-generation slice never finishes any request"
+        )
+    queue_dir = os.path.join(dir, "queue")
+    active_dir = os.path.join(dir, "active")
+    done_dir = os.path.join(dir, "done")
+    campaigns_dir = os.path.join(dir, "campaigns")
+    regression_dir = os.path.join(dir, REGRESSION_DIR)
+    for d in (queue_dir, active_dir, done_dir, campaigns_dir):
+        os.makedirs(d, exist_ok=True)
+    build = factory or _default_factory
+
+    # crash recovery: requests that were in flight when a previous service
+    # died are requeued — their campaigns resume from checkpoint, and
+    # `generations` counts TOTAL campaign generations, so re-admission
+    # runs exactly the remainder (not the full request again). A freshly
+    # resubmitted request of the same name supersedes its stale orphan.
+    for path in sorted(glob.glob(os.path.join(active_dir, "*.json"))):
+        target = os.path.join(queue_dir, os.path.basename(path))
+        if os.path.exists(target):
+            os.replace(path, os.path.join(done_dir, os.path.basename(path)))
+        else:
+            os.replace(path, target)
+
+    jobs: Dict[str, Dict[str, Any]] = {}
+    completed: List[str] = []
+    rounds = 0
+    idle = 0
+    unparseable: Dict[str, int] = {}  # queue path -> consecutive bad polls
+
+    def reject(path: str, cid: Optional[str], why: str) -> None:
+        out(json.dumps({"campaign": cid, "rejected": why}))
+        os.replace(path, os.path.join(done_dir, os.path.basename(path)))
+
+    def poll_queue() -> None:
+        """One request must never take the service down: malformed JSON is
+        retried a few polls (a non-atomic writer may still be mid-write)
+        then rejected to done/; a request that fails to build (unknown
+        workload, checkpoint mismatch, ...) is rejected immediately."""
+        for path in sorted(glob.glob(os.path.join(queue_dir, "*.json"))):
+            try:
+                with open(path) as f:
+                    request = json.load(f)
+            except (json.JSONDecodeError, OSError) as e:
+                n = unparseable.get(path, 0) + 1
+                if n >= 3:
+                    unparseable.pop(path, None)
+                    reject(
+                        path, None,
+                        f"unreadable request after {n} polls: "
+                        f"{type(e).__name__}: {str(e)[:120]}",
+                    )
+                else:
+                    unparseable[path] = n
+                continue
+            unparseable.pop(path, None)
+            cid = str(
+                request.get("id") or os.path.splitext(os.path.basename(path))[0]
+            )
+            request["id"] = cid
+            if cid in jobs:
+                reject(path, cid, "duplicate id; request ignored")
+                continue
+            remaining = int(request.get("generations", 4))
+            if remaining <= 0:
+                reject(path, cid, "generations must be positive")
+                continue
+            # active/ entries are keyed by CAMPAIGN id, not request-file
+            # basename: two differently-named files with distinct explicit
+            # ids must never share (and clobber) one in-flight path
+            active_path = os.path.join(active_dir, f"{cid}.json")
+            os.replace(path, active_path)
+            campaign_dir = os.path.join(campaigns_dir, cid)
+            try:
+                built = build(request, campaign_dir, regression_dir, log)
+            except Exception as e:  # noqa: BLE001 - service must survive
+                reject(active_path, cid, f"{type(e).__name__}: {str(e)[:200]}")
+                continue
+            # `generations` is the campaign's TOTAL target: a resumed
+            # campaign (service restart, or a re-submitted id) runs only
+            # the remainder — and an already-satisfied request completes
+            # immediately instead of running the whole budget again
+            left = remaining - int(getattr(built, "generation", 0))
+            if left <= 0:
+                os.replace(
+                    active_path,
+                    os.path.join(done_dir, os.path.basename(active_path)),
+                )
+                completed.append(cid)
+                out(json.dumps({
+                    "campaign": cid, "completed": True,
+                    "generation": int(getattr(built, "generation", 0)),
+                }))
+                continue
+            jobs[cid] = {
+                "campaign": built,
+                "request": request,
+                "active_path": active_path,
+                "campaign_dir": campaign_dir,
+                "remaining": left,
+            }
+            out(json.dumps({
+                "campaign": cid, "accepted": True, "generations": left,
+            }))
+
+    while True:
+        poll_queue()
+        progressed = False
+        for cid in sorted(jobs):
+            job = jobs[cid]
+            g = min(int(slice_generations), job["remaining"])
+            campaign = job["campaign"]
+            try:
+                report = campaign.run(g)
+                campaign.checkpoint()
+            except Exception as e:  # noqa: BLE001 - one tenant's failing
+                # workload must not take the other campaigns down; its last
+                # good checkpoint stays resumable
+                reject(
+                    job["active_path"], cid,
+                    f"slice failed: {type(e).__name__}: {str(e)[:200]}",
+                )
+                del jobs[cid]
+                progressed = True
+                continue
+            job["remaining"] -= g
+            line = {
+                "campaign": cid,
+                "generation": campaign.generation,
+                "remaining": job["remaining"],
+                "fingerprint": report.fingerprint(),
+                "bugs": len(getattr(campaign, "bugs", ())),
+                "report": report.to_dict(),
+            }
+            out(json.dumps(line))
+            with open(
+                os.path.join(job["campaign_dir"], REPORTS_STREAM), "a"
+            ) as f:
+                f.write(json.dumps(line) + "\n")
+            progressed = True
+            if job["remaining"] <= 0:
+                os.replace(
+                    job["active_path"],
+                    os.path.join(
+                        done_dir, os.path.basename(job["active_path"])
+                    ),
+                )
+                completed.append(cid)
+                del jobs[cid]
+        rounds += 1
+        if max_rounds is not None and rounds >= max_rounds:
+            break
+        if progressed:
+            idle = 0
+        else:
+            idle += 1
+            if idle_rounds is not None and idle >= idle_rounds:
+                break
+            sleep(poll_s)
+    return {"rounds": rounds, "completed": completed, "pending": sorted(jobs)}
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+def _cmd_run(args) -> int:
+    say = None if args.json else (lambda m: print(m, flush=True))
+    if os.path.exists(os.path.join(args.dir, MANIFEST)):
+        # resume: flags the user explicitly typed must MATCH the
+        # checkpoint (sentinel defaults are None, so omitted flags defer)
+        with open(os.path.join(args.dir, MANIFEST)) as f:
+            man = json.load(f)
+        given = {
+            k: v for k, v in (
+                ("workload", args.workload),
+                ("virtual_secs", args.virtual_secs),
+                ("meta_seed", args.meta_seed),
+                ("lanes", args.lanes),
+                ("chunk", args.chunk or None),
+            ) if v is not None
+        }
+        if args.storm:
+            given["storm"] = True
+        check_resume_conflicts(man, given)
+        c = Campaign.resume(
+            args.dir, regression_dir=args.regression_dir, log=say
+        )
+        # triage knobs are runtime policy, not search identity: explicitly
+        # typed flags override the checkpoint instead of being ignored
+        if args.no_shrink:
+            c.shrink = False
+        if args.max_shrinks is not None:
+            c.max_shrinks = args.max_shrinks
+    else:
+        workload = args.workload or "raft"
+        virtual_secs = 2.0 if args.virtual_secs is None else args.virtual_secs
+        ref = named_workload_ref(workload, virtual_secs, args.storm)
+        c = Campaign(
+            build_workload(ref), args.dir,
+            meta_seed=args.meta_seed or 0,
+            lanes=args.lanes or 256,
+            chunk=args.chunk or None, workload_ref=ref,
+            shrink=not args.no_shrink,
+            max_shrinks=8 if args.max_shrinks is None else args.max_shrinks,
+            spec_ref="madsim_tpu.campaign:spec_for",
+            spec_kwargs={
+                "name": workload, "virtual_secs": virtual_secs,
+            },
+            regression_dir=args.regression_dir,
+            log=say,
+        )
+    report = c.run(args.generations)
+    c.checkpoint()
+    if args.json:
+        print(json.dumps({
+            "campaign": c.campaign_id,
+            "generation": c.generation,
+            "fingerprint": report.fingerprint(),
+            "bugs": [b.to_dict() for b in c.bugs],
+            "report": report.to_dict(),
+        }), flush=True)
+    else:
+        print(report.render(), flush=True)
+        for b in c.bugs:
+            print(
+                f"  bug {b.signature[:12]} ({b.violation_kind}, clauses "
+                f"{b.clause_profile}): {len(b.witnesses)} witness seed(s) "
+                f"{b.witness_seeds[:8]} -> {b.bundle_path}",
+                flush=True,
+            )
+        print(f"checkpoint: {c.dir}", flush=True)
+    return 0
+
+
+def _cmd_merge(args) -> int:
+    res = merge_and_minimize(
+        args.dirs, args.out, lane_width=args.lane_width,
+        log=lambda m: print(m, flush=True),
+    )
+    print(json.dumps({
+        "out": args.out, "candidates": res["replayed"],
+        "kept": len(res["kept"]), "merged_bits": res["merged_bits"],
+        "kept_bits": res["kept_bits"], "dispatches": res["dispatches"],
+    }), flush=True)
+    return 0
+
+
+def _cmd_regress(args) -> int:
+    rep = regress(args.dir, repeats=args.repeats)
+    return 1 if rep["failures"] else 0
+
+
+def _cmd_serve(args) -> int:
+    serve(
+        args.dir, poll_s=args.poll,
+        slice_generations=args.slice_generations,
+        max_rounds=args.max_rounds, idle_rounds=args.idle_rounds,
+        log=lambda m: print(m, flush=True) if args.verbose else None,
+    )
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m madsim_tpu.campaign",
+        description="persistent fuzz campaigns over the batched explorer "
+        "(docs/campaign.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser(
+        "run", help="run (or resume, if DIR has a manifest) one campaign"
+    )
+    # workload/search flags default to None sentinels: on a FRESH dir the
+    # fallbacks are raft/2.0s/seed 0/256 lanes; on resume, only the flags
+    # the user actually typed are checked against the checkpoint
+    r.add_argument("--dir", required=True)
+    r.add_argument("--workload", default=None)
+    r.add_argument("--virtual-secs", type=float, default=None)
+    r.add_argument("--storm", action="store_true")
+    r.add_argument("--meta-seed", type=int, default=None)
+    r.add_argument("--lanes", type=int, default=None)
+    r.add_argument("--chunk", type=int, default=None)
+    r.add_argument("--generations", type=int, default=8)
+    r.add_argument("--no-shrink", action="store_true")
+    r.add_argument("--max-shrinks", type=int, default=None)
+    r.add_argument("--regression-dir", default=None)
+    r.add_argument("--json", action="store_true")
+    r.set_defaults(fn=_cmd_run)
+
+    m = sub.add_parser(
+        "merge", help="merge + cmin-minimize corpora into --out"
+    )
+    m.add_argument("dirs", nargs="+")
+    m.add_argument("--out", required=True)
+    m.add_argument("--lane-width", type=int, default=64)
+    m.set_defaults(fn=_cmd_merge)
+
+    g = sub.add_parser(
+        "regress",
+        help="replay the regression corpus green (default dir: "
+        "$MADSIM_REGRESSION_DIR or ./.madsim_regression)",
+    )
+    g.add_argument("--dir", default=None)
+    g.add_argument("--repeats", type=int, default=1)
+    g.set_defaults(fn=_cmd_regress)
+
+    s = sub.add_parser(
+        "serve", help="watch-dir fuzz service: queue/ -> active/ -> done/"
+    )
+    s.add_argument("--dir", required=True)
+    s.add_argument("--poll", type=float, default=0.5)
+    s.add_argument("--slice-generations", type=int, default=1)
+    s.add_argument("--max-rounds", type=int, default=None)
+    s.add_argument("--idle-rounds", type=int, default=None)
+    s.add_argument("--verbose", action="store_true")
+    s.set_defaults(fn=_cmd_serve)
+
+    args = p.parse_args(argv)
+    # persistent XLA cache, same location as the suite/repro CLI: service
+    # restarts and cross-process resumes should pay seconds, not compiles
+    from .repro import _configure_jax_cache
+
+    _configure_jax_cache()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

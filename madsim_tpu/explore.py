@@ -74,11 +74,23 @@ class MetaRng:
     """Counter-based meta-rng: draw i of meta-seed s is
     `bits32(key_from_seed(s), META_SITE_DRAW, i)` — the same murmur3
     mirror both backends execute, so the whole search is a pure function
-    of the meta-seed with no hidden RNG state."""
+    of the meta-seed with no hidden RNG state.
 
-    def __init__(self, meta_seed: int) -> None:
+    The whole state is (meta_seed, counter): a checkpoint records the
+    `counter` cursor and a resume constructs `MetaRng(seed, counter=c)`,
+    which by the counter-chain construction continues the exact stream —
+    the property the campaign layer's kill/resume bit-identity rests on.
+    """
+
+    def __init__(self, meta_seed: int, counter: int = 0) -> None:
+        self.meta_seed = int(meta_seed)
         self._key = key_from_seed(int(meta_seed))
-        self._n = 0
+        self._n = int(counter)
+
+    @property
+    def counter(self) -> int:
+        """The draw cursor — draw `counter` is the next one handed out."""
+        return self._n
 
     def u32(self) -> int:
         v = bits32(self._key, META_SITE_DRAW, self._n)
@@ -99,6 +111,17 @@ class MetaRng:
 # --------------------------------------------------------------------------
 # candidates — one lane's (seed, fault-plan subset) genome
 # --------------------------------------------------------------------------
+
+
+def canon_genome(key) -> tuple:
+    """Canonical in-memory form of a Candidate.key() that may have been
+    through JSON (tuples collapse to lists): (seed, off, occ_off tuple,
+    rate_scale tuple, horizon_us)."""
+    seed, off, occ, rs, h = key
+    return (
+        int(seed), int(off), tuple(int(v) for v in occ),
+        tuple(float(v) for v in rs), int(h),
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,6 +171,23 @@ class Candidate:
             "horizon_us": self.horizon_us or None,
         }
 
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON face (campaign corpus lines; tuples become lists)."""
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(doc: Dict[str, Any]) -> "Candidate":
+        return Candidate(
+            seed=int(doc["seed"]),
+            off=int(doc.get("off", 0)),
+            occ_off=tuple(int(v) for v in doc.get("occ_off") or
+                          (0,) * len(OCC_CLAUSES)),
+            rate_scale=tuple(float(v) for v in doc.get("rate_scale") or
+                             (1.0,) * len(RATE_CLAUSES)),
+            horizon_us=int(doc.get("horizon_us", 0)),
+            origin=str(doc.get("origin", "fresh")),
+        )
+
     def describe(self) -> str:
         bits = [f"seed={self.seed}"]
         off = [n for n in TRIAGE_CLAUSES if self.off & TRIAGE_BIT[n]]
@@ -176,6 +216,41 @@ class CorpusEntry:
     violated: bool
     dispatch: int  # generation index at admission
 
+    def to_dict(self) -> Dict[str, Any]:
+        """One campaign corpus.jsonl line: the genome, the novelty that
+        admitted it, the exact bitmap (hex) and its digest."""
+        return {
+            "cand": self.cand.to_dict(),
+            "new_bits": int(self.new_bits),
+            "bitmap": self.bitmap.tobytes().hex(),
+            "cov_digest": hashlib.sha256(self.bitmap.tobytes()).hexdigest(),
+            "hiwater": int(self.hiwater),
+            "transitions": int(self.transitions),
+            "violated": bool(self.violated),
+            "dispatch": int(self.dispatch),
+        }
+
+    @staticmethod
+    def from_dict(doc: Dict[str, Any]) -> "CorpusEntry":
+        bitmap = np.frombuffer(
+            bytes.fromhex(doc["bitmap"]), np.uint32
+        ).copy()  # frombuffer views are read-only; the union path ORs in place
+        digest = doc.get("cov_digest")
+        if digest and hashlib.sha256(bitmap.tobytes()).hexdigest() != digest:
+            raise ValueError(
+                "corpus entry bitmap does not match its cov_digest "
+                f"(seed {doc.get('cand', {}).get('seed')}) — corrupt corpus"
+            )
+        return CorpusEntry(
+            cand=Candidate.from_dict(doc["cand"]),
+            new_bits=int(doc["new_bits"]),
+            bitmap=bitmap,
+            hiwater=int(doc.get("hiwater", 0)),
+            transitions=int(doc.get("transitions", 0)),
+            violated=bool(doc.get("violated", False)),
+            dispatch=int(doc.get("dispatch", 0)),
+        )
+
 
 @dataclasses.dataclass
 class ExploreReport:
@@ -203,17 +278,50 @@ class ExploreReport:
     def to_json(self) -> str:
         return json.dumps(self.to_dict())
 
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "ExploreReport":
+        """Reload a report (checkpoints, the campaign service stream).
+
+        The inverse of `to_dict` up to JSON's tuple->list collapse;
+        `fingerprint()` is canonicalized over that collapse, so a
+        round-tripped report fingerprints identically to the original.
+        """
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - fields
+        if unknown:
+            raise ValueError(f"unknown ExploreReport fields: {sorted(unknown)}")
+        rep = cls(**{k: doc[k] for k in fields if k in doc})
+        # candidate genomes arrive as JSON lists; restore the in-memory
+        # tuple form so violation records compare equal either way
+        rep.violations = [dict(v) for v in rep.violations]
+        for v in rep.violations:
+            if v.get("candidate") is not None:
+                v["candidate"] = canon_genome(v["candidate"])
+        return rep
+
+    @classmethod
+    def from_json(cls, text: str) -> "ExploreReport":
+        return cls.from_dict(json.loads(text))
+
     def fingerprint(self) -> str:
         """sha256 over everything the determinism contract covers: corpus
         genomes + bitmaps (via `corpus_digest`), coverage/corpus/violation
         curves, violation genomes. Excludes wall-clock and bundle paths
-        (machine-local)."""
+        (machine-local). JSON-canonical (tuples and lists encode the
+        same), so it survives a to_json/from_json round trip — the
+        campaign checkpoint and service-stream code depend on that."""
         h = hashlib.sha256()
-        h.update(repr((
-            self.meta_seed, self.lanes, self.coverage_curve,
-            self.corpus_curve, self.violation_curve, self.corpus_digest,
-            [(v["candidate"], v["dispatch"]) for v in self.violations],
-        )).encode())
+        h.update(json.dumps({
+            "meta_seed": self.meta_seed,
+            "lanes": self.lanes,
+            "coverage_curve": list(self.coverage_curve),
+            "corpus_curve": list(self.corpus_curve),
+            "violation_curve": list(self.violation_curve),
+            "corpus_digest": self.corpus_digest,
+            "violations": [
+                [v["candidate"], v["dispatch"]] for v in self.violations
+            ],
+        }, sort_keys=True, separators=(",", ":")).encode())
         return h.hexdigest()
 
     def render(self) -> str:
@@ -303,6 +411,29 @@ def popcount_rows(bitmaps: np.ndarray) -> np.ndarray:
     ).sum(axis=-1)
 
 
+def ctl_for(pop: Sequence[Candidate], full_horizon_us: int):
+    """The TriageCtl encoding one candidate per lane (the Explorer's
+    dispatch face; the campaign cmin replay builds the same rows)."""
+    import jax.numpy as jnp
+
+    from .tpu.engine import TriageCtl
+    from .tpu.spec import REBASE_US
+
+    off = np.asarray([c.off for c in pop], np.int32)
+    occ = np.asarray([list(c.occ_off) for c in pop], np.int32)
+    rs = np.asarray([list(c.rate_scale) for c in pop], np.float32)
+    h = np.asarray(
+        [c.horizon_us or int(full_horizon_us) for c in pop], np.int64
+    )
+    return TriageCtl(
+        off=jnp.asarray(off),
+        occ=jnp.asarray(occ),
+        rate_scale=jnp.asarray(rs),
+        h_epoch=jnp.asarray((h // REBASE_US).astype(np.int32)),
+        h_off=jnp.asarray((h % REBASE_US).astype(np.int32)),
+    )
+
+
 # --------------------------------------------------------------------------
 # the explorer
 # --------------------------------------------------------------------------
@@ -339,6 +470,7 @@ class Explorer:
         max_shrinks: Optional[int] = None,
         shrink_kwargs: Optional[Dict[str, Any]] = None,
         pipeline: bool = True,
+        sim=None,
         log: Optional[Callable[[str], None]] = None,
     ) -> None:
         from .tpu.engine import BatchedSim
@@ -366,10 +498,18 @@ class Explorer:
         self.say = log or (lambda msg: None)
 
         # ONE sim serves search, shrink and replay: triage threads the ctl
-        # (the mutator's knobs), coverage threads the novelty bitmaps
-        self.sim = BatchedSim(
-            workload.spec, self.cfg, triage=True, coverage=True
-        )
+        # (the mutator's knobs), coverage threads the novelty bitmaps.
+        # `sim` accepts a pre-built BatchedSim(triage=True, coverage=True)
+        # so a campaign resume (or a test suite) amortizes the compile.
+        if sim is None:
+            sim = BatchedSim(
+                workload.spec, self.cfg, triage=True, coverage=True
+            )
+        elif not (sim.triage and sim.coverage):
+            raise ValueError(
+                "Explorer needs a BatchedSim(..., triage=True, coverage=True)"
+            )
+        self.sim = sim
         self._rng = MetaRng(self.meta_seed)
         self._next_fresh = int(first_seed)
         self._full_h = int(self.cfg.horizon_us)
@@ -515,24 +655,7 @@ class Explorer:
     # ------------------------------------------------------------ dispatch
 
     def _ctl_for(self, pop: List[Candidate]):
-        import jax.numpy as jnp
-
-        from .tpu.engine import TriageCtl
-        from .tpu.spec import REBASE_US
-
-        off = np.asarray([c.off for c in pop], np.int32)
-        occ = np.asarray([list(c.occ_off) for c in pop], np.int32)
-        rs = np.asarray([list(c.rate_scale) for c in pop], np.float32)
-        h = np.asarray(
-            [c.horizon_us or self._full_h for c in pop], np.int64
-        )
-        return TriageCtl(
-            off=jnp.asarray(off),
-            occ=jnp.asarray(occ),
-            rate_scale=jnp.asarray(rs),
-            h_epoch=jnp.asarray((h // REBASE_US).astype(np.int32)),
-            h_off=jnp.asarray((h % REBASE_US).astype(np.int32)),
-        )
+        return ctl_for(pop, self._full_h)
 
     def _run_generation(self, gen: int, pop: List[Candidate]) -> None:
         """Dispatch one generation (chunked + double-buffered like
@@ -540,7 +663,7 @@ class Explorer:
         fold its coverage into the corpus."""
         from .tpu.batch import pipelined
 
-        new_violations: List[Candidate] = []
+        new_violations: List[Tuple[Candidate, np.ndarray]] = []
 
         def dispatch(lo: int):
             part = pop[lo:lo + self.chunk]
@@ -573,16 +696,16 @@ class Explorer:
                     ))
                 if violated[i] and cand.seed not in self._violated_seeds:
                     self._violated_seeds.add(cand.seed)
-                    new_violations.append(cand)
+                    new_violations.append((cand, bitmaps[i].copy()))
 
         pipelined(
             range(0, len(pop), self.chunk), dispatch, decode,
             serial=not self.pipeline,
         )
-        for cand in new_violations:
+        for cand, bitmap in new_violations:
             if self.first_violation_dispatch is None:
                 self.first_violation_dispatch = gen
-            self.violations.append(self._record_violation(cand, gen))
+            self.violations.append(self._record_violation(cand, gen, bitmap))
         self.coverage_curve.append(
             int(popcount_rows(self.union[None, :])[0])
         )
@@ -593,7 +716,10 @@ class Explorer:
             f"corpus {len(self.corpus)}, violations {len(self.violations)}"
         )
 
-    def _record_violation(self, cand: Candidate, gen: int) -> Dict[str, Any]:
+    def _record_violation(
+        self, cand: Candidate, gen: int,
+        bitmap: Optional[np.ndarray] = None,
+    ) -> Dict[str, Any]:
         rec: Dict[str, Any] = {
             "candidate": cand.key(),
             "seed": cand.seed,
@@ -601,6 +727,12 @@ class Explorer:
             "describe": cand.describe(),
             "dispatch": gen,
             "bundle_path": None,
+            # the violating lane's exact coverage-bitmap digest — per-seed
+            # evidence the campaign dedup layer records on each witness
+            "cov_digest": (
+                hashlib.sha256(bitmap.tobytes()).hexdigest()
+                if bitmap is not None else None
+            ),
         }
         if self.shrink_violations and (
             self.max_shrinks is not None
@@ -662,6 +794,79 @@ class Explorer:
             device_dispatches=self.sim.dispatch_count,
             corpus_digest=digest.hexdigest(),
         )
+
+    # ---------------------------------------------------------- persistence
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The COMPLETE search state as a JSON-safe dict: restoring it into
+        a fresh Explorer (same workload, same constructor parameters) and
+        running k more generations produces bit-identically what the
+        uninterrupted run would have — `MetaRng(seed, counter)` continues
+        the draw stream, `_next_fresh` the seed sequence, and the corpus /
+        union / seen-genome set reproduce every ranking and dedup decision.
+        The campaign layer persists this dict (docs/campaign.md)."""
+        return {
+            "meta_seed": self.meta_seed,
+            "lanes": self.lanes,
+            "meta_cursor": self._rng.counter,
+            "next_fresh": self._next_fresh,
+            "generation": self._gen,
+            "shrinks_done": self._shrinks_done,
+            "seeds_run": self.seeds_run,
+            "first_violation_dispatch": self.first_violation_dispatch,
+            "wall_s": self._wall_s,
+            "union": self.union.tobytes().hex(),
+            "coverage_curve": list(self.coverage_curve),
+            "corpus_curve": list(self.corpus_curve),
+            "violation_curve": list(self.violation_curve),
+            "corpus": [e.to_dict() for e in self.corpus],
+            "seen": [list(g) for g in sorted(self._seen)],
+            "violated_seeds": sorted(int(s) for s in self._violated_seeds),
+            "violations": json.loads(json.dumps(self.violations)),
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Install a `snapshot()` into this (freshly constructed) Explorer.
+
+        The constructor parameters are part of the contract the snapshot
+        does NOT carry (the campaign manifest records them); meta_seed and
+        lanes are cross-checked because silently resuming a different
+        search is the one mistake no fingerprint would catch early."""
+        if int(snap["meta_seed"]) != self.meta_seed:
+            raise ValueError(
+                f"snapshot meta_seed {snap['meta_seed']} != explorer "
+                f"meta_seed {self.meta_seed}"
+            )
+        if int(snap["lanes"]) != self.lanes:
+            raise ValueError(
+                f"snapshot lanes {snap['lanes']} != explorer lanes "
+                f"{self.lanes}"
+            )
+        self._rng = MetaRng(self.meta_seed, counter=int(snap["meta_cursor"]))
+        self._next_fresh = int(snap["next_fresh"])
+        self._gen = int(snap["generation"])
+        self._shrinks_done = int(snap["shrinks_done"])
+        self.seeds_run = int(snap["seeds_run"])
+        fvd = snap["first_violation_dispatch"]
+        self.first_violation_dispatch = None if fvd is None else int(fvd)
+        self._wall_s = float(snap["wall_s"])
+        union = np.frombuffer(bytes.fromhex(snap["union"]), np.uint32)
+        if union.shape != self.union.shape:
+            raise ValueError(
+                f"snapshot union has {union.size} words, engine has "
+                f"{self.union.size} (COV_WORDS drift — not resumable)"
+            )
+        self.union = union.copy()  # frombuffer is read-only; decode ORs in place
+        self.coverage_curve = [int(v) for v in snap["coverage_curve"]]
+        self.corpus_curve = [int(v) for v in snap["corpus_curve"]]
+        self.violation_curve = [int(v) for v in snap["violation_curve"]]
+        self.corpus = [CorpusEntry.from_dict(d) for d in snap["corpus"]]
+        self._seen = {canon_genome(g) for g in snap["seen"]}
+        self._violated_seeds = {int(s) for s in snap["violated_seeds"]}
+        self.violations = [dict(v) for v in snap["violations"]]
+        for v in self.violations:
+            if v.get("candidate") is not None:
+                v["candidate"] = canon_genome(v["candidate"])
 
 
 # --------------------------------------------------------------------------
@@ -744,6 +949,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     )
     parser.add_argument("--no-pipeline", action="store_true")
     parser.add_argument("--out-dir", default=None)
+    parser.add_argument(
+        "--out", default=None, metavar="DIR",
+        help="write the report AND the corpus/checkpoint to DIR in the "
+        "campaign on-disk format (docs/campaign.md) — the one-shot run "
+        "becomes a campaign-importable, resumable artifact",
+    )
     parser.add_argument("--json", action="store_true", help="JSON line only")
     args = parser.parse_args(argv)
 
@@ -757,6 +968,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         log=None if args.json else lambda m: print(m, flush=True),
     )
     report = ex.run(args.dispatches)
+    if args.out:
+        from . import campaign
+
+        campaign.export_explorer(
+            args.out, ex,
+            workload_ref=campaign.named_workload_ref(
+                args.workload, args.virtual_secs, bool(args.storm)
+            ),
+        )
+        if not args.json:
+            print(f"checkpoint + corpus written to {args.out}", flush=True)
     if args.json:
         print(report.to_json(), flush=True)
     else:

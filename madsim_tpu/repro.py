@@ -149,7 +149,19 @@ def replay_device(
         f"device replay OK: seed {bundle.seed} violates at step {step}, "
         f"t={t_us}us, bit-identical across {max(1, repeats)} runs"
     )
-    return {"violated": True, "step": step, "t_us": t_us, "repeats": repeats}
+    rep = {"violated": True, "step": step, "t_us": t_us, "repeats": repeats}
+    if bundle.signature:
+        # campaign provenance (bundle schema v2): the dedup signature keys
+        # this bug class across seeds/campaigns — docs/campaign.md
+        provenance = ""
+        if bundle.campaign is not None:
+            provenance = f" (campaign {bundle.campaign}"
+            if bundle.generation is not None:
+                provenance += f", generation {bundle.generation}"
+            provenance += ")"
+        out(f"bug signature: {bundle.signature}{provenance}")
+        rep["signature"] = bundle.signature
+    return rep
 
 
 def replay_host(bundle: ReproBundle, out=print) -> Dict[str, Any]:

@@ -65,7 +65,10 @@ from .nemesis import (
     filter_schedule,
 )
 
-BUNDLE_FORMAT = "madsim-tpu-repro/1"
+# v2 adds the campaign provenance fields (signature/campaign/generation —
+# see madsim_tpu/campaign.py); v1 bundles read back with those defaulted
+BUNDLE_FORMAT = "madsim-tpu-repro/2"
+BUNDLE_FORMATS_READ = ("madsim-tpu-repro/1", BUNDLE_FORMAT)
 
 # an atom is (clause_name, occurrence k | None); k=None means the whole
 # clause (message-level clauses, skew, wipe, and legacy chaos knobs)
@@ -247,6 +250,11 @@ class ReproBundle:
     plan: dict  # shrunk FaultPlan (plan_to_json)
     trace_tail: List[str]
     format: str = BUNDLE_FORMAT
+    # -- v2: campaign provenance (None on bundles shrunk outside a
+    # campaign, and on every v1 bundle read back) --
+    signature: Optional[str] = None  # campaign.bug_signature dedup key
+    campaign: Optional[str] = None  # producing campaign id
+    generation: Optional[int] = None  # explorer generation that surfaced it
 
     # -- serialization --
 
@@ -257,15 +265,30 @@ class ReproBundle:
     def from_json(text: str) -> "ReproBundle":
         doc = json.loads(text)
         fmt = doc.get("format", "")
-        if fmt != BUNDLE_FORMAT:
+        if fmt not in BUNDLE_FORMATS_READ:
             raise ValueError(
-                f"unsupported bundle format {fmt!r} (want {BUNDLE_FORMAT!r})"
+                f"unsupported bundle format {fmt!r} "
+                f"(want one of {list(BUNDLE_FORMATS_READ)})"
             )
         fields = {f.name for f in dataclasses.fields(ReproBundle)}
         unknown = set(doc) - fields
         if unknown:
             raise ValueError(f"unknown bundle fields: {sorted(unknown)}")
+        # v1 bundles predate the campaign provenance fields; the dataclass
+        # defaults (None) fill them in. The format string is kept as read —
+        # it records what wrote the file, not what loaded it.
         return ReproBundle(**doc)
+
+    def stamp(
+        self, signature: str, campaign: Optional[str] = None,
+        generation: Optional[int] = None,
+    ) -> "ReproBundle":
+        """Attach campaign provenance (the dedup signature and where it
+        came from) in place; the caller re-saves. Returns self."""
+        self.signature = signature
+        self.campaign = campaign
+        self.generation = generation
+        return self
 
     def save(self, path: str) -> str:
         with open(path, "w") as f:

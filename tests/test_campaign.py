@@ -1,0 +1,736 @@
+"""Campaign mode: persistent corpus, coverage-signature bug dedup, and the
+fuzz-service front end (madsim_tpu/campaign).
+
+The subsystem's contract (docs/campaign.md):
+  * kill/resume bit-identity: a campaign checkpointed at generation k and
+    resumed for k' more produces the SAME `ExploreReport.fingerprint()` as
+    the uninterrupted k+k' run — in-process and cross-process;
+  * corpus merge + cmin minimization provably preserve the coverage union
+    (popcount AND exact array equality, asserted in campaign.py itself);
+  * bug dedup collapses a seed-dense planted bug to exactly one BugRecord
+    with N witness seeds, whose shrunk bundle replays green from the
+    regression corpus.
+
+`chaos`-marked tests are the campaign-smoke tier (`make campaign-smoke`);
+`slow`-marked cross-process/e2e runs go nightly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from madsim_tpu import campaign
+from madsim_tpu.explore import (
+    Candidate,
+    CorpusEntry,
+    Explorer,
+    ExploreReport,
+    canon_genome,
+)
+
+from tests.test_explore import _planted_workload
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+@pytest.fixture(scope="module")
+def planted():
+    """One compiled (triage+coverage) sim shared by every device test in
+    this module — search, resume, dedup-shrink and cmin replay all reuse
+    it (the lane_width=16 shrink programs compile separately, once)."""
+    from madsim_tpu.tpu.engine import BatchedSim
+
+    wl = _planted_workload()
+    sim = BatchedSim(wl.spec, wl.config, triage=True, coverage=True)
+    return wl, sim
+
+
+def _report(meta_seed=1, violations=()):
+    return ExploreReport(
+        meta_seed=meta_seed, lanes=4, dispatches=1, coverage_curve=[3],
+        corpus_curve=[1], violation_curve=[len(violations)],
+        violations=list(violations), coverage_bits=3, corpus_size=1,
+        seeds_run=4, first_violation_dispatch=None, wall_s=0.1,
+        device_dispatches=2, corpus_digest="00" * 32,
+    )
+
+
+# ------------------------------------------------------------- pure pieces
+
+
+def test_bug_signature_keys_on_minimal_plan_shape():
+    """The dedup key: clause profile of the shrunk plan — occurrence
+    INDICES excluded (seed-local), counts and whole-clause atoms kept."""
+    sig = campaign.bug_signature
+    # which crash window triggered it varies seed to seed; the shape
+    # "one partition occurrence + one crash occurrence" is the class
+    assert sig("raft", "invariant", [("partition", 3), ("crash", 1)]) == \
+        sig("raft", "invariant", [("crash", 7), ("partition", 0)])
+    assert sig("raft", "invariant", [("partition", 0)]) != \
+        sig("raft", "invariant", [("partition", 0), ("partition", 1)])
+    assert sig("raft", "invariant", []) != sig("kv", "invariant", [])
+    assert sig("raft", "invariant", [("loss", None)]) != \
+        sig("raft", "invariant", [("loss", 0)])
+    assert campaign.clause_profile(
+        [("crash", 2), ("crash", 5), ("loss", None)]
+    ) == [["crash", 2], ["loss", -1]]
+    # the coarse (pre-shrink) grouping key ignores the SEED, keeps the ctl
+    g1 = (3, 1, (0, 2, 0, 0), (1.0, 1.0, 1.0), 0)
+    g2 = (99, 1, (0, 2, 0, 0), (1.0, 1.0, 1.0), 0)
+    g3 = (3, 0, (0, 2, 0, 0), (1.0, 1.0, 1.0), 0)
+    assert campaign.coarse_key("raft", "invariant", g1) == \
+        campaign.coarse_key("raft", "invariant", g2)
+    assert campaign.coarse_key("raft", "invariant", g1) != \
+        campaign.coarse_key("raft", "invariant", g3)
+
+
+def test_bugrecord_roundtrip():
+    rec = campaign.BugRecord(
+        signature="s1", spec_name="raft", violation_kind="invariant",
+        clause_profile=[["partition", 1]],
+        witnesses=[{"seed": 3, "candidate": [3, 0, [0] * 4, [1.0] * 3, 0],
+                    "dispatch": 0, "origin": "fresh", "cov_digest": "ab"}],
+        bundle_path="/tmp/b.json", campaign="c1", first_generation=0,
+        coarse_keys=["coarse-xyz"],
+    )
+    again = campaign.BugRecord.from_dict(
+        json.loads(json.dumps(rec.to_dict()))
+    )
+    assert again == rec
+    assert again.witness_seeds == [3]
+    with pytest.raises(ValueError, match="unknown"):
+        campaign.BugRecord.from_dict({**rec.to_dict(), "bogus": 1})
+
+
+def test_checkpoint_roundtrip_pure(tmp_path):
+    """save_checkpoint/load_checkpoint are exact inverses on the snapshot
+    dict (manifest + jsonl split reassembles), with atomic writes."""
+    bitmap = np.arange(256, dtype=np.uint32)
+    snapshot = {
+        "meta_seed": 7, "lanes": 16, "meta_cursor": 42, "next_fresh": 33,
+        "generation": 2, "shrinks_done": 1, "seeds_run": 32,
+        "first_violation_dispatch": 1, "wall_s": 1.5,
+        "union": bitmap.tobytes().hex(),
+        "coverage_curve": [10, 20], "corpus_curve": [1, 2],
+        "violation_curve": [0, 1],
+        "corpus": [CorpusEntry(
+            cand=Candidate(seed=5, origin="swarm"), new_bits=10,
+            bitmap=bitmap, hiwater=3, transitions=9, violated=False,
+            dispatch=1,
+        ).to_dict()],
+        "seen": [[5, 0, [0, 0, 0, 0], [1.0, 1.0, 1.0], 0]],
+        "violated_seeds": [9],
+        "violations": [{"candidate": [9, 0, [0] * 4, [1.0] * 3, 0],
+                        "seed": 9, "dispatch": 1, "origin": "fresh",
+                        "describe": "seed=9", "bundle_path": None,
+                        "cov_digest": None}],
+    }
+    bugs = [campaign.BugRecord(
+        signature="s", spec_name="raft", violation_kind="invariant",
+        clause_profile=[], witnesses=[], bundle_path=None, campaign="c",
+        first_generation=1, coarse_keys=["k"],
+    )]
+    extra = {
+        "campaign_id": "c", "workload": {"kind": "custom"},
+        "config_hash": "h", "spec_name": "raft", "params": {"lanes": 16},
+        "seen_violations": 1, "kind": "campaign",
+    }
+    d = str(tmp_path / "ck")
+    campaign.save_checkpoint(d, snapshot, extra, bugs=bugs)
+    back = campaign.load_checkpoint(d)
+    assert back["manifest"]["campaign_id"] == "c"
+    assert back["manifest"]["state"]["meta_cursor"] == 42
+    assert json.loads(json.dumps(snapshot)) == back["snapshot"]
+    assert back["bugs"] == bugs
+    # no .tmp litter (atomic writes)
+    assert not [p for p in os.listdir(d) if ".tmp" in p]
+    # the manifest is the COMMIT POINT: sidecars are stamped with the
+    # generation PLUS a content digest (a re-checkpoint with different
+    # content never rewrites a committed manifest's files), and a new
+    # checkpoint garbage-collects stale files only after its manifest lands
+    import glob as globmod
+
+    man = json.load(open(os.path.join(d, campaign.MANIFEST)))
+    assert man["files"]["corpus"].startswith("corpus.2-")
+    snap3 = {**snapshot, "generation": 3}
+    campaign.save_checkpoint(d, snap3, extra, bugs=bugs)
+    assert not globmod.glob(os.path.join(d, "corpus.2-*"))
+    man3 = campaign.load_checkpoint(d)["manifest"]
+    assert man3["files"]["corpus"].startswith("corpus.3-")
+    # same generation, same content: identical names, still loadable;
+    # different content (a bug absorbed, no new generation): FRESH names,
+    # so a kill mid-save can never invalidate the committed manifest
+    campaign.save_checkpoint(d, snap3, extra, bugs=bugs)
+    assert campaign.load_checkpoint(d)["manifest"]["files"] == man3["files"]
+    campaign.save_checkpoint(d, snap3, extra, bugs=[])
+    man3b = campaign.load_checkpoint(d)["manifest"]
+    assert man3b["files"]["bugs"] != man3["files"]["bugs"]
+    # a torn checkpoint (sidecar not matching the manifest digest) fails
+    # LOUDLY — resuming it would silently fork the search
+    with open(os.path.join(d, man3b["files"]["seen"]), "a") as f:
+        f.write('{"genome": [1, 0, [0,0,0,0], [1.0,1.0,1.0], 0]}\n')
+    with pytest.raises(AssertionError, match="digest"):
+        campaign.load_checkpoint(d)
+    campaign.save_checkpoint(d, snap3, extra, bugs=bugs)  # heal
+    # and a bad format marker is refused
+    man = json.load(open(os.path.join(d, campaign.MANIFEST)))
+    man["format"] = "bogus/9"
+    json.dump(man, open(os.path.join(d, campaign.MANIFEST), "w"))
+    with pytest.raises(ValueError, match="format"):
+        campaign.load_checkpoint(d)
+
+
+def test_serve_queue_mechanics_with_stub_campaigns(tmp_path):
+    """The watch-dir protocol without a device: requests move queue/ ->
+    active/ -> done/, slices round-robin, one JSON line streams per slice,
+    checkpoints land between slices."""
+    d = str(tmp_path / "svc")
+    os.makedirs(os.path.join(d, "queue"))
+    events = []
+
+    class Stub:
+        def __init__(self, cid):
+            self.cid, self.generation, self.bugs = cid, 0, []
+
+        def run(self, g):
+            self.generation += g
+            events.append(("run", self.cid, self.generation))
+            return _report()
+
+        def checkpoint(self):
+            events.append(("ckpt", self.cid, self.generation))
+            os.makedirs(os.path.join(d, "campaigns", self.cid), exist_ok=True)
+
+    def factory(request, campaign_dir, regression_dir, log):
+        return Stub(request["id"])
+
+    for name, gens in (("a", 2), ("b", 1)):
+        with open(os.path.join(d, "queue", f"{name}.json"), "w") as f:
+            json.dump({"workload": "raft", "generations": gens}, f)
+    lines = []
+    res = campaign.serve(
+        d, slice_generations=1, max_rounds=5, idle_rounds=1,
+        out=lambda s: lines.append(json.loads(s)), factory=factory,
+        sleep=lambda s: None,
+    )
+    assert res["completed"] == ["b", "a"] and not res["pending"]
+    # round-robin: a and b interleave, b (1 gen) finishes first
+    assert [e for e in events if e[0] == "run"] == [
+        ("run", "a", 1), ("run", "b", 1), ("run", "a", 2),
+    ]
+    # every slice checkpointed BEFORE its report line streamed
+    assert events == [
+        ("run", "a", 1), ("ckpt", "a", 1), ("run", "b", 1),
+        ("ckpt", "b", 1), ("run", "a", 2), ("ckpt", "a", 2),
+    ]
+    slices = [l for l in lines if "report" in l]
+    assert [(l["campaign"], l["generation"]) for l in slices] == [
+        ("a", 1), ("b", 1), ("a", 2),
+    ]
+    assert all("fingerprint" in l for l in slices)
+    for name in ("a", "b"):
+        assert os.path.exists(os.path.join(d, "done", f"{name}.json"))
+        assert not os.path.exists(os.path.join(d, "queue", f"{name}.json"))
+        stream = campaign._read_jsonl(
+            os.path.join(d, "campaigns", name, campaign.REPORTS_STREAM)
+        )
+        assert [s["generation"] for s in stream] == (
+            [1, 2] if name == "a" else [1]
+        )
+
+
+def test_serve_survives_bad_requests(tmp_path):
+    """One tenant must never take the service down: malformed JSON is
+    retried then rejected to done/, non-positive generations and factory
+    failures are rejected immediately, and a campaign whose slice raises
+    is evicted while the other campaigns keep running."""
+    d = str(tmp_path / "svc")
+    os.makedirs(os.path.join(d, "queue"))
+
+    class Stub:
+        def __init__(self, cid, explode=False):
+            self.cid, self.generation, self.explode = cid, 0, explode
+            self.bugs = []
+
+        def run(self, g):
+            if self.explode:
+                raise RuntimeError("planted slice failure")
+            self.generation += g
+            return _report()
+
+        def checkpoint(self):
+            os.makedirs(os.path.join(d, "campaigns", self.cid), exist_ok=True)
+
+    def factory(request, campaign_dir, regression_dir, log):
+        if request["id"] == "unbuildable":
+            raise ValueError("unknown workload")
+        return Stub(request["id"], explode=request["id"] == "explodes")
+
+    reqs = {
+        "ok": {"workload": "raft", "generations": 1},
+        "explodes": {"workload": "raft", "generations": 2},
+        "unbuildable": {"workload": "nope", "generations": 1},
+        "zero": {"workload": "raft", "generations": 0},
+    }
+    for name, req in reqs.items():
+        with open(os.path.join(d, "queue", f"{name}.json"), "w") as f:
+            json.dump(req, f)
+    with open(os.path.join(d, "queue", "garbage.json"), "w") as f:
+        f.write("{not json")
+    lines = []
+    res = campaign.serve(
+        d, slice_generations=1, max_rounds=6, idle_rounds=2,
+        out=lambda s: lines.append(json.loads(s)), factory=factory,
+        sleep=lambda s: None,
+    )
+    assert res["completed"] == ["ok"] and not res["pending"]
+    rejected = {l["campaign"]: l["rejected"] for l in lines if "rejected" in l}
+    assert "generations" in rejected["zero"]
+    assert "unknown workload" in rejected["unbuildable"]
+    assert "planted slice failure" in rejected["explodes"]
+    assert any("unreadable request" in v for v in rejected.values())
+    # every request file ended up in done/, none left in queue/ or active/
+    for sub, want in (("queue", 0), ("active", 0), ("done", 5)):
+        assert len(os.listdir(os.path.join(d, sub))) == want, sub
+    # the good campaign still ran to completion
+    assert [(l["campaign"], l["generation"]) for l in lines
+            if "report" in l] == [("ok", 1)]
+
+
+def test_serve_crash_recovery_and_total_generation_semantics(tmp_path):
+    """A service restart requeues requests orphaned in active/, and
+    `generations` is the campaign's TOTAL target: a resumed campaign runs
+    only the remainder, an already-satisfied request completes without
+    running at all."""
+    d = str(tmp_path / "svc")
+    os.makedirs(os.path.join(d, "queue"))
+    os.makedirs(os.path.join(d, "active"))
+    runs = []
+
+    class Stub:
+        def __init__(self, cid, start_gen):
+            self.cid, self.generation, self.bugs = cid, start_gen, []
+
+        def run(self, g):
+            self.generation += g
+            runs.append((self.cid, self.generation))
+            return _report()
+
+        def checkpoint(self):
+            os.makedirs(os.path.join(d, "campaigns", self.cid), exist_ok=True)
+
+    start_gens = {"orphan": 3, "satisfied": 5}
+
+    def factory(request, campaign_dir, regression_dir, log):
+        return Stub(request["id"], start_gens[request["id"]])
+
+    # orphaned mid-flight by a killed service: checkpoint says gen 3 of 4
+    with open(os.path.join(d, "active", "orphan.json"), "w") as f:
+        json.dump({"workload": "raft", "generations": 4}, f)
+    # already past its total target
+    with open(os.path.join(d, "queue", "satisfied.json"), "w") as f:
+        json.dump({"workload": "raft", "generations": 2}, f)
+    lines = []
+    res = campaign.serve(
+        d, slice_generations=2, max_rounds=4, idle_rounds=1,
+        out=lambda s: lines.append(json.loads(s)), factory=factory,
+        sleep=lambda s: None,
+    )
+    assert sorted(res["completed"]) == ["orphan", "satisfied"]
+    # the orphan ran exactly its REMAINDER (1 gen, though the slice is 2)
+    assert runs == [("orphan", 4)]
+    assert any(
+        l.get("completed") and l["campaign"] == "satisfied"
+        and l["generation"] == 5 for l in lines
+    )
+    for name in ("orphan", "satisfied"):
+        assert os.path.exists(os.path.join(d, "done", f"{name}.json"))
+    assert not os.listdir(os.path.join(d, "active"))
+
+
+def test_serve_active_files_keyed_by_campaign_id(tmp_path):
+    """In-flight requests are parked as active/<campaign id>.json: a new
+    request REUSING a previous request's filename (but a distinct explicit
+    id) must not clobber the in-flight file of the first."""
+    d = str(tmp_path / "svc")
+    os.makedirs(os.path.join(d, "queue"))
+
+    class Stub:
+        def __init__(self, cid):
+            self.cid, self.generation, self.bugs = cid, 0, []
+
+        def run(self, g):
+            self.generation += g
+            return _report()
+
+        def checkpoint(self):
+            os.makedirs(os.path.join(d, "campaigns", self.cid), exist_ok=True)
+
+    def factory(request, campaign_dir, regression_dir, log):
+        return Stub(request["id"])
+
+    with open(os.path.join(d, "queue", "job.json"), "w") as f:
+        json.dump({"id": "a", "workload": "raft", "generations": 2}, f)
+    campaign.serve(
+        d, slice_generations=1, max_rounds=1, out=lambda s: None,
+        factory=factory, sleep=lambda s: None,
+    )
+    assert os.listdir(os.path.join(d, "active")) == ["a.json"]
+    # tenant B reuses the FILENAME while a is still in flight (service
+    # restarted: the orphan requeues under its id, so no name collision)
+    with open(os.path.join(d, "queue", "job.json"), "w") as f:
+        json.dump({"id": "b", "workload": "raft", "generations": 1}, f)
+    res = campaign.serve(
+        d, slice_generations=1, max_rounds=4, idle_rounds=1,
+        out=lambda s: None, factory=factory, sleep=lambda s: None,
+    )
+    assert sorted(res["completed"]) == ["a", "b"]
+    assert sorted(os.listdir(os.path.join(d, "done"))) == [
+        "a.json", "b.json",
+    ]
+    assert not os.listdir(os.path.join(d, "active"))
+
+
+def test_resume_conflict_check_only_covers_explicit_knobs():
+    """Resuming under explicitly different search parameters is refused;
+    omitted knobs (and chunk 0/null, the 'default' spelling) defer to the
+    checkpoint — so a service restart never rejects its own request."""
+    man = {
+        "params": {"meta_seed": 0, "lanes": 256, "chunk": 256},
+        "workload": {"kind": "named", "name": "raft",
+                     "virtual_secs": 2.0, "storm": True},
+    }
+    campaign.check_resume_conflicts(man, {})  # nothing explicit
+    campaign.check_resume_conflicts(
+        man, {"workload": "raft", "virtual_secs": 2.0, "meta_seed": 0,
+              "lanes": 256, "storm": True},
+    )
+    for given, what in (
+        ({"meta_seed": 5}, "meta_seed"),
+        ({"lanes": 64}, "lanes"),
+        ({"chunk": 8}, "chunk"),
+        ({"workload": "kv"}, "workload"),
+        ({"virtual_secs": 1.0}, "virtual_secs"),
+        ({"storm": False}, "storm"),
+    ):
+        with pytest.raises(ValueError, match=what):
+            campaign.check_resume_conflicts(man, given)
+    # the exact restart regression: a request that said chunk 0 ('use the
+    # default') must not be treated as pinning chunk=0
+    req = {"workload": "raft", "virtual_secs": 2.0, "chunk": 0,
+           "meta_seed": 0, "lanes": 256, "storm": True, "generations": 4}
+    given = campaign._explicit_request_params(req)
+    assert "chunk" not in given
+    campaign.check_resume_conflicts(man, given)
+    assert campaign._explicit_request_params({"chunk": 8})["chunk"] == 8
+
+
+def test_build_workload_raises_catchable_errors():
+    """build_workload is a LIBRARY call: an unknown workload name must be
+    a ValueError (the serve loop's per-request guard catches Exception),
+    not the SystemExit the explore CLI speaks — a SystemExit would kill
+    the whole multi-tenant service."""
+    with pytest.raises(ValueError, match="nosuch"):
+        campaign.build_workload(
+            {"kind": "named", "name": "nosuch", "virtual_secs": 1.0}
+        )
+    with pytest.raises(ValueError, match="custom"):
+        campaign.build_workload({"kind": "custom"})
+
+
+def test_regress_empty_dir_is_vacuously_green(tmp_path):
+    out = []
+    rep = campaign.regress(str(tmp_path / "nothing"), out=out.append)
+    assert rep["bundles"] == 0 and not rep["failures"]
+    assert "0/0" in out[-1]
+
+
+# ----------------------------------------------------------- device tests
+
+
+@pytest.mark.chaos
+def test_campaign_kill_resume_bit_identity_in_process(tmp_path, planted):
+    """The acceptance contract: checkpoint at generation 1, resume into a
+    FRESH Campaign/Explorer, run 2 more — the report fingerprints (and
+    curves, corpus digest, violations) equal the uninterrupted 3-gen run."""
+    wl, sim = planted
+    kw = dict(meta_seed=11, lanes=16, chunk=8, shrink=False, sim=sim)
+
+    full = campaign.Campaign(wl, str(tmp_path / "full"), **kw)
+    rep_full = full.run(3)
+
+    part = campaign.Campaign(wl, str(tmp_path / "part"), **kw)
+    part.run(1)
+    part.checkpoint()
+    del part  # the "kill": nothing in-memory survives but the checkpoint
+
+    resumed = campaign.Campaign.resume(
+        str(tmp_path / "part"), workload=wl, sim=sim
+    )
+    assert resumed.generation == 1
+    rep_res = resumed.run(2)
+
+    assert rep_res.fingerprint() == rep_full.fingerprint()
+    assert rep_res.coverage_curve == rep_full.coverage_curve
+    assert rep_res.corpus_curve == rep_full.corpus_curve
+    assert rep_res.corpus_digest == rep_full.corpus_digest
+    assert rep_res.violations == rep_full.violations
+    assert rep_res.seeds_run == rep_full.seeds_run == 48
+    # and the checkpoint survives ANOTHER round trip at generation 3
+    resumed.checkpoint()
+    again = campaign.Campaign.resume(
+        str(tmp_path / "part"), workload=wl, sim=sim
+    )
+    assert again.report().fingerprint() == rep_full.fingerprint()
+    # resuming under a different config is refused (hash check)
+    import dataclasses as dc
+
+    other = dc.replace(
+        wl, config=dc.replace(wl.config, horizon_us=wl.config.horizon_us + 1)
+    )
+    with pytest.raises(ValueError, match="config hash"):
+        campaign.Campaign.resume(str(tmp_path / "part"), workload=other)
+
+
+@pytest.mark.chaos
+def test_explore_out_exports_resumable_campaign(tmp_path, planted, monkeypatch, capsys):
+    """Satellite: `python -m madsim_tpu.explore --out DIR` writes the
+    campaign on-disk format; the one-shot run resumes as a campaign and
+    continues bit-identically."""
+    from madsim_tpu import explore
+
+    wl, sim = planted
+    # the CLI builds named workloads; point it at the planted one and
+    # reuse the compiled sim for the in-process Explorer
+    monkeypatch.setattr(explore, "_named_workload", lambda *a: wl)
+    orig_init = Explorer.__init__
+    monkeypatch.setattr(
+        Explorer, "__init__",
+        lambda self, *a, **k: orig_init(self, *a, **{**k, "sim": sim}),
+    )
+    out_dir = str(tmp_path / "oneshot")
+    explore.main([
+        "--workload", "raft", "--meta-seed", "11", "--lanes", "16",
+        "--chunk", "8", "--dispatches", "1", "--no-shrink", "--out",
+        out_dir, "--json",
+    ])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    cli_report = ExploreReport.from_json(line)
+    assert os.path.exists(os.path.join(out_dir, campaign.MANIFEST))
+    saved = campaign.load_report(out_dir)
+    assert saved.fingerprint() == cli_report.fingerprint()
+    # resume the one-shot artifact as a campaign; continuing 2 generations
+    # matches an uninterrupted 3-generation explorer bit-for-bit
+    c = campaign.Campaign.resume(out_dir, workload=wl, sim=sim)
+    rep = c.run(2)
+    ex = Explorer(
+        wl, meta_seed=11, lanes=16, chunk=8, shrink_violations=False,
+        sim=sim,
+    )
+    assert rep.fingerprint() == ex.run(3).fingerprint()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("meta_seeds", [(1, 2), (5, 9)])
+def test_corpus_merge_minimize_preserves_union(tmp_path, planted, meta_seeds):
+    """Merging independent campaigns' corpora and cmin-minimizing keeps
+    the coverage union EXACTLY (popcount + array equality — also asserted
+    inside campaign.minimize itself), keeps only admitted genomes, and
+    writes a reloadable merged corpus."""
+    from madsim_tpu.explore import popcount_rows
+
+    wl, sim = planted
+    dirs = []
+    unions = []
+    for ms in meta_seeds:
+        ex = Explorer(
+            wl, meta_seed=ms, lanes=16, chunk=8, shrink_violations=False,
+            sim=sim, first_seed=ms * 1000,
+        )
+        ex.run(2)
+        d = str(tmp_path / f"c{ms}")
+        campaign.export_explorer(d, ex, workload_ref={"kind": "custom"})
+        dirs.append(d)
+        unions.append(ex.union.copy())
+
+    entries, manifests = campaign.merge_corpora(dirs)
+    assert len({canon_genome(e.cand.key()) for e in entries}) == len(entries)
+    out_dir = str(tmp_path / "merged")
+    res = campaign.merge_and_minimize(
+        dirs, out_dir, workload=wl, sim=sim, lane_width=8
+    )
+    merged_union = unions[0] | unions[1]
+    merged_bits = int(popcount_rows(merged_union[None, :])[0])
+    assert res["merged_bits"] == merged_bits > 0
+    assert res["kept_bits"] == merged_bits
+    kept_union = np.zeros_like(merged_union)
+    for e in res["kept"]:
+        kept_union |= e.bitmap
+    assert np.array_equal(kept_union, merged_union)
+    assert 0 < len(res["kept"]) <= len(entries)
+    kept_genomes = {canon_genome(e.cand.key()) for e in res["kept"]}
+    assert kept_genomes <= {canon_genome(e.cand.key()) for e in entries}
+    # the merged corpus reloads to the same kept set, and refuses resume
+    reloaded = campaign.load_corpus(out_dir)
+    assert {canon_genome(e.cand.key()) for e in reloaded} == kept_genomes
+    with pytest.raises(ValueError, match="resume"):
+        campaign.Campaign.resume(out_dir, workload=wl, sim=sim)
+    # a tampered corpus entry is caught by its per-entry cov_digest...
+    doc = reloaded[0].to_dict()
+    doc["bitmap"] = ("%08x" % (int(doc["bitmap"][:8], 16) ^ 1)) + doc["bitmap"][8:]
+    with pytest.raises(ValueError, match="cov_digest"):
+        CorpusEntry.from_dict(doc)
+    # ...and a torn/hand-edited corpus FILE by the manifest's sha256
+    man = json.load(open(os.path.join(out_dir, campaign.MANIFEST)))
+    cpath = os.path.join(out_dir, man["files"]["corpus"])
+    with open(cpath) as f:
+        text = f.read()
+    with open(cpath, "w") as f:
+        f.write(text[:-2] + "\n")  # drop a byte: content no longer matches
+    with pytest.raises(AssertionError, match="digest"):
+        campaign.load_corpus(out_dir)
+
+
+@pytest.mark.chaos
+def test_dedup_collapses_seed_dense_planted_bug(tmp_path, planted):
+    """The acceptance contract: the seed-dense planted raft re-stamp bug
+    collapses to EXACTLY ONE BugRecord with >= 2 witness seeds; only the
+    first witness pays a shrink; the stamped bundle lands in the
+    regression corpus and replays green (printing its signature)."""
+    from madsim_tpu import triage
+
+    wl, sim = planted
+    reg = str(tmp_path / "reg")
+    c = campaign.Campaign(
+        wl, str(tmp_path / "camp"), meta_seed=0, lanes=64, chunk=64,
+        shrink=True, max_shrinks=4, lane_width=16, sim=sim,
+        regression_dir=reg,
+        spec_ref="tests.test_triage:planted_restamp_spec",
+        # pure fresh generations: every violation shares the default-ctl
+        # coarse group, which is exactly the seed-dense regime dedup is for
+        explorer_kwargs={"fresh_frac": 1.0, "mutant_frac": 0.0},
+    )
+    for _ in range(4):
+        c.run(1)
+        if c.bugs and len(c.bugs[0].witnesses) >= 2:
+            break
+    assert c.bugs, "planted bug not found in 256 fresh seeds"
+    assert len(c.bugs) == 1, (
+        f"seed-dense bug split into {len(c.bugs)} records: "
+        f"{[(b.signature[:12], b.clause_profile) for b in c.bugs]}"
+    )
+    bug = c.bugs[0]
+    assert len(bug.witnesses) >= 2
+    assert len(set(bug.witness_seeds)) == len(bug.witnesses)
+    assert bug.shrink_error is None
+    assert bug.clause_profile, "shrunk profile empty yet chaos-dependent?"
+    # only the first witness was shrunk (the whole point of dedup)
+    assert c._shrinks_done == 1
+    # every witness carries its own coverage digest (per-seed evidence;
+    # distinct trajectories => the digests need not coincide)
+    assert all(w["cov_digest"] for w in bug.witnesses)
+    # bundle: stamped with signature + campaign provenance, in both dirs
+    assert bug.bundle_path and os.path.exists(bug.bundle_path)
+    bundle = triage.ReproBundle.load(bug.bundle_path)
+    assert bundle.signature == bug.signature
+    assert bundle.campaign == c.campaign_id
+    assert bundle.generation == bug.first_generation
+    reg_path = os.path.join(reg, os.path.basename(bug.bundle_path))
+    assert os.path.exists(reg_path)
+    # checkpoint -> resume keeps the dedup state (no re-shrink, same record)
+    c.checkpoint()
+    c2 = campaign.Campaign.resume(
+        str(tmp_path / "camp"), workload=wl, sim=sim, regression_dir=reg
+    )
+    assert [b.signature for b in c2.bugs] == [bug.signature]
+    assert c2._shrinks_done == 1
+    assert c2.bugs[0].witness_seeds == bug.witness_seeds
+    # regression replay: green, and the signature is printed (repro v2)
+    printed = []
+    rep = campaign.regress(reg, spec=wl.spec, out=printed.append)
+    assert rep["bundles"] == 1 and not rep["failures"]
+    assert any(bug.signature in line for line in printed)
+
+
+@pytest.mark.chaos
+def test_serve_end_to_end_runs_and_checkpoints_real_campaign(tmp_path, planted):
+    """The service loop over a REAL campaign (planted workload via a
+    custom factory reusing the module's compiled sim): accepts the queued
+    request, streams a fingerprinted report line per slice, checkpoints
+    between slices, finishes the request — and the checkpointed state
+    equals a direct 2-generation campaign's."""
+    wl, sim = planted
+    d = str(tmp_path / "svc")
+    os.makedirs(os.path.join(d, "queue"))
+
+    def factory(request, campaign_dir, regression_dir, log):
+        return campaign.Campaign(
+            wl, campaign_dir, meta_seed=11, lanes=16, chunk=8,
+            shrink=False, sim=sim, campaign_id=request["id"],
+            regression_dir=regression_dir,
+        )
+
+    with open(os.path.join(d, "queue", "job1.json"), "w") as f:
+        json.dump({"workload": "planted", "generations": 2}, f)
+    lines = []
+    res = campaign.serve(
+        d, slice_generations=1, max_rounds=4, idle_rounds=1,
+        out=lambda s: lines.append(json.loads(s)), factory=factory,
+        sleep=lambda s: None,
+    )
+    assert res["completed"] == ["job1"]
+    slices = [l for l in lines if "report" in l]
+    assert [l["generation"] for l in slices] == [1, 2]
+    # the streamed lines reload as reports, fingerprint intact
+    for l in slices:
+        assert ExploreReport.from_dict(l["report"]).fingerprint() == \
+            l["fingerprint"]
+    # the time-sliced, checkpointed-every-slice service run equals one
+    # uninterrupted 2-generation campaign bit-for-bit
+    direct = campaign.Campaign(
+        wl, str(tmp_path / "direct"), meta_seed=11, lanes=16, chunk=8,
+        shrink=False, sim=sim,
+    )
+    assert slices[-1]["fingerprint"] == direct.run(2).fingerprint()
+    # resume-from-service-checkpoint continues cleanly
+    c = campaign.Campaign.resume(
+        os.path.join(d, "campaigns", "job1"), workload=wl, sim=sim
+    )
+    assert c.generation == 2
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_campaign_cross_process_kill_resume(tmp_path):
+    """Cross-process acceptance: run 2 generations in one process, resume
+    for 2 more in a SECOND process, compare the fingerprint against a
+    third process's uninterrupted 4-generation run."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=f"/tmp/madsim_tpu_jaxcache-{os.getuid()}",
+    )
+
+    def run_cli(dir, gens):
+        proc = subprocess.run(
+            [sys.executable, "-m", "madsim_tpu.campaign", "run",
+             "--dir", str(dir), "--workload", "raft",
+             "--virtual-secs", "0.5", "--meta-seed", "3", "--lanes", "8",
+             "--generations", str(gens), "--no-shrink", "--json"],
+            capture_output=True, text=True, timeout=580, env=env, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    a1 = run_cli(tmp_path / "resumed", 2)
+    assert a1["generation"] == 2
+    a2 = run_cli(tmp_path / "resumed", 2)  # same dir: resumes
+    assert a2["generation"] == 4
+    b = run_cli(tmp_path / "straight", 4)
+    assert b["generation"] == 4
+    assert a2["fingerprint"] == b["fingerprint"]
+    assert a2["report"]["coverage_curve"] == b["report"]["coverage_curve"]

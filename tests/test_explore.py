@@ -109,6 +109,44 @@ def test_cov_index_mirrors_engine_hash_shape():
     assert popcount_rows(np.asarray([[0b1011, 0]], np.uint32)).tolist() == [3]
 
 
+def test_explore_report_json_roundtrip_preserves_fingerprint():
+    """The campaign checkpoint/service contract: a report reloaded from
+    its JSON line fingerprints identically (tuple->list collapse is
+    canonicalized away) and compares field-for-field."""
+    from madsim_tpu.explore import ExploreReport
+
+    rep = ExploreReport(
+        meta_seed=11, lanes=16, dispatches=3,
+        coverage_curve=[40, 61, 61], corpus_curve=[3, 5, 5],
+        violation_curve=[0, 1, 2],
+        violations=[{
+            "candidate": (9, 2, (0, 0b101, 0, 0), (1.0, 0.5, 1.0), 0),
+            "seed": 9, "origin": "mutant", "describe": "[mutant] seed=9",
+            "dispatch": 1, "bundle_path": "/tmp/x.json",
+            "cov_digest": "ab" * 32,
+        }],
+        coverage_bits=61, corpus_size=5, seeds_run=48,
+        first_violation_dispatch=1, wall_s=1.25, device_dispatches=6,
+        corpus_digest="feed" * 16,
+    )
+    again = ExploreReport.from_json(rep.to_json())
+    assert again.fingerprint() == rep.fingerprint()
+    # candidate genomes come back in the canonical in-memory tuple form
+    assert again.violations == rep.violations
+    assert again.to_dict()["coverage_curve"] == rep.coverage_curve
+    # a second round trip is a fixed point
+    assert ExploreReport.from_json(again.to_json()).fingerprint() == \
+        rep.fingerprint()
+    with pytest.raises(ValueError, match="unknown"):
+        ExploreReport.from_dict({**rep.to_dict(), "bogus": 1})
+    # MetaRng state face: (seed, counter) IS the whole state
+    r = MetaRng(5)
+    draws = [r.u32() for _ in range(6)]
+    resumed = MetaRng(5, counter=4)
+    assert resumed.counter == 4
+    assert [resumed.u32(), resumed.u32()] == draws[4:]
+
+
 def test_occurrence_fires_parses_summary_keys():
     from madsim_tpu.tpu.nemesis import occurrence_fires
 

@@ -193,6 +193,42 @@ def test_bundle_json_roundtrip_and_validation(tmp_path):
         triage.ReproBundle(**doc).config()
 
 
+def test_bundle_v1_backcompat_and_v2_stamp(tmp_path):
+    """Schema v2 (campaign provenance): a v1 bundle — no signature/
+    campaign/generation keys, format .../1 — still loads, with the new
+    fields defaulted; a stamped v2 bundle round-trips them."""
+    from madsim_tpu.tpu import SimConfig
+
+    cfg = SimConfig(horizon_us=2_000_000)
+    bundle = triage.ReproBundle(
+        seed=42, spec_ref=None, spec_kwargs={}, spec_name="raft5",
+        n_nodes=5, config_toml=cfg.to_toml(), config_hash=cfg.hash(),
+        violation_kind="invariant", violation_step=17,
+        violation_t_us=123_456, dropped_clauses=[], occ_off={},
+        rate_scale={}, horizon_us=130_000, max_steps=10_000,
+        plan=triage.plan_to_json(SCHED_PLAN), trace_tail=[],
+    )
+    doc = json.loads(bundle.to_json())
+    # fabricate the v1 on-disk shape: old format marker, no v2 fields
+    for key in ("signature", "campaign", "generation"):
+        del doc[key]
+    doc["format"] = "madsim-tpu-repro/1"
+    v1 = triage.ReproBundle.from_json(json.dumps(doc))
+    assert v1.signature is None and v1.campaign is None
+    assert v1.generation is None
+    assert v1.format == "madsim-tpu-repro/1"  # provenance is preserved
+    assert v1.seed == 42 and v1.config() == cfg
+    # v2 stamp round-trip
+    bundle.stamp("sigdeadbeef", campaign="c1", generation=3)
+    path = tmp_path / "v2.json"
+    bundle.save(str(path))
+    again = triage.ReproBundle.load(str(path))
+    assert again.format == triage.BUNDLE_FORMAT
+    assert (again.signature, again.campaign, again.generation) == (
+        "sigdeadbeef", "c1", 3,
+    )
+
+
 def test_filtered_schedule_drops_whole_occurrence_windows():
     evs = SCHED_PLAN.schedule(11, HORIZON_US, 5)
     crash_ks = sorted({e.k for e in evs if e.kind in ("crash", "restart")})

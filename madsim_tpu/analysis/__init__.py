@@ -1,0 +1,157 @@
+"""Static verifier: jaxpr-level determinism/purity rules + source linter.
+
+Every subsystem in this repo (nemesis, triage, explorer, campaign) rests
+on invariants that were, until now, enforced only by example-based twin
+tests: the single-RNG funnel (every draw a pure function of seed +
+occurrence index), host/device mirror completeness, schedule purity, and
+the r8 narrow-dtype/donation discipline. The FoundationDB/TigerBeetle DST
+lineage argues these should be *checked mechanically* — one un-mirrored
+clause or one stray host callback silently breaks bit-exact replay for
+every campaign checkpoint downstream. This package checks them:
+
+  Layer 1 — jaxpr verifier (`jaxpr_check.py`): traces each workload's
+  actual donated `_step_split` program (chaos + triage + coverage on)
+  and walks the closed jaxpr / lowered StableHLO. Rules: `callbacks`,
+  `rng-taint`, `donation`, `dtype`, `lane-independence`.
+
+  Layer 2 — source/mirror linter (`lint.py`): AST + introspection over
+  the tree. Rules: `ambient-entropy`, `mirror`, `both-faces`,
+  `layout-agreement`, `marker-hygiene`.
+
+Run it:  `python -m madsim_tpu.analysis [--all] [--workload NAME]`
+         (`make lint` = source rules, `make analyze` = everything).
+Each run emits a summary JSON (rule -> pass/fail/violation count) so
+rule counts can be tracked like a coverage metric across BENCH rounds.
+Rule catalog, allowlists, and the `# madsim: allow(<rule>)` suppression
+pragma: docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+SCHEMA = "madsim-tpu-analysis/1"
+
+# Layer-1 (per-workload, jaxpr) and Layer-2 (tree-wide, source) rules.
+JAXPR_RULES = (
+    "callbacks", "rng-taint", "donation", "dtype", "lane-independence",
+)
+LINT_RULES = (
+    "ambient-entropy", "mirror", "both-faces", "layout-agreement",
+    "marker-hygiene",
+)
+ALL_RULES = JAXPR_RULES + LINT_RULES
+
+WORKLOADS = ("raft", "kv", "paxos", "twopc", "chain")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule violation: where it is and what it breaks."""
+
+    rule: str
+    where: str  # file:line, workload:leaf, or registry face
+    detail: str
+
+    def render(self) -> str:
+        return f"[{self.rule}] {self.where}: {self.detail}"
+
+
+@dataclasses.dataclass
+class RuleResult:
+    rule: str
+    checked: int = 0  # units examined (eqns, files, clauses, tests, ...)
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def add(self, where: str, detail: str) -> None:
+        self.violations.append(Violation(self.rule, where, detail))
+
+
+def merge_results(results: Sequence[RuleResult]) -> Dict[str, RuleResult]:
+    """Fold per-workload results for the same rule into one row."""
+    out: Dict[str, RuleResult] = {}
+    for r in results:
+        cur = out.setdefault(r.rule, RuleResult(r.rule))
+        cur.checked += r.checked
+        cur.violations.extend(r.violations)
+    return out
+
+
+def summary_json(
+    results: Sequence[RuleResult], workloads: Sequence[str]
+) -> Dict[str, Any]:
+    """The per-run summary (satellite: rule -> pass/fail/violation count,
+    trackable like a coverage metric by a future BENCH round)."""
+    merged = merge_results(results)
+    rules = {
+        name: {
+            "status": "pass" if r.ok else "fail",
+            "violations": len(r.violations),
+            "checked": r.checked,
+        }
+        for name, r in sorted(merged.items())
+    }
+    return {
+        "schema": SCHEMA,
+        # an empty rule set is NOT a pass: silent no-coverage must never
+        # read as "covered everything"
+        "ok": bool(merged) and all(r.ok for r in merged.values()),
+        "workloads": list(workloads),
+        "rules": rules,
+        "violation_details": [
+            dataclasses.asdict(v)
+            for r in merged.values()
+            for v in r.violations
+        ],
+    }
+
+
+def run_analysis(
+    workloads: Sequence[str] = (),
+    lint: bool = True,
+    root: Optional[str] = None,
+    log=print,
+) -> Dict[str, Any]:
+    """Run the selected rule set; returns the summary JSON dict.
+
+    `workloads` names the Layer-1 targets (jaxpr rules trace each one's
+    real step program); `lint` toggles the Layer-2 source rules. The
+    lint tier never TRACES anything, but its mirror/layout faces do
+    import jax (compile_plan / the raft spec), so `make lint` costs a
+    few seconds; only workload runs pay for tracing."""
+    results: List[RuleResult] = []
+    if lint:
+        from . import lint as lint_mod
+
+        results.extend(lint_mod.run_source_lints(root=root, log=log))
+    for name in workloads:
+        from . import jaxpr_check
+
+        results.extend(jaxpr_check.verify_workload(name, log=log))
+    return summary_json(results, workloads)
+
+
+def render_summary(summary: Dict[str, Any]) -> str:
+    lines = []
+    for name, row in summary["rules"].items():
+        mark = "ok " if row["status"] == "pass" else "FAIL"
+        lines.append(
+            f"  {mark} {name:<18} checked {row['checked']:>5}  "
+            f"violations {row['violations']}"
+        )
+    for v in summary["violation_details"]:
+        lines.append(f"    -> [{v['rule']}] {v['where']}: {v['detail']}")
+    lines.append("ANALYSIS " + ("PASS" if summary["ok"] else "FAIL"))
+    return "\n".join(lines)
+
+
+def write_summary(summary: Dict[str, Any], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+        f.write("\n")

@@ -1,0 +1,83 @@
+"""CLI: `python -m madsim_tpu.analysis` — the static verifier entry point.
+
+    python -m madsim_tpu.analysis                 # source lints only (fast)
+    python -m madsim_tpu.analysis --workload raft # + jaxpr rules for raft
+    python -m madsim_tpu.analysis --all           # lints + all 5 workloads
+    python -m madsim_tpu.analysis --all --json out.json
+
+Exit status 0 iff every rule passed. A summary JSON (rule ->
+pass/fail/violation count) is always printed with --json-line and written
+with --json PATH, so rule counts can be tracked like a coverage metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from . import WORKLOADS, render_summary, run_analysis, write_summary
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m madsim_tpu.analysis",
+        description=(
+            "jaxpr-level determinism/purity verifier + source-level "
+            "invariant linter (docs/analysis.md)"
+        ),
+    )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="run the jaxpr rules over all five workloads (plus the lints)",
+    )
+    parser.add_argument(
+        "--workload", action="append", default=[], metavar="NAME",
+        help=f"jaxpr-verify one workload (choices: {', '.join(WORKLOADS)}; "
+        "repeatable)",
+    )
+    parser.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the source-level lints (jaxpr rules only)",
+    )
+    parser.add_argument(
+        "--json", metavar="PATH", default=None,
+        help="write the summary JSON to PATH",
+    )
+    parser.add_argument(
+        "--json-line", action="store_true",
+        help="print the summary as one JSON line instead of the table",
+    )
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    workloads = list(args.workload)
+    if args.all:
+        workloads = list(WORKLOADS)
+    for w in workloads:
+        if w not in WORKLOADS:
+            parser.error(
+                f"unknown workload {w!r} (choose from {', '.join(WORKLOADS)})"
+            )
+    if args.no_lint and not workloads:
+        parser.error(
+            "--no-lint without --all/--workload selects zero rules — "
+            "nothing would be verified"
+        )
+
+    log = None if (args.quiet or args.json_line) else print
+    summary = run_analysis(
+        workloads=workloads, lint=not args.no_lint, log=log
+    )
+    if args.json:
+        write_summary(summary, args.json)
+    if args.json_line:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print(render_summary(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

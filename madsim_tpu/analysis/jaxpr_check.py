@@ -1,0 +1,590 @@
+"""Layer 1: jaxpr-level determinism/purity rules over the real step program.
+
+For each workload this module builds the ACTUAL sweep configuration the
+fuzzer runs — every nemesis clause enabled (crash+wipe, partition, clog,
+spike, loss, dup, reorder, skew), the buggify straggler tail on, triage
+ctl threaded, coverage instrumented — and traces the donated
+`_step_split` program abstractly (ShapeDtypeStructs; no device compute,
+no XLA compile). Five rules walk the closed jaxpr / lowered StableHLO:
+
+  callbacks          no host-sync primitive anywhere in the step (a
+                     single io_callback/debug.print re-serializes every
+                     chunked dispatch on the host and is invisible in
+                     tests that only check values).
+  rng-taint          (a) schedule purity: any murmur mix touched by
+                     `key0` taint must see NOTHING but key0 and the
+                     occurrence counters — fault schedules stay pure
+                     functions of (seed, clause, k), the invariant
+                     `FaultPlan.schedule` mirrors. (b) funnel
+                     containment: the per-step key chain's own update
+                     must derive from the key alone — protocol state
+                     must never leak INTO the RNG funnel carry.
+                     (Handler draws keyed off the step chain may fold
+                     event identity — e.g. twopc's per-tid vote coin —
+                     that is per-seed deterministic and allowed.)
+  donation           the hot+cold carry is fully donated/aliased in the
+                     lowered program and ConstState leaves never are;
+                     plus the structural split: const = {key0, ctl,
+                     skew_ppm} exactly, and the `_run` while-loop carry
+                     is hot+cold only (key0 leaking back into the carry
+                     is the regression the r8 split can silently lose).
+  dtype              narrow_fields leaves hold their declared at-rest
+                     dtype across the loop carry, time_fields stay i32,
+                     and NO float arithmetic touches a time-typed value
+                     (the integer-ppm skew bug as a checked rule class).
+  lane-independence  no reduction over the lane (batch) axis inside the
+                     step outside the allowlist — lanes must stay
+                     embarrassingly parallel or sharded sweeps and
+                     chunking stop being bit-identical.
+
+All rules fail loudly with leaf/eqn names. Allowlists and suppression:
+docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import RuleResult
+from .jaxprutil import (
+    CALLBACK_PRIMS,
+    KEY,
+    KEY2,
+    SALT,
+    STATE,
+    TIME,
+    TaintMap,
+    aval_sig,
+    backward_invars,
+    donated_arg_flags,
+    find_while_eqns,
+    is_mix_mul,
+    iter_eqns,
+    reduced_axes,
+    while_carry_avals,
+    while_const_avals,
+)
+
+# default lane count for abstract tracing: a small prime that no
+# structural dimension (node count, pool slots, payload width, clause
+# rows) uses, so "shape[0] == LANES" identifies the lane axis reliably
+LANES = 13
+
+# occurrence counters: the ONLY non-key values a schedule draw may touch
+NEUTRAL_LEAVES = frozenset({
+    "hot.nem.crash_k", "hot.nem.part_k", "hot.nem.clog_k",
+    "hot.nem.spike_k",
+})
+KEY0_LEAVES = frozenset({"const.key0"})
+KEYCHAIN_LEAVES = frozenset({"hot.key"})
+
+# time-typed leaves (virtual-us offsets): the operands the integer-ppm
+# rule guards — float arithmetic on any of these loses microseconds
+TIME_LEAF_NAMES = frozenset({
+    "hot.clock", "hot.timer", "hot.chaos_at", "hot.part_at",
+    "hot.msgs.deliver", "hot.strag.deliver",
+    "hot.nem.clog_at", "hot.nem.spike_at",
+    "cold.violation_at", "const.ctl.h_off",
+})
+
+
+def full_fault_plan():
+    """Every clause kind at once: the maximal step program (what a storm
+    campaign actually compiles; any rule that holds here holds for every
+    subset config, which compiles strictly less machinery)."""
+    from .. import nemesis as nem
+
+    return nem.FaultPlan(
+        name="analysis-full",
+        clauses=(
+            nem.Crash(wipe_rate=0.3),
+            nem.Partition(),
+            nem.LinkClog(),
+            nem.LatencySpike(),
+            nem.MsgLoss(rate=0.05),
+            nem.Duplicate(rate=0.05),
+            nem.Reorder(rate=0.1, window_us=50_000),
+            nem.ClockSkew(max_ppm=50_000),
+        ),
+    )
+
+
+def spec_factories() -> Dict[str, object]:
+    from ..tpu.chain import make_chain_spec
+    from ..tpu.kv import make_kv_spec
+    from ..tpu.paxos import make_paxos_spec
+    from ..tpu.raft import make_raft_spec
+    from ..tpu.twopc import make_twopc_spec
+
+    return {
+        "raft": make_raft_spec,
+        "kv": make_kv_spec,
+        "paxos": make_paxos_spec,
+        "twopc": make_twopc_spec,
+        "chain": make_chain_spec,
+    }
+
+
+def build_verified_sim(name: str, lanes: int = LANES):
+    """(sim, state, hot, cold, const) — all abstract (ShapeDtypeStructs).
+
+    `state` is the eval_shape of the real `_init`; hot/cold/const the
+    real `split_state` partition. Nothing touches a device."""
+    from ..tpu import nemesis as tpun
+    from ..tpu.engine import BatchedSim, split_state
+    from ..tpu.spec import SimConfig
+
+    factories = spec_factories()
+    if name not in factories:
+        raise ValueError(
+            f"unknown workload {name!r} (choose from {sorted(factories)})"
+        )
+    spec = factories[name]()
+    cfg = tpun.compile_plan(
+        full_fault_plan(),
+        SimConfig(
+            horizon_us=2_000_000,
+            loss_rate=0.05,
+            buggify_delay_rate=0.01,  # straggler side pool in the program
+        ),
+    )
+    sim = BatchedSim(spec, cfg, triage=True, coverage=True)
+    seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
+    state = jax.eval_shape(sim._init, seeds)
+    hot, cold, const = split_state(state)
+    return sim, state, hot, cold, const
+
+
+def _leaf_names(hot, cold, const) -> List[str]:
+    from ..tpu.engine import named_leaves
+
+    return (
+        [n for n, _ in named_leaves(hot, "hot")]
+        + [n for n, _ in named_leaves(cold, "cold")]
+        + [n for n, _ in named_leaves(const, "const")]
+    )
+
+
+def _time_leaves(sim) -> Set[str]:
+    names = set(TIME_LEAF_NAMES)
+    for f in sim.spec.time_fields:
+        names.add(f"hot.node.{f}")
+    return names
+
+
+def _invar_masks(names: Sequence[str], time_leaves: Set[str]) -> List[int]:
+    masks = []
+    for n in names:
+        if n in KEY0_LEAVES:
+            masks.append(KEY)
+        elif n in KEYCHAIN_LEAVES:
+            masks.append(KEY2)
+        elif n in NEUTRAL_LEAVES:
+            masks.append(0)
+        elif n in time_leaves:
+            masks.append(STATE | TIME)
+        else:
+            masks.append(STATE)
+    return masks
+
+
+# ------------------------------------------------------------------- rules
+
+
+def check_callbacks(closed, where: str = "step") -> RuleResult:
+    """No host-sync primitives anywhere in the program."""
+    res = RuleResult("callbacks")
+    for eqn, depth in iter_eqns(closed.jaxpr):
+        res.checked += 1
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMS or "callback" in name:
+            res.add(
+                where,
+                f"host-sync primitive `{name}` at nesting depth {depth} — "
+                "the jitted step must never round-trip to the host",
+            )
+    return res
+
+
+def check_rng_taint(
+    closed,
+    invar_names: Sequence[str],
+    time_leaves: Set[str],
+    where: str = "step",
+    key_out_index: Optional[int] = None,
+    salt_values: Sequence[int] = (),
+) -> RuleResult:
+    """Schedule purity + funnel containment over the murmur mix eqns."""
+    res = RuleResult("rng-taint")
+    masks = _invar_masks(invar_names, time_leaves)
+    # taint per mix eqn is ACCUMULATED across visits and judged after the
+    # walk: loop bodies are re-propagated to a fixpoint, so the taint a
+    # mix sees can GROW on pass >= 2 — gating on first visit would throw
+    # the later, larger mask away and miss carry-borne violations
+    mix_taint: Dict[int, Tuple[object, int, object]] = {}
+    tm = TaintMap(closed, masks, salt_values=salt_values)
+
+    def visit(eqn, read):
+        if not is_mix_mul(eqn):
+            return
+        m = 0
+        for iv in eqn.invars:
+            m |= read(iv)
+        prev = mix_taint.get(id(eqn))
+        if prev is not None:
+            m |= prev[1]
+        # witness via the enclosing TOP-LEVEL eqn: an offending mix
+        # inside an inline-jitted helper still names real leaves
+        mix_taint[id(eqn)] = (eqn, m, tm.top_eqn)
+
+    tm.run(visit)
+    res.checked += len(mix_taint)
+    flagged = [
+        (eqn, m, top)
+        for eqn, m, top in mix_taint.values()
+        if (m & KEY) and (m & (STATE | TIME | KEY2 | SALT))
+    ]
+    for eqn, m, top in flagged:
+        src = top if top is not None else eqn
+        hits = backward_invars(closed.jaxpr, list(src.invars))
+        offenders = [
+            invar_names[i]
+            for i in hits
+            if masks[i] & (STATE | TIME)
+        ][:6]
+        res.add(
+            where,
+            "schedule-purity violation: a key0-rooted draw mixes "
+            f"non-schedule material (taint {m:#x}; reaches "
+            f"{offenders or ['<literal/chain>']}) — fault schedules must "
+            "be pure functions of (seed, clause, occurrence)",
+        )
+    if key_out_index is not None:
+        ov = closed.jaxpr.outvars[key_out_index]
+        m = tm.read(ov)
+        res.checked += 1
+        if m & (STATE | TIME | SALT | KEY):
+            res.add(
+                where,
+                f"RNG funnel contaminated: the step's key-chain update "
+                f"carries taint {m:#x} (expected the chain key alone) — "
+                "protocol/config state must never feed the PRNG carry",
+            )
+    return res
+
+
+def check_dtype(
+    closed,
+    sim,
+    hot,
+    out_template,
+    invar_names: Sequence[str],
+    where: str = "step",
+) -> RuleResult:
+    """Narrow at-rest dtypes across the carry + no float-on-time math."""
+    res = RuleResult("dtype")
+    h2 = out_template[0]
+    narrow = dict(sim.spec.narrow_fields or {})
+    for f, dt in narrow.items():
+        res.checked += 1
+        want = str(jnp.dtype(dt))
+        got_in = str(getattr(hot.node, f).dtype)
+        got_out = str(getattr(h2.node, f).dtype)
+        if got_in != want:
+            res.add(
+                where,
+                f"node.{f} enters the carry as {got_in}, declared {want}",
+            )
+        if got_out != want:
+            res.add(
+                where,
+                f"node.{f} leaves the step as {got_out}, declared {want} — "
+                "the at-rest narrowing was silently widened in the carry",
+            )
+    for f in sim.spec.time_fields:
+        res.checked += 1
+        got = str(getattr(h2.node, f).dtype)
+        if got != "int32":
+            res.add(
+                where,
+                f"time field node.{f} is {got} in the carry — time-typed "
+                "values must stay i32 (epoch-rebased offsets)",
+            )
+
+    # float-on-time: forward TIME taint; any floating-dtype output of an
+    # eqn with a TIME-tainted operand is the f32-skew bug class
+    time_leaves = _time_leaves(sim)
+    masks = _invar_masks(invar_names, time_leaves)
+    hits: List[Tuple[object, str]] = []
+
+    def visit(eqn, read):
+        tainted = any(read(iv) & TIME for iv in eqn.invars)
+        if not tainted:
+            return
+        for ov in eqn.outvars:
+            dt = getattr(ov.aval, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating):
+                hits.append((eqn, str(dt)))
+
+    TaintMap(closed, masks).run(visit)
+    res.checked += 1
+    for eqn, dt in hits:
+        res.add(
+            where,
+            f"float arithmetic on a time-typed value: `{eqn.primitive.name}`"
+            f" -> {dt} with TIME-tainted input — f32 loses integer "
+            "microseconds past 2^24 us; use exact int math "
+            "(scale_delay_ppm)",
+        )
+    return res
+
+
+def check_lane_independence(
+    closed,
+    lanes: int = LANES,
+    where: str = "step",
+    allow: Sequence[str] = (),
+) -> RuleResult:
+    """No reduction over the lane axis anywhere in the step.
+
+    A reduced/contracted/sorted dimension of size `lanes` is flagged in
+    ANY axis position (not just axis 0): `lanes` is chosen as a small
+    prime no structural dimension uses, so a transposed lane axis is
+    still caught. dot_general is checked on BOTH contracted operands.
+    `allow` names primitives permitted to cross lanes (empty by default:
+    decode-side reductions live in `_summary_reduction`, outside the
+    step)."""
+    res = RuleResult("lane-independence")
+    allowed = set(allow)
+    for eqn, depth in iter_eqns(closed.jaxpr):
+        entries = reduced_axes(eqn)
+        if not entries:
+            continue
+        res.checked += 1
+        for shape, axes in entries:
+            hit = [
+                a for a in axes if a < len(shape) and shape[a] == lanes
+            ]
+            if not hit:
+                continue
+            if eqn.primitive.name in allowed:
+                continue
+            res.add(
+                where,
+                f"cross-lane reduction: `{eqn.primitive.name}` over axis "
+                f"{hit[0]} of {shape} (the lane-sized dim) at depth {depth}"
+                " — lanes must stay independent for sharding/chunking "
+                "bit-identity",
+            )
+            break
+    return res
+
+
+def check_step_donation(
+    step_fn,
+    hot,
+    cold,
+    const,
+    hot_names: Sequence[str],
+    cold_names: Sequence[str],
+    const_names: Sequence[str],
+    where: str = "step",
+    res: Optional[RuleResult] = None,
+) -> RuleResult:
+    """Lower `step_fn(hot, cold, const)` with the carry donated and assert
+    every hot+cold leaf is aliased to an output while no const leaf is."""
+    res = res or RuleResult("donation")
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    text = step.lower(hot, cold, const).as_text()
+    flags = donated_arg_flags(text)
+    names = list(hot_names) + list(cold_names) + list(const_names)
+    res.checked += len(names)
+    for i, n in enumerate(names):
+        donated = flags.get(i, False)
+        is_const = n.startswith("const.")
+        if not is_const and not donated:
+            res.add(
+                where,
+                f"carry leaf {n} is NOT donated/aliased in the lowered "
+                "step — the sweep would allocate a second copy of it per "
+                "dispatch segment",
+            )
+        if is_const and donated:
+            # unreachable under current jax semantics (const is outside
+            # donate_argnums here, and only donated args get aliasing
+            # attributes) — kept as a sanity check of that lowering
+            # assumption; the load-bearing const protection is the
+            # while-carry check (check_run_carry) + the structural split
+            res.add(
+                where,
+                f"ConstState leaf {n} IS donated/aliased — loop-invariant "
+                "operands must never rotate through the donation",
+            )
+    return res
+
+
+def check_run_carry(
+    closed_run,
+    hot,
+    cold,
+    const,
+    where: str = "run",
+    res: Optional[RuleResult] = None,
+) -> RuleResult:
+    """The sweep's while-loop carry must be hot+cold (+counter) exactly,
+    with every const leaf entering as a loop-invariant operand."""
+    from ..tpu.engine import named_leaves
+
+    res = res or RuleResult("donation")
+    res.checked += 1
+    whiles = find_while_eqns(closed_run.jaxpr)
+    if not whiles:
+        res.add(where, "no while_loop found — sweep structure changed?")
+        return res
+    weqn = whiles[0]
+    got = sorted(aval_sig(a) for a in while_carry_avals(weqn))
+    want = sorted(
+        [aval_sig(x) for _, x in named_leaves(hot)]
+        + [aval_sig(x) for _, x in named_leaves(cold)]
+        + [((), "int32")]  # the loop counter
+    )
+    if got != want:
+        from collections import Counter
+
+        extra = Counter(got) - Counter(want)
+        missing = Counter(want) - Counter(got)
+        res.add(
+            where,
+            "while-loop carry != hot+cold (+counter): extra "
+            f"{sorted(extra.elements())}, missing {sorted(missing.elements())}"
+            " — a ConstState leaf leaked into (or a carry leaf fell out "
+            "of) the sweep carry",
+        )
+    cdict: Dict[Tuple, int] = {}
+    for a in while_const_avals(weqn):
+        sig = aval_sig(a)
+        cdict[sig] = cdict.get(sig, 0) + 1
+    for n, x in named_leaves(const, "const"):
+        sig = aval_sig(x)
+        if cdict.get(sig, 0) <= 0:
+            res.add(
+                where,
+                f"{n} is not a loop-invariant operand of the sweep "
+                "while-loop (missing from the body consts)",
+            )
+        else:
+            cdict[sig] -= 1
+    return res
+
+
+def check_donation(sim, state, hot, cold, const, where: str = "step") -> RuleResult:
+    """Donated/aliased carry coverage + the hot/cold/const structural split."""
+    from ..tpu.engine import carry_partition
+
+    res = RuleResult("donation")
+    # the engine's own introspection hook IS the name source: if the
+    # split and the hook ever disagree, this rule is checking the wrong
+    # partition and should fail loudly with it
+    part = carry_partition(state)
+    hot_names = [f"hot.{n}" for n in part["hot"]]
+    cold_names = [f"cold.{n}" for n in part["cold"]]
+    const_names = [f"const.{n}" for n in part["const"]]
+
+    # (1) structural split: const is exactly key0 + ctl (+ skew_ppm)
+    res.checked += 1
+    if sim.triage and not any(n.startswith("const.ctl.") for n in const_names):
+        res.add(where, "TriageCtl leaves missing from ConstState")
+    if "const.key0" not in const_names:
+        res.add(
+            where,
+            "key0 is not in ConstState — if it rides the carry, donation "
+            "rotates the schedule root through fresh buffers every segment",
+        )
+    for n in ("key0", "ctl"):
+        leaked = [
+            h for h in hot_names + cold_names
+            if h.split(".", 1)[1].startswith(n)
+        ]
+        if leaked:
+            res.add(where, f"loop-invariant leaf leaked into the carry: {leaked}")
+
+    # (2) lowered donation flags on the real _step_split program
+    check_step_donation(
+        lambda h, c, k: sim._step_split(h, c, k),
+        hot, cold, const, hot_names, cold_names, const_names, where, res,
+    )
+
+    # (3) the production `_run` while-loop carries hot+cold ONLY
+    run_fn = getattr(type(sim)._run, "__wrapped__", None)
+    if run_fn is not None:
+        closed_run = jax.make_jaxpr(lambda st: run_fn(sim, st, 8))(state)
+    else:  # trace through the jitted wrapper (shows up as a pjit eqn)
+        closed_run = jax.make_jaxpr(lambda st: sim._run(st, 8))(state)
+    check_run_carry(closed_run, hot, cold, const, where, res)
+    return res
+
+
+# --------------------------------------------------------------- entry
+
+
+def verify_workload(
+    name: str, lanes: int = LANES, log=print
+) -> List[RuleResult]:
+    """Trace workload `name`'s real step program and run every jaxpr rule.
+
+    All five rules share ONE abstract trace of `_step_split` (the
+    lane-width reuse trick: a small fixed lane count keeps tracing
+    seconds-fast and identifies the lane axis unambiguously)."""
+    from ..tpu.engine import COV_SALT, named_leaves
+
+    if log:
+        log(f"[analysis] tracing {name} step program (L={lanes}) ...")
+    sim, state, hot, cold, const = build_verified_sim(name, lanes=lanes)
+    closed = jax.make_jaxpr(sim._step_split)(hot, cold, const)
+    out_template = jax.eval_shape(sim._step_split, hot, cold, const)
+    names = _leaf_names(hot, cold, const)
+    time_leaves = _time_leaves(sim)
+    # outvar index of the step's key-chain update (h2.key)
+    h2_names = [n for n, _ in named_leaves(out_template[0], "hot")]
+    key_out = h2_names.index("hot.key")
+
+    where = f"{name}:_step_split"
+    results = [
+        check_callbacks(closed, where),
+        check_rng_taint(
+            closed, names, time_leaves, where,
+            key_out_index=key_out, salt_values=(COV_SALT,),
+        ),
+        check_dtype(closed, sim, hot, out_template, names, where),
+        check_lane_independence(closed, lanes, where),
+        check_donation(sim, state, hot, cold, const, f"{name}:_run"),
+    ]
+    # init runs once per sweep but draws the schedule roots: callbacks +
+    # purity hold there too (seeds are the key root at init)
+    seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
+    closed_init = jax.make_jaxpr(sim._init)(seeds)
+    init_names = ["const.key0"] + [
+        f"const.ctl.{i}" for i in range(len(closed_init.jaxpr.invars) - 1)
+    ]
+    results.append(check_callbacks(closed_init, f"{name}:_init"))
+    results.append(
+        check_rng_taint(
+            closed_init,
+            init_names[: len(closed_init.jaxpr.invars)],
+            set(),
+            f"{name}:_init",
+            salt_values=(COV_SALT,),
+        )
+    )
+    if log:
+        bad = sum(len(r.violations) for r in results)
+        log(
+            f"[analysis] {name}: {len(closed.jaxpr.eqns)} step eqns, "
+            f"{bad} violations"
+        )
+    return results

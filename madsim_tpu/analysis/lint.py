@@ -1,0 +1,822 @@
+"""Layer 2: source-level invariant linter (AST + registry introspection).
+
+Five rules over the tree itself — the invariants that live BETWEEN files,
+where no single test's assertions can see them:
+
+  ambient-entropy    no wall-clock / ambient-entropy calls (`time.time`,
+                     `random.*`, `np.random.*`, `os.urandom`, `secrets`,
+                     `uuid.uuid4`, `datetime.now`) inside `madsim_tpu/`
+                     outside the allowlist: `core/interpose.py` (the
+                     patcher that VIRTUALIZES these inside sims) and
+                     `real/` (wall-clock mode by definition). Measurement
+                     clocks (`time.perf_counter`/`monotonic`) are allowed
+                     — they never feed simulation state. Suppress a
+                     deliberate use with `# madsim: allow(ambient-entropy)`.
+  mirror             every fault clause exists on all three faces — pure
+                     schedule, host NemesisDriver, device `nem_*` knobs —
+                     cross-checked against the enumerable registries in
+                     `madsim_tpu/nemesis.py` (SCHEDULE_CLAUSES,
+                     MESSAGE_CLAUSES, CLAUSE_EVENT_KINDS, ...).
+  both-faces         every field folded into the device coverage bitmap
+                     is also folded by the pure trace mirror
+                     (`explore.cov_index`), counted against the
+                     `engine.COV_FIELDS` registry — the rule behind every
+                     recorded cov_digest staying replayable.
+  layout-agreement   the LAYOUT dtype table in tests/test_state_layout.py
+                     agrees with the raft spec's `narrow_fields` in both
+                     directions.
+  marker-hygiene     tests flagged long-running (by name pattern or a
+                     `~Ns` runtime note in their docstring) carry
+                     slow/deep/chaos markers — tier-1 runs `-m 'not
+                     slow'` under an 870 s budget, and an unmarked slow
+                     test is a time bomb.
+
+All file/line findings honor the inline pragma
+`# madsim: allow(<rule>)` on the offending line or the line above.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import RuleResult
+
+PRAGMA_RE = re.compile(r"#\s*madsim:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+# files (repo-relative, forward slashes) exempt from ambient-entropy
+ENTROPY_ALLOWED_FILES = (
+    "madsim_tpu/core/interpose.py",  # the virtualization layer itself
+)
+ENTROPY_ALLOWED_DIRS = (
+    "madsim_tpu/real/",  # real-socket/wall-clock mode by definition
+)
+
+# long-running test-name indicators (marker-hygiene)
+LONG_NAME_RE = re.compile(
+    r"(?:^|_)(?:soak|cross_process|fresh_runtimes?|two_hour|acceptance)"
+    r"(?:_|$)"
+)
+# "~45 s"-style runtime note in a test docstring
+RUNTIME_NOTE_RE = re.compile(r"[~≈]\s*(\d+)\s*s\b")
+RUNTIME_NOTE_FLOOR_S = 30
+HYGIENE_MARKS = {"slow", "deep", "chaos"}
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _read(path: str) -> Tuple[str, List[str]]:
+    with open(path, "r") as f:
+        src = f.read()
+    return src, src.splitlines()
+
+
+def _pragma_allows(lines: List[str], lineno: int, rule: str) -> bool:
+    """True if line `lineno` (1-based) or the line above carries
+    `# madsim: allow(<rule>)` naming this rule."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = PRAGMA_RE.search(lines[ln - 1])
+            if m and rule in [s.strip() for s in m.group(1).split(",")]:
+                return True
+    return False
+
+
+def _py_files(root: str, rel: str) -> List[str]:
+    out = []
+    base = os.path.join(root, rel)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+# ------------------------------------------------------------ ambient entropy
+
+
+def check_entropy_file(path: str, root: str = "") -> RuleResult:
+    """Scan one python file for wall-clock/ambient-entropy calls."""
+    res = RuleResult("ambient-entropy")
+    rel = os.path.relpath(path, root).replace(os.sep, "/") if root else path
+    src, lines = _read(path)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        res.add(f"{rel}:{e.lineno}", f"unparseable: {e.msg}")
+        return res
+
+    mod_alias: Dict[str, str] = {}  # local name -> stdlib module
+    direct: Dict[str, str] = {}  # local name -> dotted origin (forbidden)
+    dt_class: Set[str] = set()  # `from datetime import datetime` aliases
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                top = a.name.split(".")[0]
+                if a.name == "numpy.random" and a.asname:
+                    # `import numpy.random as npr`: npr IS the rng module
+                    mod_alias[a.asname] = "numpy.random"
+                elif top in (
+                    "time", "random", "os", "secrets", "uuid", "datetime",
+                    "numpy",
+                ):
+                    mod_alias[a.asname or top] = top
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                local = a.asname or a.name
+                if mod == "time" and a.name in ("time", "time_ns"):
+                    direct[local] = f"time.{a.name}"
+                elif mod == "os" and a.name == "urandom":
+                    direct[local] = "os.urandom"
+                elif mod == "random":
+                    direct[local] = f"random.{a.name}"
+                elif mod == "secrets":
+                    direct[local] = f"secrets.{a.name}"
+                elif mod == "uuid" and a.name in ("uuid1", "uuid4"):
+                    direct[local] = f"uuid.{a.name}"
+                elif mod == "datetime" and a.name in ("datetime", "date"):
+                    dt_class.add(local)
+                elif mod == "numpy" and a.name == "random":
+                    mod_alias[local] = "numpy.random"
+                elif mod == "numpy.random":
+                    direct[local] = f"numpy.random.{a.name}"
+
+    def chain_of(func) -> List[str]:
+        parts: List[str] = []
+        while isinstance(func, ast.Attribute):
+            parts.append(func.attr)
+            func = func.value
+        if isinstance(func, ast.Name):
+            parts.append(func.id)
+        else:
+            return []
+        return parts[::-1]
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        res.checked += 1
+        bad: Optional[str] = None
+        ch = chain_of(node.func)
+        if ch:
+            root_mod = mod_alias.get(ch[0])
+            dotted = ".".join(ch)
+            if root_mod == "time" and ch[-1] in ("time", "time_ns"):
+                bad = dotted
+            elif root_mod == "random" and len(ch) >= 2:
+                bad = dotted
+            elif root_mod == "numpy" and len(ch) >= 3 and ch[1] == "random":
+                bad = dotted
+            elif root_mod == "numpy.random" and len(ch) >= 2:
+                bad = dotted
+            elif root_mod == "os" and ch[-1] == "urandom":
+                bad = dotted
+            elif root_mod == "secrets" and len(ch) >= 2:
+                bad = dotted
+            elif root_mod == "uuid" and ch[-1] in ("uuid1", "uuid4"):
+                bad = dotted
+            elif root_mod == "datetime" and ch[-1] in (
+                "now", "utcnow", "today"
+            ):
+                bad = dotted
+            elif len(ch) == 2 and ch[0] in dt_class and ch[1] in (
+                "now", "utcnow", "today"
+            ):
+                bad = dotted
+            elif len(ch) == 1 and ch[0] in direct:
+                bad = direct[ch[0]]
+        if bad is None:
+            continue
+        if _pragma_allows(lines, node.lineno, "ambient-entropy"):
+            continue
+        res.add(
+            f"{rel}:{node.lineno}",
+            f"ambient entropy / wall clock: `{bad}` — simulation behavior "
+            "must derive from the seed; suppress a deliberate use with "
+            "`# madsim: allow(ambient-entropy)`",
+        )
+    return res
+
+
+def check_entropy(root: Optional[str] = None) -> RuleResult:
+    root = root or repo_root()
+    res = RuleResult("ambient-entropy")
+    for path in _py_files(root, "madsim_tpu"):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if rel in ENTROPY_ALLOWED_FILES:
+            continue
+        if any(rel.startswith(d) for d in ENTROPY_ALLOWED_DIRS):
+            continue
+        one = check_entropy_file(path, root)
+        res.checked += one.checked
+        res.violations.extend(one.violations)
+    return res
+
+
+# ----------------------------------------------------------------- both-faces
+
+
+def _ordered_stmts(body: Iterable[ast.stmt]) -> Iterable[ast.stmt]:
+    """Statements in source order, descending into compound statements."""
+    for st in body:
+        yield st
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(st, attr, None)
+            if sub:
+                yield from _ordered_stmts(sub)
+        for h in getattr(st, "handlers", []) or []:
+            yield from _ordered_stmts(h.body)
+
+
+def _find_function(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == name:
+                return node
+    return None
+
+
+def _word_ident(node: ast.AST) -> str:
+    """The folded-field identifier of a fold call's word argument."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - ancient AST nodes
+        return "<expr>"
+
+
+def fold_chain_fields(
+    fn: ast.AST, fold_names: Set[str], salt_name: str = "COV_SALT"
+) -> List[str]:
+    """The SEQUENCE of field identifiers folded into the salt-rooted hash
+    chain inside a function: the seed fold (whose first argument mentions
+    `salt_name`) contributes its word argument, each subsequent
+    `x = fold(x, field)` appends its word. Comparing sequences (not
+    counts) catches a field SUBSTITUTED on one face, not just added."""
+    chains: Dict[str, List[str]] = {}
+    best: List[str] = []
+    for st in _ordered_stmts(fn.body):
+        if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+            continue
+        tgt = st.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        call = st.value
+        if not isinstance(call, ast.Call) or len(call.args) < 2:
+            continue
+        fname = None
+        if isinstance(call.func, ast.Attribute):
+            fname = call.func.attr
+        elif isinstance(call.func, ast.Name):
+            fname = call.func.id
+        if fname not in fold_names:
+            continue
+        arg0 = call.args[0]
+        word = _word_ident(call.args[1])
+        mentions_salt = any(
+            isinstance(n, ast.Name) and n.id == salt_name
+            for n in ast.walk(arg0)
+        )
+        if mentions_salt:
+            chains[tgt.id] = [word]
+        elif isinstance(arg0, ast.Name) and arg0.id in chains:
+            chains[tgt.id] = chains[arg0.id] + [word]
+        else:
+            continue
+        if len(chains[tgt.id]) >= len(best):
+            best = list(chains[tgt.id])
+    return best
+
+
+def registry_cov_fields(engine_src: str) -> Optional[List[str]]:
+    """COV_FIELDS names parsed from engine.py source (no import needed)."""
+    tree = ast.parse(engine_src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "COV_FIELDS":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    return [
+                        e.value
+                        for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+    return None
+
+
+def check_both_faces(
+    engine_path: Optional[str] = None,
+    mirror_path: Optional[str] = None,
+    engine_fn: str = "_step_traced",
+    mirror_fn: str = "cov_index",
+    root: Optional[str] = None,
+) -> RuleResult:
+    """Device coverage-hash chain == trace-mirror chain == COV_FIELDS."""
+    root = root or repo_root()
+    engine_path = engine_path or os.path.join(
+        root, "madsim_tpu", "tpu", "engine.py"
+    )
+    mirror_path = mirror_path or os.path.join(root, "madsim_tpu", "explore.py")
+    res = RuleResult("both-faces")
+    eng_src, _ = _read(engine_path)
+    mir_src, _ = _read(mirror_path)
+    dev_fn = _find_function(ast.parse(eng_src), engine_fn)
+    mir_fn_node = _find_function(ast.parse(mir_src), mirror_fn)
+    if dev_fn is None:
+        res.add(engine_path, f"device face function {engine_fn} not found")
+        return res
+    if mir_fn_node is None:
+        res.add(mirror_path, f"mirror face function {mirror_fn} not found")
+        return res
+    dev = fold_chain_fields(dev_fn, {"fold"})
+    mir = fold_chain_fields(mir_fn_node, {"fold", "fold32"})
+    reg = registry_cov_fields(eng_src)
+    res.checked += 3
+    if not dev:
+        res.add(
+            f"{engine_path}:{engine_fn}",
+            "no COV_SALT-rooted fold chain found on the device face",
+        )
+    if not mir:
+        res.add(
+            f"{mirror_path}:{mirror_fn}",
+            "no COV_SALT-rooted fold chain found on the trace mirror",
+        )
+    if dev and mir and len(dev) != len(mir):
+        res.add(
+            f"{engine_fn} vs {mirror_fn}",
+            f"coverage hash folds {len(dev)} fields on the device face "
+            f"({dev}) but {len(mir)} on the trace mirror ({mir}) — a "
+            "field hashed on one face only desyncs every recorded "
+            "cov_digest (the both-faces rule)",
+        )
+    # each face's i-th folded identifier must NAME the registered field
+    # (substring match: the device face uses e.g. `src_w` for `src`) —
+    # comparing the sequences, not just the counts, catches a field
+    # SUBSTITUTED on one face
+    if reg:
+        for label, seq in (("device face", dev), ("trace mirror", mir)):
+            if not seq:
+                continue
+            if len(seq) != len(reg):
+                res.add(
+                    f"{engine_fn if label == 'device face' else mirror_fn}"
+                    " vs COV_FIELDS",
+                    f"{label} folds {len(seq)} fields ({seq}) but "
+                    f"COV_FIELDS registers {len(reg)} ({reg}) — update "
+                    "the registry with the new field",
+                )
+                continue
+            for i, (got, want) in enumerate(zip(seq, reg)):
+                if want not in got:
+                    res.add(
+                        f"COV_FIELDS[{i}]",
+                        f"{label} folds `{got}` where the registry names "
+                        f"`{want}` — a substituted hash field desyncs "
+                        "recorded cov_digests exactly like an added one",
+                    )
+    # the mirror must consume BOTH event faces of the trace
+    body_names = {
+        n.attr for n in ast.walk(mir_fn_node) if isinstance(n, ast.Attribute)
+    }
+    mirror_module = ast.parse(mir_src)
+    bft = _find_function(mirror_module, "bitmap_from_trace")
+    if bft is not None:
+        body_names |= {
+            n.attr for n in ast.walk(bft) if isinstance(n, ast.Attribute)
+        }
+    res.checked += 1
+    for field in ("msg_fired", "timer_fired"):
+        if field not in body_names:
+            res.add(
+                f"{mirror_path}",
+                f"trace mirror never reads `{field}` — one event face of "
+                "the coverage encoding is unmirrored",
+            )
+    return res
+
+
+# --------------------------------------------------------------------- mirror
+
+
+def check_mirror(
+    schedule_clauses: Optional[Dict[str, type]] = None,
+    message_clauses: Optional[Dict[str, type]] = None,
+    assign_clauses: Optional[Dict[str, type]] = None,
+    event_kinds: Optional[Dict[str, Tuple[str, ...]]] = None,
+    driver_source: Optional[str] = None,
+    root: Optional[str] = None,
+) -> RuleResult:
+    """Every clause exists on all three faces (schedule/host/device).
+
+    Parameters exist for fixture injection; by default the real
+    registries, driver source, and compile_plan are checked."""
+    from .. import nemesis as nem
+
+    res = RuleResult("mirror")
+    root = root or repo_root()
+    schedule_clauses = (
+        nem.SCHEDULE_CLAUSES if schedule_clauses is None else schedule_clauses
+    )
+    message_clauses = (
+        nem.MESSAGE_CLAUSES if message_clauses is None else message_clauses
+    )
+    assign_clauses = (
+        nem.ASSIGN_CLAUSES if assign_clauses is None else assign_clauses
+    )
+    event_kinds = (
+        nem.CLAUSE_EVENT_KINDS if event_kinds is None else event_kinds
+    )
+
+    all_named = {**schedule_clauses, **message_clauses, **assign_clauses}
+
+    # (a) registry completeness vs the clause type universe
+    res.checked += 1
+    registered = set(all_named.values())
+    universe = set(nem._CLAUSE_TYPES)
+    for cls in sorted(universe - registered, key=lambda c: c.__name__):
+        res.add(
+            "nemesis registries",
+            f"clause type {cls.__name__} is not in SCHEDULE_CLAUSES / "
+            "MESSAGE_CLAUSES / ASSIGN_CLAUSES — the verifier cannot prove "
+            "its mirrors exist",
+        )
+    for cls in sorted(registered - universe, key=lambda c: c.__name__):
+        res.add(
+            "nemesis registries",
+            f"registered clause {cls.__name__} is not a FaultPlan clause "
+            "type",
+        )
+
+    # (b) vocabulary agreement with the triage/occurrence tables
+    res.checked += 1
+    if set(schedule_clauses) != set(nem.OCC_CLAUSES):
+        res.add(
+            "nemesis registries",
+            f"SCHEDULE_CLAUSES {sorted(schedule_clauses)} != OCC_CLAUSES "
+            f"{sorted(nem.OCC_CLAUSES)} — occurrence masks and schedule "
+            "clauses must share one vocabulary",
+        )
+    if set(message_clauses) != set(nem.RATE_CLAUSES):
+        res.add(
+            "nemesis registries",
+            f"MESSAGE_CLAUSES {sorted(message_clauses)} != RATE_CLAUSES "
+            f"{sorted(nem.RATE_CLAUSES)}",
+        )
+    missing_triage = (
+        set(all_named) - set(nem.TRIAGE_CLAUSES)
+    )
+    if missing_triage:
+        res.add(
+            "nemesis registries",
+            f"clauses {sorted(missing_triage)} have no TRIAGE_CLAUSES atom "
+            "— they cannot be shrunk out of a repro",
+        )
+
+    # (c) event-kind tables are mutually inverse
+    res.checked += 1
+    windowed = {**schedule_clauses, **assign_clauses}
+    for name in windowed:
+        kinds = event_kinds.get(name)
+        if not kinds:
+            res.add(
+                "CLAUSE_EVENT_KINDS",
+                f"clause {name!r} has no registered event kinds",
+            )
+            continue
+        for k in kinds:
+            owner = nem.CLAUSE_OF_EVENT.get(k)
+            if owner != name:
+                res.add(
+                    "CLAUSE_OF_EVENT",
+                    f"event kind {k!r} maps to {owner!r}, expected {name!r}",
+                )
+
+    # (d) host driver face: NemesisDriver handles every event kind
+    driver_src = driver_source
+    if driver_src is None:
+        driver_src, _ = _read(os.path.join(root, "madsim_tpu", "nemesis.py"))
+    tree = ast.parse(driver_src)
+    apply_fn = _find_function(tree, "_apply")
+    install_fn = _find_function(tree, "install")
+    handled: Set[str] = set()
+    for fn in (apply_fn, install_fn):
+        if fn is None:
+            continue
+        # standalone string statements (docstrings, prose) must NOT count
+        # as handling — a kind surviving only in a docstring after its
+        # code was deleted is exactly the regression this rule hunts
+        prose_ids = {
+            id(node.value)
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        }
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in prose_ids
+            ):
+                handled.add(node.value)
+    res.checked += 1
+    for name, kinds in sorted(event_kinds.items()):
+        if name not in windowed and name not in all_named:
+            continue
+        for k in kinds:
+            if k not in handled:
+                res.add(
+                    "NemesisDriver",
+                    f"host driver never handles event kind {k!r} (clause "
+                    f"{name!r}) — the device face would fire it unmirrored",
+                )
+
+    # (e) device + schedule faces per clause (single-clause plans)
+    from ..tpu import nemesis as tpun
+    from ..tpu.spec import SimConfig
+
+    base = SimConfig()
+    for name, cls in sorted(schedule_clauses.items()):
+        res.checked += 1
+        try:
+            plan = nem.FaultPlan(clauses=(cls(),), name=f"lint-{name}")
+        except Exception as e:  # fixture clause types may not construct
+            res.add(name, f"clause {cls.__name__} not constructible: {e}")
+            continue
+        enabled_prop = f"nem_{name}_enabled"
+        if not hasattr(SimConfig, enabled_prop):
+            res.add(
+                "SimConfig",
+                f"no `{enabled_prop}` switch — schedule clause {name!r} has "
+                "no device face",
+            )
+            continue
+        cfg = tpun.compile_plan(plan, base)
+        if not getattr(cfg, enabled_prop):
+            res.add(
+                "compile_plan",
+                f"compiling a {cls.__name__} plan leaves {enabled_prop} "
+                "False — the device face ignores the clause",
+            )
+        evs = plan.schedule(seed=1, horizon_us=60_000_000, n_nodes=5)
+        got_kinds = {e.kind for e in evs}
+        want = set(event_kinds.get(name, ()))
+        if not got_kinds:
+            res.add(
+                "plan_schedule",
+                f"single-clause {cls.__name__} plan produced no schedule "
+                "events over a 60 s horizon",
+            )
+        elif want and not got_kinds <= want:
+            res.add(
+                "plan_schedule",
+                f"clause {name!r} emitted kinds {sorted(got_kinds - want)} "
+                "outside its registered event kinds",
+            )
+        if want and evs and event_kinds[name][0] not in got_kinds:
+            res.add(
+                "plan_schedule",
+                f"clause {name!r} never emitted its open-half kind "
+                f"{event_kinds[name][0]!r}",
+            )
+        for fk in nem.CLAUSE_FIRE_KINDS.get(name, ()):
+            if fk not in nem.FIRE_KINDS:
+                res.add(
+                    "FIRE_KINDS",
+                    f"fire kind {fk!r} (clause {name!r}) missing from "
+                    "FIRE_KINDS",
+                )
+    for name, cls in sorted(message_clauses.items()):
+        res.checked += 1
+        cfg = tpun.compile_plan(
+            nem.FaultPlan(clauses=(cls(),), name=f"lint-{name}"), base
+        )
+        knob = f"nem_{name}_rate"
+        if getattr(cfg, knob, 0) <= 0:
+            res.add(
+                "compile_plan",
+                f"compiling a {cls.__name__} plan leaves {knob} at 0 — no "
+                "device face",
+            )
+    for name, cls in sorted(assign_clauses.items()):
+        res.checked += 1
+        plan = nem.FaultPlan(clauses=(cls(),), name=f"lint-{name}")
+        cfg = tpun.compile_plan(plan, base)
+        if name == "skew":
+            if not cfg.nem_skew_enabled:
+                res.add("compile_plan", "ClockSkew plan leaves skew disabled")
+            if not any(plan.skew_ppm(3, 5)):
+                res.add(
+                    "plan.skew_ppm",
+                    "ClockSkew plan assigns zero ppm everywhere for seed 3",
+                )
+    return res
+
+
+# ----------------------------------------------------------- layout agreement
+
+
+def parse_layout_table(src: str) -> Dict[str, Optional[str]]:
+    """{leaf name -> declared dtype string (None entries preserved)} from
+    the LAYOUT literal in tests/test_state_layout.py (pure AST; the test
+    module is never imported)."""
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "LAYOUT" and isinstance(
+                node.value, ast.Dict
+            ):
+                out: Dict[str, Optional[str]] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if not (
+                        isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    ):
+                        continue
+                    if isinstance(v, ast.Constant) and v.value is None:
+                        out[k.value] = None
+                    elif isinstance(v, (ast.Tuple, ast.List)) and v.elts:
+                        first = v.elts[0]
+                        if isinstance(first, ast.Constant) and isinstance(
+                            first.value, str
+                        ):
+                            out[k.value] = first.value
+                return out
+    raise ValueError("LAYOUT table not found")
+
+
+_NARROW_DTYPES = {"uint8", "int8", "uint16", "int16"}
+
+
+def check_layout_agreement(
+    layout: Optional[Dict[str, Optional[str]]] = None,
+    narrow_fields: Optional[Dict[str, object]] = None,
+    root: Optional[str] = None,
+) -> RuleResult:
+    """tests/test_state_layout.py LAYOUT vs the raft spec narrow table."""
+    res = RuleResult("layout-agreement")
+    root = root or repo_root()
+    if layout is None:
+        src, _ = _read(os.path.join(root, "tests", "test_state_layout.py"))
+        layout = parse_layout_table(src)
+    if narrow_fields is None:
+        from ..tpu.raft import make_raft_spec
+
+        narrow_fields = dict(make_raft_spec().narrow_fields or {})
+    import numpy as np
+
+    declared = {
+        k[len("node."):]: v
+        for k, v in layout.items()
+        if k.startswith("node.") and v is not None
+    }
+    for f, dt in sorted(narrow_fields.items()):
+        res.checked += 1
+        want = np.dtype(dt).name
+        got = declared.get(f)
+        if got is None:
+            res.add(
+                "LAYOUT",
+                f"narrow field node.{f} ({want}) missing from the LAYOUT "
+                "table — the layout lint cannot guard it",
+            )
+        elif got != want:
+            res.add(
+                "LAYOUT",
+                f"node.{f}: LAYOUT declares {got}, spec.narrow_fields "
+                f"declares {want} — the two tables drifted",
+            )
+    for f, got in sorted(declared.items()):
+        if got in _NARROW_DTYPES and f not in narrow_fields:
+            res.checked += 1
+            res.add(
+                "LAYOUT",
+                f"LAYOUT declares node.{f} narrow ({got}) but the raft "
+                "spec's narrow_fields does not narrow it — stale table "
+                "entry or missing spec declaration",
+            )
+    return res
+
+
+# ------------------------------------------------------------- marker hygiene
+
+
+def _marks_of(fn: ast.AST, module_marks: Set[str]) -> Set[str]:
+    marks = set(module_marks)
+    for dec in getattr(fn, "decorator_list", []):
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        parts = parts[::-1]
+        if len(parts) >= 3 and parts[0] == "pytest" and parts[1] == "mark":
+            marks.add(parts[2])
+        elif len(parts) == 2 and parts[0] == "mark":
+            marks.add(parts[1])
+    return marks
+
+
+def _module_marks(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "pytestmark"
+            for t in node.targets
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Attribute):
+                    out.add(sub.attr)
+    return out - {"mark", "pytest"}
+
+
+def check_marker_hygiene_file(path: str, root: str = "") -> RuleResult:
+    res = RuleResult("marker-hygiene")
+    rel = os.path.relpath(path, root).replace(os.sep, "/") if root else path
+    src, lines = _read(path)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        res.add(f"{rel}:{e.lineno}", f"unparseable: {e.msg}")
+        return res
+    module_marks = _module_marks(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith("test_"):
+            continue
+        res.checked += 1
+        reasons = []
+        accepted = set(HYGIENE_MARKS)
+        if LONG_NAME_RE.search(node.name):
+            reasons.append(f"name matches {LONG_NAME_RE.pattern!r}")
+        doc = ast.get_docstring(node) or ""
+        m = RUNTIME_NOTE_RE.search(doc)
+        if m and int(m.group(1)) >= RUNTIME_NOTE_FLOOR_S:
+            reasons.append(f"docstring notes a ~{m.group(1)}s runtime")
+            # a MEASURED budget note demands a tier-excluding mark:
+            # `chaos` alone does not take a test out of the default run
+            accepted = {"slow", "deep"}
+        if not reasons:
+            continue
+        marks = _marks_of(node, module_marks)
+        if marks & accepted:
+            continue
+        if _pragma_allows(lines, node.lineno, "marker-hygiene"):
+            continue
+        res.add(
+            f"{rel}:{node.lineno}",
+            f"{node.name} looks long-running ({'; '.join(reasons)}) but "
+            f"carries no slow/deep/chaos marker — tier-1 runs `-m 'not "
+            "slow'` under a hard budget; mark it or suppress with "
+            "`# madsim: allow(marker-hygiene)`",
+        )
+    return res
+
+
+def check_marker_hygiene(
+    root: Optional[str] = None, tests_dir: str = "tests"
+) -> RuleResult:
+    root = root or repo_root()
+    res = RuleResult("marker-hygiene")
+    for path in _py_files(root, tests_dir):
+        if "fixtures" in path.replace(os.sep, "/").split("/"):
+            continue
+        if not os.path.basename(path).startswith("test_"):
+            continue
+        one = check_marker_hygiene_file(path, root)
+        res.checked += one.checked
+        res.violations.extend(one.violations)
+    return res
+
+
+# -------------------------------------------------------------------- runner
+
+
+def run_source_lints(root: Optional[str] = None, log=print) -> List[RuleResult]:
+    root = root or repo_root()
+    if log:
+        log(f"[analysis] source lints over {root} ...")
+    return [
+        check_entropy(root),
+        check_both_faces(root=root),
+        check_mirror(root=root),
+        check_layout_agreement(root=root),
+        check_marker_hygiene(root),
+    ]

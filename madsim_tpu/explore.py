@@ -357,6 +357,12 @@ def cov_index(node: int, src: int = -1, kind: int = -1, bucket: int = 0) -> int:
     bucket); timer fires hash (node, -1, -1, 0). All inputs are
     trace-visible, so `bitmap_from_trace` recomputes a lane's exact device
     bitmap — the coverage analog of the nemesis schedule-mirror invariant.
+
+    The folded fields and their order are REGISTERED in
+    `engine.COV_FIELDS`; the analysis both-faces rule counts this chain
+    against the device chain in `_step_traced`, so a field added to one
+    face without the other fails `make lint` instead of silently
+    desyncing every recorded cov_digest.
     """
     from .tpu.engine import COV_BITS, COV_SALT
 

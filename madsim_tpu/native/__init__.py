@@ -39,7 +39,8 @@ def _try_build() -> None:
         # concurrent build_ext over the same in-place .so (ADVICE r4)
         import time as _time
 
-        if lock.exists() and _time.time() - lock.stat().st_mtime > 600:
+        # build tooling, not simulation: stale-lock age is wall-clock
+        if lock.exists() and _time.time() - lock.stat().st_mtime > 600:  # madsim: allow(ambient-entropy)
             lock.unlink()
     except OSError:
         pass

@@ -269,6 +269,52 @@ _CLAUSE_TYPES: Tuple[type, ...] = (
     ClockSkew,
 )
 
+# --------------------------------------------------------------------------
+# enumerable mirror registries (the analysis verifier's ground truth)
+# --------------------------------------------------------------------------
+# Every fault clause lives on THREE faces — the pure schedule
+# (plan_schedule), the host driver (NemesisDriver._apply / install), and
+# the device engine (compile_plan -> nem_* knobs) — and the static
+# verifier (madsim_tpu/analysis, rule `mirror`) cross-checks completeness
+# against these tables instead of sampling it with twin tests. A new
+# clause MUST be added here; the mirror rule fails on any face it cannot
+# find.
+
+# schedule-level clauses: occurrence-indexed event windows. Keys are the
+# shared clause names (OCC_CLAUSES rows, TriageCtl atoms, SimConfig
+# `nem_<name>_*` knob prefixes).
+SCHEDULE_CLAUSES: Dict[str, type] = {
+    "crash": Crash, "partition": Partition, "clog": LinkClog,
+    "spike": LatencySpike,
+}
+# message-level clauses: per-message coins (rate-matched across backends,
+# never event-matched). Keys are RATE_CLAUSES rows / `nem_<name>_rate`.
+MESSAGE_CLAUSES: Dict[str, type] = {
+    "loss": MsgLoss, "dup": Duplicate, "reorder": Reorder,
+}
+# assignment clauses: applied once at t=0 per (seed, node), no windows
+ASSIGN_CLAUSES: Dict[str, type] = {"skew": ClockSkew}
+# clause -> the NemesisEvent kinds its schedule face emits (open half
+# first). CLAUSE_OF_EVENT below is the inverse, event kind -> clause.
+CLAUSE_EVENT_KINDS: Dict[str, Tuple[str, ...]] = {
+    "crash": ("crash", "restart"),
+    "partition": ("split", "heal"),
+    "clog": ("clog", "unclog"),
+    "spike": ("spike_on", "spike_off"),
+    "skew": ("skew",),
+}
+# clause -> FIRE_KINDS rows it can increment
+CLAUSE_FIRE_KINDS: Dict[str, Tuple[str, ...]] = {
+    "crash": ("crash", "restart", "wipe"),
+    "partition": ("partition", "heal"),
+    "clog": ("clog",),
+    "spike": ("spike",),
+    "loss": ("loss",),
+    "dup": ("dup",),
+    "reorder": ("reorder",),
+    "skew": ("skew",),
+}
+
 
 def _check_interval(name: str, lo: int, hi: int) -> None:
     if lo < 0 or hi < lo:

@@ -60,7 +60,9 @@ async def call_with_data(
     else:  # production mode: any unique tag works
         import os as _os
 
-        rsp_tag = int.from_bytes(_os.urandom(8), "little")
+        # inside a sim, interpose patches os.urandom onto the seeded
+        # GlobalRng; this branch is explicitly production-mode
+        rsp_tag = int.from_bytes(_os.urandom(8), "little")  # madsim: allow(ambient-entropy)
     resolved = await lookup_host(dst)
     await ep.send_to_raw(resolved, _rpc_id(type(req)), (rsp_tag, req, bytes(data)))
     try:

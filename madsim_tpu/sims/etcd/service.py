@@ -469,7 +469,7 @@ class ServiceInner:
             else:  # production mode: OS entropy (determinism is sim-only)
                 import os as _os
 
-                draw = lambda: int.from_bytes(_os.urandom(8), "little") >> 1  # noqa: E731
+                draw = lambda: int.from_bytes(_os.urandom(8), "little") >> 1  # noqa: E731  # madsim: allow(ambient-entropy)
             while id == 0 or id in self.lease:
                 id = draw()  # non-negative i64
         if id in self.lease:

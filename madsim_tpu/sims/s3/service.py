@@ -226,7 +226,8 @@ class S3Service:
             return h.rng.next_u64() & 0xFFFF_FFFF
         import os
 
-        return int.from_bytes(os.urandom(4), "little")
+        # production-mode branch; sims take the seeded-rng path above
+        return int.from_bytes(os.urandom(4), "little")  # madsim: allow(ambient-entropy)
 
     @staticmethod
     def _now() -> Optional[float]:

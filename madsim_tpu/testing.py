@@ -110,7 +110,9 @@ class Builder:
         check: bool = False,
     ) -> None:
         if seed is None:
-            seed = int.from_bytes(os.urandom(8), "little")
+            # entropy on purpose: an UNSEEDED run picks its seed from
+            # the OS, then prints it for replay
+            seed = int.from_bytes(os.urandom(8), "little")  # madsim: allow(ambient-entropy)
         self.seed = seed
         self.count = count
         self.jobs = jobs

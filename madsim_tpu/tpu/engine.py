@@ -119,6 +119,13 @@ from ..nemesis import (
 COV_WORDS = 256  # u32 words per lane bitmap
 COV_BITS = COV_WORDS * 32  # 8192 coverage bits
 COV_SALT = 0x5EEDC0DE  # base key of the event-class hash chain
+# The event-class hash folds EXACTLY these fields, in this order, on BOTH
+# faces: the in-jit chain in _step_traced (step 7b) and the pure trace
+# mirror explore.cov_index. The analysis both-faces rule counts the fold
+# chains in each face's source against this registry — adding a field to
+# one face without the other (and without updating this tuple) is the
+# silent mirror break that desyncs every recorded cov_digest downstream.
+COV_FIELDS = ("node", "src", "kind", "bucket")
 
 
 class Coverage(NamedTuple):
@@ -450,6 +457,48 @@ def merge_state(hot: SimState, cold: ColdState, const: ConstState) -> SimState:
         key0=const.key0, ctl=const.ctl, nem=nem,
         **dict(zip(COLD_FIELDS, cold)),
     )
+
+
+def named_leaves(tree: Any, prefix: str = "") -> list:
+    """(dotted-path, leaf) pairs in jax flatten order, with NamedTuple
+    FIELD NAMES instead of positional keys (tree_flatten_with_path only
+    yields indices for namedtuples). None subtrees are dropped, matching
+    tree_leaves. The analysis verifier keys its per-leaf rules (taint
+    roots, donation coverage, narrow dtypes) on these names."""
+    out: list = []
+
+    def rec(name, obj):
+        if obj is None:
+            return
+        if hasattr(obj, "_fields"):  # NamedTuple node
+            for f in obj._fields:
+                rec(f"{name}.{f}" if name else f, getattr(obj, f))
+        elif isinstance(obj, (tuple, list)):
+            for i, v in enumerate(obj):
+                rec(f"{name}[{i}]" if name else f"[{i}]", v)
+        elif isinstance(obj, dict):
+            for k in sorted(obj):
+                rec(f"{name}[{k!r}]" if name else f"[{k!r}]", obj[k])
+        else:
+            out.append((name, obj))
+
+    rec(prefix, tree)
+    return out
+
+
+def carry_partition(state: SimState) -> dict:
+    """{'hot'|'cold'|'const' -> [leaf path]} for the sweep-loop split.
+
+    The donated-leaf introspection hook for the static verifier
+    (madsim_tpu/analysis): hot + cold are the while_loop carry (donated
+    across dispatch boundaries); const rides as a loop-invariant operand
+    and must never be donated, rotated, or re-emitted per step."""
+    hot, cold, const = split_state(state)
+    return {
+        "hot": [n for n, _ in named_leaves(hot)],
+        "cold": [n for n, _ in named_leaves(cold)],
+        "const": [n for n, _ in named_leaves(const)],
+    }
 
 
 def scale_delay_ppm(d: jnp.ndarray, ppm) -> jnp.ndarray:

@@ -1,0 +1,31 @@
+# Planted both-faces violation: the device coverage chain folds FIVE
+# fields while the trace mirror (and the COV_FIELDS registry) carry four
+# — the exact silent mirror break the rule exists for. Parsed only,
+# never imported (prng/fold32/COV_SALT are unresolved on purpose).
+
+COV_FIELDS = ("node", "src", "kind", "bucket")
+
+
+def _step_traced(state):
+    ck = prng.fold(jnp.uint32(COV_SALT), node_ids)
+    ck = prng.fold(ck, src_w)
+    ck = prng.fold(ck, kind_w)
+    ck = prng.fold(ck, bucket)
+    ck = prng.fold(ck, payload_crc)  # the unmirrored fifth field
+    idx = prng.mix(ck) % jnp.uint32(COV_BITS)
+    return idx
+
+
+def cov_index(node, src=-1, kind=-1, bucket=0):
+    ck = fold32(COV_SALT, node)
+    ck = fold32(ck, src)
+    ck = fold32(ck, kind)
+    ck = fold32(ck, bucket)
+    return mix32(ck) % COV_BITS
+
+
+def bitmap_from_trace(records, lane=0):
+    # both event faces read, so only the chain mismatch fires
+    if records.msg_fired[lane] or records.timer_fired[lane]:
+        return cov_index(0)
+    return 0

@@ -1,0 +1,28 @@
+# Planted both-faces SUBSTITUTION violation: both faces fold FOUR fields
+# (counts agree!) but the device face swapped `bucket` for `payload_crc`
+# — only the field-name sequence comparison against COV_FIELDS catches
+# it. Parsed only, never imported.
+
+COV_FIELDS = ("node", "src", "kind", "bucket")
+
+
+def _step_traced(state):
+    ck = prng.fold(jnp.uint32(COV_SALT), node_ids)
+    ck = prng.fold(ck, src_w)
+    ck = prng.fold(ck, kind_w)
+    ck = prng.fold(ck, payload_crc)  # substituted: registry says bucket
+    return prng.mix(ck) % jnp.uint32(COV_BITS)
+
+
+def cov_index(node, src=-1, kind=-1, bucket=0):
+    ck = fold32(COV_SALT, node)
+    ck = fold32(ck, src)
+    ck = fold32(ck, kind)
+    ck = fold32(ck, bucket)
+    return mix32(ck) % COV_BITS
+
+
+def bitmap_from_trace(records, lane=0):
+    if records.msg_fired[lane] or records.timer_fired[lane]:
+        return cov_index(0)
+    return 0

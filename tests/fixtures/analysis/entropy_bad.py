@@ -1,0 +1,27 @@
+# Planted ambient-entropy violations for the analysis linter
+# (tests/test_analysis.py). This file is PARSED, never imported or
+# collected (no test_ filename prefix). Expected findings: exactly seven
+# — time.time, random.random, np.random.rand, os.urandom, npr.rand,
+# default_rng, date.today — with the pragma'd urandom and the
+# measurement clock allowed.
+import os
+import random
+import time
+import numpy.random as npr
+from datetime import date
+from numpy.random import default_rng
+
+import numpy as np
+
+
+def leaks_ambient_entropy():
+    t = time.time()  # violation: wall clock
+    r = random.random()  # violation: unseeded stdlib RNG
+    n = np.random.rand(3)  # violation: ambient numpy RNG
+    b = os.urandom(8)  # violation: OS entropy
+    n2 = npr.rand(2)  # violation: aliased numpy.random module
+    g = default_rng()  # violation: from-imported numpy.random name
+    d = date.today()  # violation: wall-clock date
+    allowed = os.urandom(4)  # madsim: allow(ambient-entropy)
+    ok = time.perf_counter()  # allowed: measurement clock, not behavior
+    return t, r, n, b, n2, g, d, allowed, ok

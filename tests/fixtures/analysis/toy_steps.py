@@ -1,0 +1,174 @@
+"""Planted jaxpr-rule violations as toy step programs.
+
+Each function is a deliberately broken miniature of the engine pattern a
+Layer-1 rule guards; tests/test_analysis.py traces them with
+jax.make_jaxpr and asserts the matching rule FIRES (and that its clean
+twin passes). Kept tiny so tracing is milliseconds."""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from madsim_tpu.tpu import prng
+
+
+# ------------------------------------------------------------- callbacks
+
+def clean_step(x):
+    return x * 2
+
+
+def callback_step(x):
+    jax.debug.print("x = {}", x)  # host sync inside the step
+    return x * 2
+
+
+# ------------------------------------------------------------- rng taint
+
+def pure_schedule_draw(key0, k):
+    # victim draw indexed by the occurrence counter: the legal pattern
+    return prng.randint(key0, 203, 0, 5, index=k)
+
+
+def impure_schedule_draw(key0, clock):
+    # drawing the victim at an index derived from the lane CLOCK couples
+    # the fault schedule to the trajectory — the exact bug class
+    return prng.randint(key0, 203, 0, 5, index=clock)
+
+
+def impure_draw_inside_jit(key0, clock):
+    # the same bug hidden behind an inline-jitted helper: the mix eqns
+    # live in a pjit sub-jaxpr, and the witness must still name the
+    # clock leaf via the enclosing top-level equation
+    return jax.jit(
+        lambda k, c: prng.randint(k, 203, 0, 5, index=c)
+    )(key0, clock)
+
+
+def clean_funnel(key, payload):
+    new_key = prng.fold(key, 1)
+    coin = prng.uniform(prng.fold(key, 7), 33)
+    return new_key, coin + payload[..., 0]
+
+
+def contaminated_funnel(key, payload):
+    # folding protocol state INTO the carried key poisons every
+    # downstream step's draws
+    new_key = prng.fold(key, payload[..., 0])
+    return new_key, jnp.zeros_like(payload[..., 0])
+
+
+# ----------------------------------------------------------------- dtype
+
+def time_f32_step(timer):
+    # the r1 clock-skew bug: f32 multiply on a time value loses integer
+    # microseconds past 2^24 us
+    return (timer.astype(jnp.float32) * jnp.float32(1.00005)).astype(
+        jnp.int32
+    )
+
+
+def time_int_step(timer):
+    from madsim_tpu.tpu.engine import scale_delay_ppm
+
+    return scale_delay_ppm(timer, 50)
+
+
+# ------------------------------------------------------ lane independence
+
+def lane_coupled_step(x):
+    # subtracting a cross-lane mean entangles every lane with the batch
+    return x - x.mean(axis=0, keepdims=True)
+
+
+def lane_coupled_rhs_matmul(m, x):
+    # x: [L, F]; contracting the LANE axis on the RHS operand
+    return m @ x
+
+
+def lane_coupled_transposed(x):
+    # the lane axis moved to position 1 by the transpose, then contracted
+    return x.T @ x
+
+
+def lane_local_step(x):
+    return x - x.mean(axis=1, keepdims=True)
+
+
+# -------------------------------------------------------------- donation
+
+class ToyHot(NamedTuple):
+    key: Any
+    x: Any
+
+
+class ToyCold(NamedTuple):
+    acc: Any
+
+
+class ToyConst(NamedTuple):
+    key0: Any
+    scale: Any
+
+
+HOT_NAMES = ("hot.key", "hot.x")
+COLD_NAMES = ("cold.acc",)
+CONST_NAMES = ("const.key0", "const.scale")
+
+
+def toy_state(lanes: int = 13):
+    hot = ToyHot(
+        key=jax.ShapeDtypeStruct((lanes,), jnp.uint32),
+        x=jax.ShapeDtypeStruct((lanes,), jnp.int32),
+    )
+    cold = ToyCold(acc=jax.ShapeDtypeStruct((lanes,), jnp.int32))
+    const = ToyConst(
+        key0=jax.ShapeDtypeStruct((lanes,), jnp.uint32),
+        scale=jax.ShapeDtypeStruct((), jnp.int32),
+    )
+    return hot, cold, const
+
+
+def good_toy_step(hot, cold, const):
+    coin = (prng.bits(const.key0, 5) & 1).astype(jnp.int32)
+    x2 = hot.x + const.scale + coin
+    return ToyHot(prng.fold(hot.key, 1), x2), ToyCold(cold.acc + x2)
+
+
+def widened_toy_step(hot, cold, const):
+    # hot.x leaves the step as f32: no output matches its buffer, so the
+    # leaf cannot be donated — the donation-coverage regression
+    x2 = (hot.x + const.scale).astype(jnp.float32)
+    return ToyHot(prng.fold(hot.key, 1), x2), ToyCold(cold.acc)
+
+
+def good_toy_run(hot, cold, const, n=4):
+    def body(carry):
+        h, c, i = carry
+        h2, c2 = good_toy_step(h, c, const)
+        return h2, c2, i + 1
+
+    def cond(carry):
+        return carry[2] < n
+
+    h, c, _ = jax.lax.while_loop(cond, body, (hot, cold, jnp.int32(0)))
+    return h, c
+
+
+def leaky_toy_run(hot, cold, const, n=4):
+    # const.scale rides the while carry: donation rotates a loop
+    # invariant through fresh buffers every segment — the regression the
+    # hot/cold/const split can silently lose
+    def body(carry):
+        h, c, s, i = carry
+        h2, c2 = good_toy_step(h, c, ToyConst(const.key0, s))
+        return h2, c2, s, i + 1
+
+    def cond(carry):
+        return carry[3] < n
+
+    h, c, _, _ = jax.lax.while_loop(
+        cond, body, (hot, cold, const.scale, jnp.int32(0))
+    )
+    return h, c

@@ -1,0 +1,31 @@
+# Planted marker-hygiene violations (parsed only; the filename has no
+# test_ prefix so pytest never collects these). Expected findings:
+# test_soak_unmarked (name) and test_big_sweep_budgeted (runtime note).
+import pytest
+
+
+def test_soak_unmarked():
+    pass
+
+
+def test_quick():
+    pass
+
+
+@pytest.mark.slow
+def test_cross_process_marked(tmp_path):
+    pass
+
+
+def test_big_sweep_budgeted():
+    """Replays the full acceptance corpus (~45 s warm)."""
+
+
+@pytest.mark.chaos
+def test_chaos_marked_but_budgeted():
+    """Chaos tier, but a measured ~60 s budget: chaos does not exclude
+    it from the default run, so `slow`/`deep` is still required."""
+
+
+def test_acceptance_pragmad():  # madsim: allow(marker-hygiene)
+    pass

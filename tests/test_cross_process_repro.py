@@ -251,6 +251,7 @@ def test_shrunk_bundle_replays_cross_process_on_both_backends(tmp_path):
     assert "host schedule twin OK" in host.stdout, host.stdout
 
 
+@pytest.mark.chaos
 def test_fault_plan_fire_schedule_identical_across_fresh_runtimes():
     """Nemesis determinism on the host face: same seed + same FaultPlan =>
     IDENTICAL applied fault stream (times, kinds, victims, wipe flags,

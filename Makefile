@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze chaos-smoke triage-smoke explore-smoke campaign-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -13,8 +13,10 @@ test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 lint:            ## source-level invariant lints: entropy, mirror, both-faces, layout, markers (fast)
 	$(PY) -m madsim_tpu.analysis
 
-analyze:         ## full static verifier: source lints + jaxpr rules over all five workloads
+analyze:         ## full static verifier: lints + jaxpr + range certificates over all five workloads
 	$(PY) -m madsim_tpu.analysis --all
+
+check: lint analyze  ## the fast pre-commit gate: every static rule, no pytest
 
 deep:            ## deep device sweeps (~10 min; CI nightly)
 	$(PY) -m pytest tests/ -q -m deep
@@ -29,7 +31,7 @@ explore-smoke:   ## coverage-guided search smoke: monotone coverage + meta-seed 
 	$(PY) -m pytest tests/test_explore.py -q -m "chaos and not slow"
 
 campaign-smoke:  ## mini campaign: kill -> resume fingerprint match, dedup, merge/cmin, regression replay
-	$(PY) -m madsim_tpu.analysis --quiet
+	$(PY) -m madsim_tpu.analysis --quiet --rule range --workload raft
 	$(PY) -m pytest tests/test_campaign.py -q -m "chaos and not slow"
 
 regression:      ## replay the regression corpus of deduped bug bundles green
@@ -47,7 +49,7 @@ bench:           ## the headline JSON line (runs on the live jax backend)
 	$(PY) bench.py
 
 bench-smoke:     ## <60s/workload micro-bench: completion + dispatch + layout budgets, never wall-clock
-	$(PY) -m madsim_tpu.analysis --quiet
+	$(PY) -m madsim_tpu.analysis --quiet --rule range --workload raft
 	$(PY) benches/bench_smoke.py
 
 compaction-ab:   ## r8 layout A/B: serial-vs-donated + packed-vs-unpacked bit-identity (<60s, structural)

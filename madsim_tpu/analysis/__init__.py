@@ -12,18 +12,27 @@ every campaign checkpoint downstream. This package checks them:
   Layer 1 — jaxpr verifier (`jaxpr_check.py`): traces each workload's
   actual donated `_step_split` program (chaos + triage + coverage on)
   and walks the closed jaxpr / lowered StableHLO. Rules: `callbacks`,
-  `rng-taint`, `donation`, `dtype`, `lane-independence`.
+  `rng-taint`, `donation`, `dtype`, `lane-independence`. One trace per
+  workload is shared by EVERY jaxpr rule (jaxpr_check.get_trace).
 
   Layer 2 — source/mirror linter (`lint.py`): AST + introspection over
   the tree. Rules: `ambient-entropy`, `mirror`, `both-faces`,
   `layout-agreement`, `marker-hygiene`.
 
+  Layer 3 — range certifier (`ranges.py`): interval abstract
+  interpretation over the SAME shared trace. Rule: `range` — proves the
+  narrow-dtype bounds (certified safe horizon >= the declared
+  `narrow_horizon_us` after skew derating), i32 virtual-clock no-wrap,
+  dynamic-index bounds, and rederives `_sum64`'s lane-exactness cap.
+  Emits per-workload certificates into the summary JSON.
+
 Run it:  `python -m madsim_tpu.analysis [--all] [--workload NAME]`
-         (`make lint` = source rules, `make analyze` = everything).
-Each run emits a summary JSON (rule -> pass/fail/violation count) so
-rule counts can be tracked like a coverage metric across BENCH rounds.
-Rule catalog, allowlists, and the `# madsim: allow(<rule>)` suppression
-pragma: docs/analysis.md.
+         (`make lint` = source rules, `make analyze` = everything,
+          `--rule NAME` filters the jaxpr/range rule set).
+Each run emits a summary JSON (rule -> pass/fail/violation count, plus
+the Layer-3 `certificates` section) so rule counts can be tracked like
+a coverage metric across BENCH rounds. Rule catalog, allowlists, and
+the `# madsim: allow(<rule>)` suppression pragma: docs/analysis.md.
 """
 
 from __future__ import annotations
@@ -32,11 +41,13 @@ import dataclasses
 import json
 from typing import Any, Dict, List, Optional, Sequence
 
-SCHEMA = "madsim-tpu-analysis/1"
+SCHEMA = "madsim-tpu-analysis/2"
 
-# Layer-1 (per-workload, jaxpr) and Layer-2 (tree-wide, source) rules.
+# Layer-1 (per-workload, jaxpr), Layer-2 (tree-wide, source) and
+# Layer-3 (per-workload, interval) rules.
 JAXPR_RULES = (
     "callbacks", "rng-taint", "donation", "dtype", "lane-independence",
+    "range",
 )
 LINT_RULES = (
     "ambient-entropy", "mirror", "both-faces", "layout-agreement",
@@ -84,10 +95,14 @@ def merge_results(results: Sequence[RuleResult]) -> Dict[str, RuleResult]:
 
 
 def summary_json(
-    results: Sequence[RuleResult], workloads: Sequence[str]
+    results: Sequence[RuleResult],
+    workloads: Sequence[str],
+    certificates: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The per-run summary (satellite: rule -> pass/fail/violation count,
-    trackable like a coverage metric by a future BENCH round)."""
+    trackable like a coverage metric by a future BENCH round). Schema
+    /2 adds the Layer-3 `certificates` section: per-workload narrow-
+    field / horizon / clock / index rows plus the shared _sum64 row."""
     merged = merge_results(results)
     rules = {
         name: {
@@ -104,6 +119,7 @@ def summary_json(
         "ok": bool(merged) and all(r.ok for r in merged.values()),
         "workloads": list(workloads),
         "rules": rules,
+        "certificates": dict(certificates or {}),
         "violation_details": [
             dataclasses.asdict(v)
             for r in merged.values()
@@ -117,24 +133,54 @@ def run_analysis(
     lint: bool = True,
     root: Optional[str] = None,
     log=print,
+    rules: Optional[Sequence[str]] = None,
 ) -> Dict[str, Any]:
     """Run the selected rule set; returns the summary JSON dict.
 
-    `workloads` names the Layer-1 targets (jaxpr rules trace each one's
-    real step program); `lint` toggles the Layer-2 source rules. The
+    `workloads` names the Layer-1/Layer-3 targets (jaxpr + range rules
+    share ONE trace of each one's real step program); `lint` toggles the
+    Layer-2 source rules; `rules` optionally filters the per-workload
+    rule set by name (e.g. ("range",) for the fast smoke prologue). The
     lint tier never TRACES anything, but its mirror/layout faces do
     import jax (compile_plan / the raft spec), so `make lint` costs a
     few seconds; only workload runs pay for tracing."""
+    rule_filter = set(rules) if rules is not None else None
+    if rule_filter is not None:
+        unknown = rule_filter - set(JAXPR_RULES)
+        if unknown:
+            raise ValueError(
+                f"unknown jaxpr/range rules {sorted(unknown)} "
+                f"(choose from {', '.join(JAXPR_RULES)})"
+            )
     results: List[RuleResult] = []
+    certificates: Dict[str, Any] = {}
     if lint:
         from . import lint as lint_mod
 
         results.extend(lint_mod.run_source_lints(root=root, log=log))
     for name in workloads:
-        from . import jaxpr_check
+        from . import jaxpr_check, ranges
 
-        results.extend(jaxpr_check.verify_workload(name, log=log))
-    return summary_json(results, workloads)
+        trace = jaxpr_check.get_trace(name, log=log)
+        layer1_rules = (
+            None if rule_filter is None
+            else tuple(rule_filter - {"range"})
+        )
+        if layer1_rules is None or layer1_rules:
+            results.extend(jaxpr_check.verify_workload(
+                name, log=log, trace=trace, rules=layer1_rules,
+            ))
+        if rule_filter is None or "range" in rule_filter:
+            rres, cert = ranges.verify_ranges(trace, log=log)
+            results.extend(rres)
+            certificates[name] = cert
+    if workloads and (rule_filter is None or "range" in rule_filter):
+        from . import ranges
+
+        sum64_res = RuleResult("range")
+        certificates["_sum64"] = ranges.sum64_certificate(sum64_res)
+        results.append(sum64_res)
+    return summary_json(results, workloads, certificates)
 
 
 def render_summary(summary: Dict[str, Any]) -> str:
@@ -144,6 +190,25 @@ def render_summary(summary: Dict[str, Any]) -> str:
         lines.append(
             f"  {mark} {name:<18} checked {row['checked']:>5}  "
             f"violations {row['violations']}"
+        )
+    for wl, cert in summary.get("certificates", {}).items():
+        if wl == "_sum64":
+            lines.append(
+                f"  cert _sum64: asserted {cert['asserted_lanes']} <= "
+                f"rederived {cert['rederived_lanes']} lanes"
+            )
+            continue
+        hz = cert.get("horizon", {})
+        c_us = hz.get("certified_us")
+        lines.append(
+            f"  cert {wl}: {len(cert.get('fields', []))} narrow fields, "
+            f"horizon certified "
+            f"{'unbounded' if c_us is None else f'{c_us} us'}"
+            + (
+                f" (declared {hz['declared_us']} us, binding "
+                f"{hz.get('binding_field')})"
+                if hz.get("declared_us") is not None else ""
+            )
         )
     for v in summary["violation_details"]:
         lines.append(f"    -> [{v['rule']}] {v['where']}: {v['detail']}")

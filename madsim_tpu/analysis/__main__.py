@@ -17,7 +17,13 @@ import json
 import sys
 from typing import Optional, Sequence
 
-from . import WORKLOADS, render_summary, run_analysis, write_summary
+from . import (
+    JAXPR_RULES,
+    WORKLOADS,
+    render_summary,
+    run_analysis,
+    write_summary,
+)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -40,6 +46,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument(
         "--no-lint", action="store_true",
         help="skip the source-level lints (jaxpr rules only)",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=[], metavar="NAME",
+        help="filter the per-workload jaxpr/range rules (choices: "
+        f"{', '.join(JAXPR_RULES)}; repeatable; needs --workload/--all "
+        "— e.g. the smoke prologues run `--rule range --workload raft`)",
     )
     parser.add_argument(
         "--json", metavar="PATH", default=None,
@@ -65,10 +77,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "--no-lint without --all/--workload selects zero rules — "
             "nothing would be verified"
         )
+    for r in args.rule:
+        if r not in JAXPR_RULES:
+            parser.error(
+                f"unknown rule {r!r} (choose from {', '.join(JAXPR_RULES)})"
+            )
+    if args.rule and not workloads:
+        parser.error("--rule filters per-workload rules: add --workload/--all")
 
     log = None if (args.quiet or args.json_line) else print
     summary = run_analysis(
-        workloads=workloads, lint=not args.no_lint, log=log
+        workloads=workloads, lint=not args.no_lint, log=log,
+        rules=args.rule or None,
     )
     if args.json:
         write_summary(summary, args.json)

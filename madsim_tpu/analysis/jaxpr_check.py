@@ -529,58 +529,149 @@ def check_donation(sim, state, hot, cold, const, where: str = "step") -> RuleRes
     return res
 
 
-# --------------------------------------------------------------- entry
+# --------------------------------------------------- the shared trace
 
 
-def verify_workload(
-    name: str, lanes: int = LANES, log=print
-) -> List[RuleResult]:
-    """Trace workload `name`'s real step program and run every jaxpr rule.
+import dataclasses
 
-    All five rules share ONE abstract trace of `_step_split` (the
-    lane-width reuse trick: a small fixed lane count keeps tracing
-    seconds-fast and identifies the lane axis unambiguously)."""
-    from ..tpu.engine import COV_SALT, named_leaves
 
+@dataclasses.dataclass
+class WorkloadTrace:
+    """ONE abstract trace of a workload's real programs, shared by EVERY
+    jaxpr-level rule (purity, taint, donation, dtype, lane, range).
+
+    Tracing is the dominant cost of a Layer-1/Layer-3 run (seconds per
+    workload; the rules themselves are milliseconds of jaxpr walking),
+    so it is hoisted here and cached per (workload, lanes): the CLI, the
+    range certifier and the test suite all reuse the same trace instead
+    of re-tracing per rule. Donation additionally lowers the step — that
+    stays inside check_donation, the only consumer of StableHLO."""
+
+    name: str
+    lanes: int
+    sim: Any
+    state: Any
+    hot: Any
+    cold: Any
+    const: Any
+    closed_step: Any  # jaxpr of the donated _step_split (the sweep body)
+    out_template: Any  # eval_shape of _step_split: (h2, c2, rec)
+    closed_init: Any  # jaxpr of _init (runs once, draws schedule roots)
+    init_template: Any  # eval_shape of _init: the full SimState
+    names: List[str]  # invar leaf names (hot./cold./const. prefixed)
+    out_names: List[str]  # outvar leaf names (hot./cold./rec. prefixed)
+    invars_avals: List[Any]
+    time_leaves: Set[str]
+
+
+_TRACE_CACHE: Dict[Tuple[str, int], WorkloadTrace] = {}
+
+
+def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
+    """The per-workload trace, built once per process (abstract only:
+    ShapeDtypeStructs, no XLA compile, no device)."""
+    from ..tpu.engine import named_leaves
+
+    key = (name, lanes)
+    cached = _TRACE_CACHE.get(key)
+    if cached is not None:
+        return cached
     if log:
         log(f"[analysis] tracing {name} step program (L={lanes}) ...")
     sim, state, hot, cold, const = build_verified_sim(name, lanes=lanes)
     closed = jax.make_jaxpr(sim._step_split)(hot, cold, const)
     out_template = jax.eval_shape(sim._step_split, hot, cold, const)
-    names = _leaf_names(hot, cold, const)
-    time_leaves = _time_leaves(sim)
-    # outvar index of the step's key-chain update (h2.key)
-    h2_names = [n for n, _ in named_leaves(out_template[0], "hot")]
-    key_out = h2_names.index("hot.key")
-
-    where = f"{name}:_step_split"
-    results = [
-        check_callbacks(closed, where),
-        check_rng_taint(
-            closed, names, time_leaves, where,
-            key_out_index=key_out, salt_values=(COV_SALT,),
-        ),
-        check_dtype(closed, sim, hot, out_template, names, where),
-        check_lane_independence(closed, lanes, where),
-        check_donation(sim, state, hot, cold, const, f"{name}:_run"),
-    ]
-    # init runs once per sweep but draws the schedule roots: callbacks +
-    # purity hold there too (seeds are the key root at init)
     seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
     closed_init = jax.make_jaxpr(sim._init)(seeds)
+    init_template = jax.eval_shape(sim._init, seeds)
+    h2, c2, rec = out_template
+    out_names = (
+        [n for n, _ in named_leaves(h2, "hot")]
+        + [n for n, _ in named_leaves(c2, "cold")]
+        + [n for n, _ in named_leaves(rec, "rec")]
+    )
+    trace = WorkloadTrace(
+        name=name, lanes=lanes, sim=sim, state=state,
+        hot=hot, cold=cold, const=const,
+        closed_step=closed, out_template=out_template,
+        closed_init=closed_init, init_template=init_template,
+        names=_leaf_names(hot, cold, const),
+        out_names=out_names,
+        invars_avals=(
+            [x for _, x in named_leaves(hot, "hot")]
+            + [x for _, x in named_leaves(cold, "cold")]
+            + [x for _, x in named_leaves(const, "const")]
+        ),
+        time_leaves=_time_leaves(sim),
+    )
+    _TRACE_CACHE[key] = trace
+    return trace
+
+
+def verify_workload(
+    name: str, lanes: int = LANES, log=print,
+    trace: Optional[WorkloadTrace] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[RuleResult]:
+    """Run the selected Layer-1 jaxpr rules over workload `name`'s shared
+    trace (the lane-width reuse trick: a small fixed lane count keeps
+    tracing seconds-fast and identifies the lane axis unambiguously).
+    `rules=None` runs them all; a filter skips unselected checks
+    entirely — notably `donation`, the only rule that LOWERS the step to
+    StableHLO rather than just walking the trace."""
+    from ..tpu.engine import COV_SALT, named_leaves
+
+    trace = trace or get_trace(name, lanes=lanes, log=log)
+    want = None if rules is None else set(rules)
+
+    def on(rule: str) -> bool:
+        return want is None or rule in want
+
+    sim = trace.sim
+    closed = trace.closed_step
+    out_template = trace.out_template
+    names = trace.names
+    time_leaves = trace.time_leaves
+
+    where = f"{name}:_step_split"
+    results = []
+    if on("callbacks"):
+        results.append(check_callbacks(closed, where))
+    if on("rng-taint"):
+        # outvar index of the step's key-chain update (h2.key)
+        h2_names = [n for n, _ in named_leaves(out_template[0], "hot")]
+        key_out = h2_names.index("hot.key")
+        results.append(check_rng_taint(
+            closed, names, time_leaves, where,
+            key_out_index=key_out, salt_values=(COV_SALT,),
+        ))
+    if on("dtype"):
+        results.append(check_dtype(
+            closed, sim, trace.hot, out_template, names, where,
+        ))
+    if on("lane-independence"):
+        results.append(check_lane_independence(closed, trace.lanes, where))
+    if on("donation"):
+        results.append(check_donation(
+            sim, trace.state, trace.hot, trace.cold, trace.const,
+            f"{name}:_run",
+        ))
+    # init runs once per sweep but draws the schedule roots: callbacks +
+    # purity hold there too (seeds are the key root at init)
+    closed_init = trace.closed_init
     init_names = ["const.key0"] + [
         f"const.ctl.{i}" for i in range(len(closed_init.jaxpr.invars) - 1)
     ]
-    results.append(check_callbacks(closed_init, f"{name}:_init"))
-    results.append(
-        check_rng_taint(
+    if on("callbacks"):
+        results.append(check_callbacks(closed_init, f"{name}:_init"))
+    if on("rng-taint"):
+        results.append(check_rng_taint(
             closed_init,
             init_names[: len(closed_init.jaxpr.invars)],
             set(),
             f"{name}:_init",
             salt_values=(COV_SALT,),
-        )
-    )
+        ))
     if log:
         bad = sum(len(r.violations) for r in results)
         log(

@@ -381,6 +381,13 @@ def make_chain_spec(
             **({"fw_key": jnp.uint8, "creq_key": jnp.uint8,
                 "la_key": jnp.uint8} if K <= 255 else {}),
         },
+        # explicitly declared: every narrowed field is a step-closed
+        # flag/id/enum/key — no rate-argument bounds, so the Layer-3
+        # range certifier (analysis/ranges.py) must certify this spec
+        # trivially (unbounded safe horizon). Versions staying i32 (the
+        # monotonicity oracle compares them) is what keeps this table
+        # floor-free.
+        rate_floors={},
     ))
 
 

@@ -71,7 +71,15 @@ import jax
 import jax.numpy as jnp
 
 from . import bitpack, prng
-from .spec import INF_GUARD, INF_US, Outbox, ProtocolSpec, REBASE_US, SimConfig
+from .spec import (
+    INF_GUARD,
+    INF_US,
+    Outbox,
+    ProtocolSpec,
+    REBASE_US,
+    SimConfig,
+    derate_horizon,
+)
 from ..nemesis import (
     COIN_DENOM,
     FIRE_INDEX,
@@ -501,6 +509,95 @@ def carry_partition(state: SimState) -> dict:
     }
 
 
+def interval_hints(sim: "BatchedSim") -> dict:
+    """{carry leaf name -> (lo, hi, may_inf)} seed intervals for the
+    ENGINE-OWNED leaves, keyed by the `named_leaves` hot/cold/const paths.
+
+    The introspection hook behind the Layer-3 range certifier
+    (analysis/ranges.py): these are the engine's own documented value
+    invariants — live time OFFSETS stay below INF_GUARD (the rebase
+    guard `rb` relies on exactly this: values >= INF_GUARD are sentinels
+    and are never rebased), node ids index [0, N), occurrence counters
+    and diagnostic counters stay far from i32 overflow — stated where
+    the invariants LIVE so the analyzer cannot drift from the engine.
+    `may_inf` marks leaves that may additionally hold the INF_US
+    sentinel exactly (disarmed timers, empty pool slots, disabled
+    chaos). Leaves NOT named here are protocol-owned (node state,
+    payloads) and are seeded by the analyzer from the spec's own
+    declarations (narrow_fields / rate_floors / time_fields)."""
+    cfg = sim.config
+    N = sim.spec.n_nodes
+    off_hi = int(INF_GUARD) - 1  # live-offset invariant (see rb())
+    ctr_hi = 1 << 30  # diagnostics counters: far below i32 wrap
+    ep_hi = 1 << 22  # epochs: ~35k virtual years of rebase headroom
+    u32 = (0, (1 << 32) - 1, False)
+    toff = (-1, off_hi, True)  # time offset; -1 = "keep/disarm" in flight
+    hints = {
+        "hot.clock": (0, off_hi, True),
+        "hot.epoch": (0, ep_hi, False),
+        "hot.key": u32,
+        "hot.done": (0, 1, False),
+        "hot.violated": (0, 1, False),
+        "hot.alive_p": u32,
+        "hot.crashed": (-1, N - 1, False),
+        "hot.chaos_at": toff,
+        "hot.link_ok_p": u32,
+        "hot.partitioned": (0, 1, False),
+        "hot.part_at": toff,
+        "hot.timer": toff,
+        "hot.msgs.valid_p": u32,
+        "hot.msgs.deliver": toff,
+        "hot.strag.valid": (0, 1, False),
+        "hot.strag.deliver": toff,
+        "hot.strag.dst": (0, N - 1, False),
+        "hot.nem.crash_k": (0, ctr_hi, False),
+        "hot.nem.wipe": (0, 1, False),
+        "hot.nem.part_k": (0, ctr_hi, False),
+        "hot.nem.clog_at": toff,
+        "hot.nem.clogged": (0, 1, False),
+        "hot.nem.clog_src": (0, N - 1, False),
+        "hot.nem.clog_dst": (0, N - 1, False),
+        "hot.nem.clog_k": (0, ctr_hi, False),
+        "hot.nem.spike_at": toff,
+        "hot.nem.spiking": (0, 1, False),
+        "hot.nem.spike_k": (0, ctr_hi, False),
+        "cold.violation_at": toff,
+        "cold.violation_epoch": (0, ep_hi, False),
+        "cold.violation_step": (-1, ctr_hi, False),
+        "cold.deadlocked": (0, 1, False),
+        "cold.steps": (0, ctr_hi, False),
+        "cold.events": (0, ctr_hi, False),
+        "cold.overflow": (0, ctr_hi, False),
+        "cold.dead_drops": (0, ctr_hi, False),
+        "cold.fires": (0, ctr_hi, False),
+        "cold.occ_fired": u32,
+        "cold.cov.bitmap": u32,
+        "cold.cov.hiwater": (0, ctr_hi, False),
+        "cold.cov.transitions": (0, ctr_hi, False),
+        "const.key0": u32,
+        "const.ctl.off": (0, (1 << 31) - 1, False),
+        "const.ctl.occ": (0, (1 << 31) - 1, False),
+        "const.ctl.rate_scale": (0, 1, False),
+        "const.ctl.h_epoch": (0, ep_hi, False),
+        "const.ctl.h_off": (0, REBASE_US - 1, False),
+        "const.skew_ppm": (
+            -cfg.nem_skew_max_ppm, cfg.nem_skew_max_ppm, False
+        ),
+    }
+    n_kinds = (
+        len(sim.spec.msg_kind_names)
+        if sim.spec.msg_kind_names is not None else 256
+    )
+    hints["hot.msgs.kind"] = (0, n_kinds - 1, False)
+    hints["hot.strag.kind"] = (0, n_kinds - 1, False)
+    # absolute-time node fields (spec.time_fields) share the live-offset
+    # invariant: they are rebased with the lane's epoch like every other
+    # time tensor
+    for f in sim.spec.time_fields:
+        hints[f"hot.node.{f}"] = toff
+    return hints
+
+
 def scale_delay_ppm(d: jnp.ndarray, ppm) -> jnp.ndarray:
     """Stretch a non-negative i32 microsecond delay by (1 + ppm * 1e-6),
     EXACTLY, in pure int32 arithmetic: d + trunc(d * |ppm| / 1e6) * sign.
@@ -602,16 +699,32 @@ class BatchedSim:
                 "time_fields hold absolute epoch-rebased times and must "
                 f"stay i32 — remove {sorted(bad)} from narrow_fields"
             )
+        # rate_floors entries are ANALYZER metadata (analysis/ranges.py
+        # reads them per narrow field; entries for fields outside the
+        # live narrow table are inert — `replace(spec, narrow_fields=
+        # ...)` is a documented experimentation/escape idiom and must
+        # not force re-deriving the floor table). Only the entry TYPES
+        # are validated here, so a malformed declaration fails at
+        # construction rather than silently un-certifying a field.
+        from .spec import HardCap, RateFloor
+
+        for fname, entry in (spec.rate_floors or {}).items():
+            if not isinstance(entry, (RateFloor, HardCap)):
+                raise ValueError(
+                    f"rate_floors[{fname!r}] must be a RateFloor or "
+                    f"HardCap, got {type(entry).__name__}"
+                )
         if self._narrow and spec.narrow_horizon_us is not None:
             # rate-argument narrow bounds ("one tid per coordinator-timer
             # floor") only hold up to the spec-declared horizon; past it
             # a narrow counter would wrap SILENTLY — refuse instead.
-            # Clock skew shrinks every relative timer delay by up to
-            # (1 - max_ppm * 1e-6), speeding the bounding cadence up by
-            # the same factor, so the cap derates with the config's skew.
-            cap = spec.narrow_horizon_us
-            if cfg.nem_skew_enabled:
-                cap = cap * (1_000_000 - cfg.nem_skew_max_ppm) // 1_000_000
+            # The cap derates with the config's clock skew through the
+            # SAME helper the range certifier uses (spec.derate_horizon),
+            # so refusal and certificate can never disagree.
+            cap = derate_horizon(
+                spec.narrow_horizon_us,
+                cfg.nem_skew_max_ppm if cfg.nem_skew_enabled else 0,
+            )
             if cfg.horizon_us > cap:
                 raise ValueError(
                     f"horizon_us={cfg.horizon_us} exceeds this spec's "

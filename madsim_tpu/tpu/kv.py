@@ -65,7 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, majority as majority_of
+from .spec import Outbox, ProtocolSpec, RateFloor, majority as majority_of
 
 REPLICA, CLAIMING, PRIMARY = 0, 1, 2
 HB, CLAIM, CLAIM_ACK, WREP, WACK, RPROBE, RACK, CREQ, CRSP = range(9)
@@ -679,13 +679,14 @@ def make_kv_spec(
         # r8 carry compaction (docs/state_layout.md). Bounds: role is a
         # 3-state enum; *_kind ops are {0, OP_READ, OP_WRITE}; acks are
         # N-bit quorum masks; keys index [0, K); recover_left counts keys
-        # still to re-commit (<= K); pend_recover is a bool flag. epoch is
-        # HARD-bounded by the REV_STRIDE overflow analysis above (epoch *
-        # REV_STRIDE must stay under 2^31 => epoch < 65536 = exactly u16).
-        # wcount/revisions/values stay i32: wcount is only soft-bounded
-        # (rev_stride_pressure_lanes warns, nothing caps it) and values
-        # encode nid * 100_000 + ccount. The big h_* history rings narrow
-        # where their vocab does (h_kind, h_key).
+        # still to re-commit (<= K); pend_recover is a bool flag. epoch
+        # u16 is a RATE bound (rate_floors below — the "hard bound by
+        # REV_STRIDE arithmetic" this comment used to claim was never
+        # enforced by anything; rid arithmetic needs epoch < 65536, it
+        # does not cap it). wcount/revisions/values stay i32: wcount is
+        # only soft-bounded (rev_stride_pressure_lanes warns, nothing
+        # caps it) and values encode nid * 100_000 + ccount. The big h_*
+        # history rings narrow where their vocab does (h_kind, h_key).
         narrow_fields={
             "role": jnp.uint8,
             "pend_kind": jnp.uint8,
@@ -701,6 +702,33 @@ def make_kv_spec(
                 "h_key": jnp.uint8, "recover_left": jnp.uint8}
                if K <= 255 else {}),
         },
+        # Day-one finding of the Layer-3 range certifier
+        # (analysis/ranges.py): the old comment called the u16 epoch
+        # "HARD-bounded by the REV_STRIDE overflow analysis" — but rid
+        # arithmetic REQUIRING epoch < 65536 never enforced it, and a
+        # claim mints `(epoch//N + 1)*N + nid`, a jump of up to 2N-1
+        # per claim (the interpreter measured the +9 at N=5), so the
+        # bound is a RATE argument after all. The adversarial rate: a
+        # node claims only after missing heartbeats for >= hb_timeout_lo
+        # (or retries after claim_retry_us > that), adoption resets
+        # last_hb, so each node claims at most once per hb_timeout_lo
+        # window and the global max ratchets <= N claims x (2N-1) per
+        # window. The engine refusal now guards kv soaks through
+        # narrow_horizon_us below (65535 * 150ms / 45 ~ 3.6 nonstop
+        # virtual minutes of adversarial churn at defaults — tighter
+        # than the old unstated story; strip narrow_fields for longer
+        # soaks, exactly like raft past its 33-minute cap).
+        rate_floors={
+            "epoch": RateFloor(
+                floor_us=hb_timeout_lo_us, ratchet=N, inc=2 * N - 1,
+                why="a claim needs >= hb_timeout_lo of missed heartbeats "
+                "(retry floor is higher); one claim jumps epoch by "
+                "<= 2N-1; N claimers ratchet the global max per window",
+            ),
+        },
+        narrow_horizon_us=(
+            65_535 * hb_timeout_lo_us // (N * (2 * N - 1))
+        ),
     )
 
 

@@ -313,6 +313,13 @@ def make_paxos_spec(
             **({"acks": jnp.uint8} if N <= 8 else
                {"acks": jnp.uint16} if N <= 16 else {}),
         },
+        # explicitly declared: every narrowed field is a step-closed
+        # enum/mask — no rate-argument bounds, so the Layer-3 range
+        # certifier (analysis/ranges.py) must certify this spec
+        # trivially (unbounded safe horizon) from the interval pass
+        # alone. Ballots/round staying i32 (see above) is exactly what
+        # keeps this table floor-free.
+        rate_floors={},
     ))
 
 

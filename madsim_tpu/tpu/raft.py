@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, tree_select
+from .spec import Outbox, ProtocolSpec, RateFloor, tree_select
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 REQUEST_VOTE, VOTE_RESP, APPEND, APPEND_RESP, SNAP = 0, 1, 2, 3, 4
@@ -696,6 +696,22 @@ def make_raft_spec(
         # minutes; the engine further derates for clock skew, which can
         # shrink timer floors by up to max_ppm * 1e-6)
         narrow_horizon_us=65_535 * election_lo_us // N,
+        # the same rate argument, machine-readable: the Layer-3 range
+        # certifier (analysis/ranges.py) verifies inc=1 against the
+        # traced step (no path bumps a term by more than one per event),
+        # rederives the safe horizon from (floor, ratchet, dtype) and
+        # checks it covers narrow_horizon_us above after skew derating.
+        # base_term/log_term hold COPIES of term values, so term's bound
+        # is theirs too — same floor.
+        rate_floors={
+            f: RateFloor(
+                floor_us=election_lo_us, ratchet=N,
+                why="election deadlines (incl. restart) draw >= "
+                "election_lo; adoption ratchets the global max <= N "
+                "times per window",
+            )
+            for f in ("term", "base_term", "log_term")
+        },
     )
 
 

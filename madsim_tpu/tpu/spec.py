@@ -68,6 +68,75 @@ REBASE_US = 1 << 28  # ~268 virtual seconds per epoch
 INF_GUARD = jnp.int32(1 << 30)
 
 
+def derate_horizon(cap_us: int, skew_max_ppm: int) -> int:
+    """Derate a narrow-dtype safe horizon for clock skew.
+
+    Clock skew shrinks every relative timer delay by up to
+    (1 - max_ppm * 1e-6), speeding the bounding cadence (the rate floor
+    behind a `narrow_horizon_us` declaration) up by the same factor, so
+    any cadence-argument horizon cap shrinks with it. This is THE
+    derating formula: the engine refusal (BatchedSim.__init__) and the
+    range certifier (analysis/ranges.py) both call it, so the two can
+    never drift — tests/test_ranges.py pins the agreement.
+    """
+    if not (0 <= int(skew_max_ppm) < 1_000_000):
+        raise ValueError(
+            f"skew_max_ppm must be in [0, 1e6), got {skew_max_ppm}"
+        )
+    return int(cap_us) * (1_000_000 - int(skew_max_ppm)) // 1_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class RateFloor:
+    """Machine-readable cadence bound behind a rate-argument narrowing.
+
+    Declares, for one `narrow_fields` entry, the ADVERSARIAL rate model
+    that makes its narrow dtype safe: the field's global maximum gains at
+    most `ratchet * inc` per `floor_us` of virtual time. `floor_us` is
+    the minimum virtual-time spacing of the driver event (a timer re-arm
+    floor: every deadline draw for the driving timer is >= floor_us,
+    including restart paths), `ratchet` how many global-max increments
+    one floor window admits (raft divides by N because nodes ADOPT the
+    global max before bumping), and `inc` the largest single-event
+    increment — which the range certifier VERIFIES against the traced
+    step program instead of trusting. The certified safe horizon is then
+
+        (dtype_max - init_max) * floor_us // (ratchet * inc)
+
+    and must cover the spec's declared `narrow_horizon_us` (both skew-
+    derated through `derate_horizon`). See analysis/ranges.py and
+    docs/analysis.md Layer 3."""
+
+    floor_us: int
+    ratchet: int = 1
+    inc: int = 1
+    why: str = ""
+
+    def __post_init__(self):
+        if self.floor_us <= 0 or self.ratchet <= 0 or self.inc <= 0:
+            raise ValueError(
+                "RateFloor floor_us/ratchet/inc must all be positive, got "
+                f"({self.floor_us}, {self.ratchet}, {self.inc})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class HardCap:
+    """Machine-readable horizon-INDEPENDENT value bound behind a
+    narrowing: the field provably never exceeds `cap` (inclusive) no
+    matter the horizon — e.g. kv's `epoch * REV_STRIDE + wcount` must fit
+    i32, so epoch <= (2^31 - 1) // REV_STRIDE regardless of time. The
+    range certifier checks cap fits the declared narrow dtype and emits
+    an unbounded certified horizon for the field."""
+
+    cap: int
+    why: str = ""
+
+    def __post_init__(self):
+        if self.cap < 0:
+            raise ValueError(f"HardCap cap must be >= 0, got {self.cap}")
+
+
 def buggify(key, site: int, p: float = 0.25):
     """Cooperative fault injection inside spec handlers — the
     FoundationDB-style `buggify!()` (reference buggify.rs:8-32) for the
@@ -254,6 +323,26 @@ class ProtocolSpec:
     # or shorten the horizon) instead of letting a legal long-soak config
     # silently wrap a narrow counter. None = table is horizon-independent.
     narrow_horizon_us: Any = None
+    # OPTIONAL machine-readable bound declarations backing narrow_fields
+    # (the Layer-3 range certifier, analysis/ranges.py): {field ->
+    # RateFloor | HardCap}. Before this existed the cadence floors behind
+    # the rate-argument narrow bounds (raft's election_lo, twopc's 1 ms
+    # re-arm floor, kv's REV_STRIDE cap) lived only in comments; declared
+    # here they become inputs to an interval abstract interpretation that
+    # PROVES each field's certified safe horizon >= narrow_horizon_us
+    # instead of trusting the hand-derived formula. A narrow field with
+    # no entry must be STEP-CLOSED (enums, masks, ids — the interpreter
+    # checks its reachable interval never escapes the narrow dtype);
+    # {} explicitly declares "every narrowed field is closed". None =
+    # not yet declared (the certifier then treats all fields as closed,
+    # which is also what an empty dict means — the distinction is purely
+    # for the reader). Entry TYPES are engine-validated at construction;
+    # keys that name fields outside the live narrow table are INERT (so
+    # `replace(spec, narrow_fields=...)` experimentation never forces
+    # re-deriving this table) — a typo'd key therefore surfaces as the
+    # real field classifying "closed" in the range certificate, not as
+    # a construction error.
+    rate_floors: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -63,7 +63,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec
+from .spec import Outbox, ProtocolSpec, RateFloor
 
 NONE, COMMIT, ABORT = 0, 1, 2
 PREPARE, VOTE, OUTCOME, DREQ = 0, 1, 2, 3
@@ -427,6 +427,19 @@ def make_twopc_spec(
         # txn_gap/2 ~ 10.9 min) holds for calm configs but NOT under
         # aggressive crash plans, so the guard uses the hard floor.
         narrow_horizon_us=32_767 * 1_000,
+        # the same rate argument, machine-readable for the Layer-3 range
+        # certifier (analysis/ranges.py): one global mint per 1 ms floor
+        # (ratchet=1 — only the coordinator mints), inc=1 verified
+        # against the traced step. o_tid/v_tid hold COPIES of minted
+        # tids, so tid_cur's bound is theirs too.
+        rate_floors={
+            f: RateFloor(
+                floor_us=1_000, ratchet=1,
+                why="a mint needs a coordinator timer fire; every re-arm "
+                "(init, post-start, retry, restart) draws >= 1_000 us",
+            )
+            for f in ("tid_cur", "o_tid", "v_tid")
+        },
     )
 
 

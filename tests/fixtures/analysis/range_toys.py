@@ -1,0 +1,85 @@
+"""Planted range-rule violations as toy step programs.
+
+Each function is a deliberately broken miniature of the value-safety
+pattern the Layer-3 range certifier guards (analysis/ranges.py), with a
+clean twin beside it; tests/test_ranges.py traces them with
+jax.make_jaxpr and asserts the matching check FIRES (and that the twin
+passes). Kept tiny so interval propagation is milliseconds."""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# --------------------------------------------- narrow counter, no floor
+
+class ToyNode(NamedTuple):
+    count: Any  # u16 at rest — the planted wrap
+
+
+def counter_step(node: ToyNode, tick):
+    """A u16 counter incremented EVERY step with no cadence floor: the
+    exact bug class `spec.narrow_horizon_us` exists to refuse. With no
+    RateFloor declared the certifier must treat the field as step-closed,
+    see the +1 escape the dtype, and fire naming the field."""
+    wide = node.count.astype(jnp.int32) + 1
+    return ToyNode(count=wide.astype(jnp.uint16)), tick
+
+
+def counter_clamped_step(node: ToyNode, tick):
+    """The clean twin: the increment saturates at the dtype boundary, so
+    the reachable interval is closed over u16 and certifies floor-free."""
+    wide = jnp.minimum(node.count.astype(jnp.int32) + 1, 65535)
+    return ToyNode(count=wide.astype(jnp.uint16)), tick
+
+
+# ------------------------------------- i32 time accumulator that wraps
+
+def time_unit_wrap_step(t_ms, deliver):
+    """The classic unit-conversion clock wrap: virtual time kept in
+    MILLIseconds fits i32 over the whole horizon, but the microsecond
+    conversion (t_ms * 1000) overflows i32 INSIDE the declared horizon.
+    Seeded with t_ms in [0, horizon_ms], the multiply's mathematical
+    interval escapes int32 and the clock-wrap check must fire."""
+    t_us = t_ms * 1000  # wraps past ~2147 virtual seconds
+    return t_us + 5_000, deliver + t_us
+
+
+def time_rebased_step(clock, deliver):
+    """The clean twin — the engine's actual discipline: offsets stay
+    below the rebase guard (INF_GUARD), so every adder in the step keeps
+    the math far inside i32."""
+    window = clock + 1_000
+    return window, jnp.minimum(deliver, window + 100_000)
+
+
+def time_scan_wrap_step(t0):
+    """A time accumulator wrapped INSIDE a loop: 4000 steps of up to
+    1 ms each starting from an in-range offset. The abstract unroll of
+    the scan must surface the iteration where the running i32 offset
+    escapes int32 (no rebase in sight — the planted bug)."""
+
+    def body(t, _):
+        return t + 1_000_000, ()
+
+    t, _ = lax.scan(body, t0, (), length=4000)
+    return t
+
+
+# ----------------------------------------------- dynamic index bounds
+
+def index_oob_step(x, slot):
+    """A pool-slot read whose cursor is NOT provably inside the pool:
+    slot arrives in [0, 63] but the pool holds 16 slots, and the gather
+    promises in-bounds — undefined behavior the certifier must refuse."""
+    cursor = jnp.minimum(slot, 63)
+    return x.at[cursor].get(mode="promise_in_bounds")
+
+
+def index_ring_step(x, slot):
+    """The clean twin — the engine's ring-cursor idiom: the modulo by
+    the static ring depth proves the index in-bounds for any input."""
+    cursor = slot % x.shape[0]
+    return x.at[cursor].get(mode="promise_in_bounds")

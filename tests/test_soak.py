@@ -86,7 +86,17 @@ def test_kv_time_fields_rebase_across_epochs():
     real-time order valid — violations would fire otherwise, and the
     watermark times must stay in the current basis (< REBASE + slack)."""
     wl = kv_workload(virtual_secs=900.0)  # ~3.3 epochs
-    sim = BatchedSim(wl.spec, wl.config)
+    # 900 virtual seconds exceeds kv's CERTIFIED narrow-epoch horizon
+    # (~218 s: the range certifier re-classified the u16 epoch bound as
+    # a rate argument — see tpu/kv.py rate_floors — and the engine now
+    # refuses longer narrow soaks). This test is about time_fields
+    # rebasing, not the narrow table: run it wide, the documented
+    # long-soak path. Narrowing invariance is pinned separately in
+    # test_state_layout.py.
+    import dataclasses
+
+    spec = dataclasses.replace(wl.spec, narrow_fields=None)
+    sim = BatchedSim(spec, wl.config)
     state = sim.run(jnp.arange(4), max_steps=1_200_000, dispatch_steps=50_000)
     s = summarize(state, wl.spec)
     assert s["violations"] == 0

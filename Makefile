@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -33,6 +33,9 @@ explore-smoke:   ## coverage-guided search smoke: monotone coverage + meta-seed 
 campaign-smoke:  ## mini campaign: kill -> resume fingerprint match, dedup, merge/cmin, regression replay
 	$(PY) -m madsim_tpu.analysis --quiet --rule range --workload raft
 	$(PY) -m pytest tests/test_campaign.py -q -m "chaos and not slow"
+
+refill-smoke:    ## continuous batching: >=90% occupancy on a 10x horizon-spread mix, dispatch budget, bit-identity (<60s)
+	$(PY) benches/refill_smoke.py
 
 regression:      ## replay the regression corpus of deduped bug bundles green
 	$(PY) -m madsim_tpu.campaign regress $(if $(REGRESSION_DIR),--dir $(REGRESSION_DIR),)

@@ -348,6 +348,9 @@ def bench_roofline(lanes: int, virtual_secs: float, client_rate: float) -> dict:
             "roofline_carry_floor_ms": raft.get("carry_floor_ms"),
             "roofline_step_over_floor": raft.get("step_over_floor"),
             "roofline_rows": rows,
+            # continuous batching (r9): lane occupancy refill-vs-chunked
+            # on a 10x horizon-spread mix + the lane-step advantage
+            "refill_occupancy": rl.refill_occupancy(),
         }
     except Exception as e:  # noqa: BLE001 - diagnostics must not kill BENCH
         return {"roofline_error": str(e)[:200]}

@@ -1,0 +1,109 @@
+"""refill-smoke: <60s continuous-batching gate for CI and the tier-1 tier.
+
+The refill engine's whole value proposition is two platform-independent
+numbers, so this smoke asserts them without touching wall-clock:
+
+  * LANE OCCUPANCY >= 90% on a synthetic workload mix with a 10x horizon
+    spread (one long admission per 8 — the ddmin-probe / short-mutant
+    shape): busy-lane-steps / total-lane-steps, counted by the engine's
+    own in-carry occupancy counters;
+  * the DISPATCH BUDGET: a refill sweep is init + segments + early-stop
+    reductions like any chunked sweep — an eager-init-style regression
+    (per-retirement host round-trips would be the refill analog of the
+    r5 dispatch storm) blows the budget loudly;
+  * the LANE-STEP ADVANTAGE >= 2x: total lane-steps the chunked path
+    burns for the SAME per-seed results, the hardware-independent form
+    of the "ddmin wall-clock down >= 2x" claim (wall follows lane-steps
+    once the step is bandwidth-bound — bench.py measures that on-chip);
+  * per-seed BIT-IDENTITY of the two paths' violation/step rows on this
+    mix (the determinism contract at smoke scale; the full matrix lives
+    in tests/test_refill.py).
+
+Wall times are printed for eyes only. Usage:
+python benches/refill_smoke.py  (or `make refill-smoke`)
+Exit code != 0 on any assertion failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LANES = 16
+WAVES = 16  # admissions = LANES * WAVES (deep enough that the tail
+#            drain — surviving long lanes after the queue empties —
+#            stays amortized, the production serving shape)
+SPREAD = 10  # long-to-short horizon ratio
+OCCUPANCY_FLOOR = 0.90
+ADVANTAGE_FLOOR = 2.0
+# init + sweep segments + early-stop reductions for the whole refill
+# sweep; the smoke mix finishes in ONE ~2k-iteration segment, so the
+# budget is tiny and fixed (see engine.run_state's accounting)
+DISPATCH_BUDGET = 6
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    import numpy as np
+
+    import roofline as rl
+
+    row = rl.refill_occupancy(
+        lanes=LANES, waves=WAVES, spread=SPREAD, virtual_secs=1.0,
+    )
+    failures = []
+    if row["occupancy_refill"] < OCCUPANCY_FLOOR:
+        failures.append(
+            f"occupancy {row['occupancy_refill']} < {OCCUPANCY_FLOOR} on "
+            f"the {SPREAD}x horizon-spread mix"
+        )
+    if row["lane_step_advantage"] < ADVANTAGE_FLOOR:
+        failures.append(
+            f"lane-step advantage {row['lane_step_advantage']} < "
+            f"{ADVANTAGE_FLOOR}x vs the chunked path"
+        )
+    if row["dispatches_refill"] > DISPATCH_BUDGET:
+        failures.append(
+            f"refill sweep cost {row['dispatches_refill']} dispatches "
+            f"(budget {DISPATCH_BUDGET}) — a host round-trip leaked into "
+            "the retirement loop?"
+        )
+
+    # per-seed bit-identity of the two paths on the same mix (smoke
+    # scale): every admission's violation verdict and step counters must
+    # match its chunked row exactly
+    import dataclasses
+
+    from madsim_tpu.tpu import raft_workload
+    from madsim_tpu.tpu.batch import run_batch
+
+    wl = dataclasses.replace(raft_workload(), host_repro=None)
+    seeds = range(LANES * 3)
+    rc = run_batch(seeds, wl, chunk=LANES, mesh=None, max_traces=0)
+    rr = run_batch(seeds, wl, chunk=LANES * 3, mesh=None, max_traces=0,
+                   refill=LANES // 2)
+    if not np.array_equal(rc.violated, rr.violated):
+        failures.append("refill/chunked violation rows differ")
+    if not np.array_equal(rc.violation_step, rr.violation_step):
+        failures.append("refill/chunked violation_step rows differ")
+    if rc.summary["total_events"] != rr.summary["total_events"]:
+        failures.append("refill/chunked event totals differ")
+
+    out = {
+        "refill_occupancy": row,
+        "bit_identity": not any("differ" in f for f in failures),
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "ok": not failures,
+        "failures": failures,
+    }
+    print(json.dumps(out), flush=True)
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -378,6 +378,101 @@ def per_workload_roofline(lanes: int = 32768, scan: int = 300,
     return {"attainable_hbm_gbs": round(bw, 1), "rows": rows}
 
 
+def refill_occupancy(
+    lanes: int = 256, waves: int = 8, spread: int = 10,
+    long_every: int = 8, virtual_secs: float = 2.0,
+    max_steps: int = 50_000,
+) -> dict:
+    """The continuous-batching headline metric (r9): LANE OCCUPANCY —
+    busy-lane-steps / total-lane-steps per dispatch — on a synthetic
+    workload mix with a `spread`x horizon spread (one long admission per
+    `long_every`, the ddmin-probe / short-mutant shape), refill vs the
+    chunked path on the SAME admissions. Also reports the lane-step
+    advantage: how many total lane-steps the chunked path burns per
+    refill lane-step for identical per-seed results (wall-clock-free, so
+    the number is hardware-independent; the wall ratio follows it once
+    the step is bandwidth-bound). Reported into BENCH by bench.py and
+    asserted >= 0.9 occupancy by `make refill-smoke`."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from madsim_tpu import nemesis as nem
+    from madsim_tpu.tpu import make_raft_spec
+    from madsim_tpu.tpu import nemesis as tn
+    from madsim_tpu.tpu.engine import (
+        BatchedSim, TriageCtl, refill_results,
+    )
+    from madsim_tpu.tpu.spec import REBASE_US, SimConfig
+
+    horizon = int(virtual_secs * 1e6)
+    plan = nem.FaultPlan(name="refill-occ", clauses=(
+        nem.Crash(interval_lo_us=horizon // 6, interval_hi_us=horizon // 2,
+                  down_lo_us=horizon // 8, down_hi_us=horizon // 3),
+        nem.MsgLoss(rate=0.05),
+    ))
+    cfg = tn.compile_plan(plan, SimConfig(horizon_us=horizon))
+    sim = BatchedSim(make_raft_spec(), cfg, triage=True)
+    A = lanes * waves
+    seeds = np.arange(A, dtype=np.uint32)
+    h = np.where(
+        np.arange(A) % long_every == 0, horizon, horizon // spread
+    ).astype(np.int64)
+
+    def ctl_rows(sel):
+        n = int(sel.sum()) if sel.dtype == bool else len(sel)
+        hs = h[sel]
+        return TriageCtl(
+            off=jnp.zeros((n,), jnp.int32),
+            occ=jnp.zeros((n, 4), jnp.int32),
+            rate_scale=jnp.ones((n, 3), jnp.float32),
+            h_epoch=jnp.asarray((hs // REBASE_US).astype(np.int32)),
+            h_off=jnp.asarray((hs % REBASE_US).astype(np.int32)),
+        )
+
+    all_rows = ctl_rows(np.ones((A,), bool))
+    t0 = time.perf_counter()
+    d0 = sim.dispatch_count
+    st = sim.run_refill(seeds, lanes=lanes, max_steps=max_steps,
+                        ctl=all_rows)
+    res = refill_results(st)
+    refill_ms = (time.perf_counter() - t0) * 1e3
+    refill_disp = sim.dispatch_count - d0
+
+    chunk_busy = chunk_total = 0
+    t0 = time.perf_counter()
+    d0 = sim.dispatch_count
+    for off in range(0, A, lanes):
+        sel = np.zeros((A,), bool)
+        sel[off:off + lanes] = True
+        stc = sim.run(seeds[off:off + lanes], max_steps=max_steps,
+                      dispatch_steps=max_steps, ctl=ctl_rows(sel))
+        steps = np.asarray(stc.steps, np.int64)
+        chunk_busy += int(steps.sum())
+        chunk_total += int(steps.max(initial=0)) * steps.shape[0]
+    chunked_ms = (time.perf_counter() - t0) * 1e3
+    chunked_disp = sim.dispatch_count - d0
+
+    return {
+        "lanes": lanes,
+        "admissions": A,
+        "horizon_spread": spread,
+        "long_every": long_every,
+        "occupancy_refill": round(float(res["occupancy"]), 4),
+        "occupancy_chunked": round(chunk_busy / max(chunk_total, 1), 4),
+        "busy_lane_steps": res["busy_lane_steps"],
+        "total_lane_steps_refill": res["total_lane_steps"],
+        "total_lane_steps_chunked": chunk_total,
+        # chunked lane-steps burned per refill lane-step, same results
+        "lane_step_advantage": round(
+            chunk_total / max(res["total_lane_steps"], 1), 2
+        ),
+        "dispatches_refill": refill_disp,
+        "dispatches_chunked": chunked_disp,
+        "refill_wall_ms": round(refill_ms, 1),
+        "chunked_wall_ms": round(chunked_ms, 1),
+    }
+
+
 def step_cost(sim, state):
     """XLA cost analysis of the compiled single-step program."""
     compiled = compile_sweep_step(sim, state)
@@ -538,7 +633,15 @@ def main() -> None:
         help="emit one roofline row per device workload instead of the "
         "headline-raft deep dive",
     )
+    parser.add_argument(
+        "--occupancy", action="store_true",
+        help="emit the continuous-batching lane-occupancy row (refill vs "
+        "chunked on a 10x horizon-spread mix) instead of the deep dive",
+    )
     args = parser.parse_args()
+    if args.occupancy:
+        print(json.dumps(refill_occupancy()), flush=True)
+        return
     if args.per_workload:
         print(json.dumps(per_workload_roofline(args.lanes, args.scan)),
               flush=True)

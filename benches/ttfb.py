@@ -173,24 +173,53 @@ PLANTED = {
 def measure_ttfb(
     workload, chunk: int = 1024, max_seeds: int = 8192,
     shrink: bool = True, out_dir: "str | None" = None,
-    lane_width: int = 16,
+    lane_width: int = 16, refill: int = 0,
 ) -> dict:
     """Sweep seeds in chunks from a COLD runtime until the first violation,
     then shrink it to a ReproBundle. The chunk loop is double-buffered like
     run_batch's (chunk k+1 in flight while chunk k's violation scalars are
     decoded), and every wall-clock number includes everything the user
-    would wait for — compiles included."""
+    would wait for — compiles included.
+
+    `refill=<lanes>` sweeps each chunk continuously batched instead
+    (engine.run_refill): lanes retiring at first violation immediately
+    admit the next seed, so the chip spends no time running doomed-lane
+    tails to the horizon. The first violation is identified and
+    TIMESTAMPED from the retired admission's own harvested row — its
+    `violation_step` and virtual `violation_t_us` — in admission order,
+    NEVER from the segment-end state (a refill segment retires hundreds
+    of admissions before the host sees anything; the row is the only
+    honest per-admission clock). ttfb(refill) therefore reports the SAME
+    violating seed, violation_step and violation_t_us as the chunked
+    sweep (pinned by tests/test_refill.py), with wall-clock the only
+    thing that moves."""
     import numpy as np
 
     from madsim_tpu import triage
     from madsim_tpu.tpu.batch import pipelined
-    from madsim_tpu.tpu.engine import BatchedSim
+    from madsim_tpu.tpu.engine import BatchedSim, refill_results
+    from madsim_tpu.tpu.spec import REBASE_US
 
     t0 = time.perf_counter()
     sim = BatchedSim(workload.spec, workload.config)
+    first_violation: dict = {}
 
     def dispatch(lo: int):
         seeds = np.arange(lo, lo + chunk, dtype=np.uint32)
+        if refill:
+            # ONE segment, like the chunked branch below: total_steps ==
+            # dispatch_steps keeps the engine's inter-segment early-stop
+            # reduction out of dispatch(), so the refill segment is
+            # launched without blocking the host and chunk k+1 really is
+            # in flight while chunk k decodes. The bound is generous
+            # (every admission's full per-admission budget in sequence
+            # would fit twice over) and the while_loop exits when the
+            # queue drains regardless.
+            total = workload.max_steps * ((-(-chunk // refill)) + 1) * 2
+            return seeds, sim.run_refill(
+                seeds, lanes=refill, max_steps=workload.max_steps,
+                total_steps=total, dispatch_steps=total,
+            )
         # ONE segment per chunk (dispatch_steps == max_steps): the engine's
         # multi-segment early-stop blocks the host on an inter-segment
         # reduction, which would delay decode(k) — and the violation
@@ -211,12 +240,31 @@ def measure_ttfb(
     def decode(entry):
         nonlocal first_chunk_s, swept
         seeds, st = entry
-        violated = np.asarray(st.violated)
+        if refill:
+            res = refill_results(st)
+            violated = res["violated"]
+        else:
+            res = None
+            violated = np.asarray(st.violated)
         swept += seeds.size
         if first_chunk_s is None:
             first_chunk_s = time.perf_counter() - t0
         if violated.any():
-            return int(seeds[violated][0])
+            i = int(np.nonzero(violated)[0][0])  # admission order
+            if refill:
+                vs = int(res["violation_step"][i])
+                vt = int(res["violation_epoch"][i]) * REBASE_US + int(
+                    res["violation_at"][i]
+                )
+            else:
+                vs = int(np.asarray(st.violation_step)[i])
+                vt = int(np.asarray(st.violation_epoch)[i]) * REBASE_US + (
+                    int(np.asarray(st.violation_at)[i])
+                )
+            first_violation.update(
+                violation_step=vs, violation_t_us=vt,
+            )
+            return int(seeds[i])
         return None
 
     # double-buffered: chunk k+1 is in flight while chunk k's violation
@@ -228,6 +276,8 @@ def measure_ttfb(
         "seeds_swept": swept,
         "first_chunk_s": round(first_chunk_s or 0.0, 3),
     }
+    if refill:
+        out["refill_lanes"] = refill
     if found is None:
         out["found"] = False
         out["wall_to_first_violation_s"] = None
@@ -237,6 +287,10 @@ def measure_ttfb(
         "found": True,
         "violating_seed": found,
         "wall_to_first_violation_s": round(t_first, 3),
+        # the admission's own record of WHEN it violated (virtual time /
+        # step index) — identical between the refill and chunked sweeps
+        # for the same seed (per-admission bit-identity)
+        **first_violation,
     })
     if shrink:
         own_tmp = None
@@ -260,7 +314,7 @@ def measure_ttfb(
 
 def ttfb_all(chunk: int = 1024, max_seeds: int = 8192,
              shrink: bool = True, host_baseline: bool = True,
-             host_deadline_s: float = 180.0) -> dict:
+             host_deadline_s: float = 180.0, refill: int = 64) -> dict:
     rows = {}
     for name, (factory, host_fn) in PLANTED.items():
         try:
@@ -270,6 +324,27 @@ def ttfb_all(chunk: int = 1024, max_seeds: int = 8192,
         except Exception as e:  # noqa: BLE001 - one bad config must not
             # hide the other's number
             row = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+        if refill:
+            # the continuously batched sweep of the same config (cold
+            # runtime again): must identify the SAME violation (seed /
+            # step / virtual time); only wall-clock may move
+            try:
+                r2 = measure_ttfb(
+                    factory(), chunk=chunk, max_seeds=max_seeds,
+                    shrink=False, refill=refill,
+                )
+                row["refill"] = {
+                    k: r2.get(k) for k in (
+                        "refill_lanes", "found", "seeds_swept",
+                        "first_chunk_s", "wall_to_first_violation_s",
+                        "violating_seed", "violation_step",
+                        "violation_t_us",
+                    )
+                }
+            except Exception as e:  # noqa: BLE001
+                row["refill"] = {
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"
+                }
         if host_baseline and host_fn is not None:
             try:
                 host = measure_host_ttfb(host_fn, deadline_s=host_deadline_s)
@@ -304,12 +379,17 @@ def main() -> None:
     parser.add_argument("--no-shrink", action="store_true")
     parser.add_argument("--no-host", action="store_true")
     parser.add_argument("--host-deadline", type=float, default=180.0)
+    parser.add_argument(
+        "--refill", type=int, default=64, metavar="LANES",
+        help="also sweep each config continuously batched over LANES "
+        "lanes (0 disables)",
+    )
     args = parser.parse_args()
     print(
         json.dumps(ttfb_all(
             args.chunk, args.max_seeds, shrink=not args.no_shrink,
             host_baseline=not args.no_host,
-            host_deadline_s=args.host_deadline,
+            host_deadline_s=args.host_deadline, refill=args.refill,
         )),
         flush=True,
     )

@@ -55,7 +55,11 @@ LINT_RULES = (
 )
 ALL_RULES = JAXPR_RULES + LINT_RULES
 
-WORKLOADS = ("raft", "kv", "paxos", "twopc", "chain")
+# "raft-refill" is raft's continuously batched step (the refill carry
+# partition + device-resident admission queue, docs/continuous_batching.md)
+# — every jaxpr/range rule runs against that carry too, so `make analyze`
+# gates the refill engine exactly like the plain partitions.
+WORKLOADS = ("raft", "kv", "paxos", "twopc", "chain", "raft-refill")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -74,13 +74,27 @@ from .jaxprutil import (
 # structural dimension (node count, pool slots, payload width, clause
 # rows) uses, so "shape[0] == LANES" identifies the lane axis reliably
 LANES = 13
+# admission-queue length for the refill trace: a distinct prime, so the
+# queue axis can never be mistaken for the lane axis
+REFILL_ADMISSIONS = 29
+
+# the refill step's sanctioned lane-axis primitives (engine._refill_apply):
+# the retirement rank (cumsum), the admitted count (reduce_sum) and the
+# any-retired cond predicate (reduce_or) couple lanes ONLY in the
+# seed->lane ASSIGNMENT — never in any admission's trajectory, which stays
+# the pure per-seed function chunking/sharding bit-identity needs (the
+# refill determinism tests pin exactly that). Everything else in the
+# refill step remains subject to the lane rule.
+REFILL_LANE_ALLOW = ("cumsum", "reduce_sum", "reduce_or")
 
 # occurrence counters: the ONLY non-key values a schedule draw may touch
 NEUTRAL_LEAVES = frozenset({
     "hot.nem.crash_k", "hot.nem.part_k", "hot.nem.clog_k",
     "hot.nem.spike_k",
 })
-KEY0_LEAVES = frozenset({"const.key0"})
+# the schedule key root: ConstState.key0 on the plain partition, carried
+# as hot.key0 on the refill partition (a refilled lane adopts a new root)
+KEY0_LEAVES = frozenset({"const.key0", "hot.key0"})
 KEYCHAIN_LEAVES = frozenset({"hot.key"})
 
 # time-typed leaves (virtual-us offsets): the operands the integer-ppm
@@ -130,13 +144,16 @@ def spec_factories() -> Dict[str, object]:
     }
 
 
-def build_verified_sim(name: str, lanes: int = LANES):
+def build_verified_sim(name: str, lanes: int = LANES, refill: bool = False):
     """(sim, state, hot, cold, const) — all abstract (ShapeDtypeStructs).
 
-    `state` is the eval_shape of the real `_init`; hot/cold/const the
-    real `split_state` partition. Nothing touches a device."""
+    `state` is the eval_shape of the real `_init` (or, with `refill`, of
+    the real `init_refill` with a REFILL_ADMISSIONS-deep queue — the
+    continuous-batching carry partition); hot/cold/const the real
+    `split_state` partition. Nothing touches a device."""
+    from ..nemesis import OCC_CLAUSES, RATE_CLAUSES
     from ..tpu import nemesis as tpun
-    from ..tpu.engine import BatchedSim, split_state
+    from ..tpu.engine import BatchedSim, TriageCtl, split_state
     from ..tpu.spec import SimConfig
 
     factories = spec_factories()
@@ -155,7 +172,23 @@ def build_verified_sim(name: str, lanes: int = LANES):
     )
     sim = BatchedSim(spec, cfg, triage=True, coverage=True)
     seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
-    state = jax.eval_shape(sim._init, seeds)
+    if refill:
+        A = REFILL_ADMISSIONS
+        qseeds = jax.ShapeDtypeStruct((A,), jnp.uint32)
+        qctl = TriageCtl(
+            off=jax.ShapeDtypeStruct((A,), jnp.int32),
+            occ=jax.ShapeDtypeStruct((A, len(OCC_CLAUSES)), jnp.int32),
+            rate_scale=jax.ShapeDtypeStruct(
+                (A, len(RATE_CLAUSES)), jnp.float32
+            ),
+            h_epoch=jax.ShapeDtypeStruct((A,), jnp.int32),
+            h_off=jax.ShapeDtypeStruct((A,), jnp.int32),
+        )
+        state = jax.eval_shape(
+            lambda s, c: sim.init_refill(s, lanes, c), qseeds, qctl,
+        )
+    else:
+        state = jax.eval_shape(sim._init, seeds)
     hot, cold, const = split_state(state)
     return sim, state, hot, cold, const
 
@@ -177,6 +210,16 @@ def _time_leaves(sim) -> Set[str]:
     return names
 
 
+# refill admission inputs: the queue's seed column and the cursor /
+# per-lane admission indices are schedule ROOTS (which work runs next),
+# not trajectory material — neutral like the occurrence counters, so a
+# refilled lane's re-init draws read as the pure (seed, site, k)
+# functions they are. The queue's ctl rows stay STATE like every ctl.
+REFILL_NEUTRAL = frozenset({
+    "const.queue.seeds", "cold.refill.cursor", "cold.refill.admitted",
+})
+
+
 def _invar_masks(names: Sequence[str], time_leaves: Set[str]) -> List[int]:
     masks = []
     for n in names:
@@ -184,7 +227,7 @@ def _invar_masks(names: Sequence[str], time_leaves: Set[str]) -> List[int]:
             masks.append(KEY)
         elif n in KEYCHAIN_LEAVES:
             masks.append(KEY2)
-        elif n in NEUTRAL_LEAVES:
+        elif n in NEUTRAL_LEAVES or n in REFILL_NEUTRAL:
             masks.append(0)
         elif n in time_leaves:
             masks.append(STATE | TIME)
@@ -219,7 +262,15 @@ def check_rng_taint(
     key_out_index: Optional[int] = None,
     salt_values: Sequence[int] = (),
 ) -> RuleResult:
-    """Schedule purity + funnel containment over the murmur mix eqns."""
+    """Schedule purity + funnel containment over the murmur mix eqns.
+
+    The refill trace passes this check STRICTLY too: the admission
+    inputs a refilled lane's chain root derives from (queue seed column,
+    cursor, admission ids) are classified neutral (REFILL_NEUTRAL — they
+    are schedule roots, like the occurrence counters), retirement FLAGS
+    shed their taint at the bool boundary (control flow doesn't launder
+    values; jaxprutil.TaintMap), and the re-init select then carries the
+    chain key alone."""
     res = RuleResult("rng-taint")
     masks = _invar_masks(invar_names, time_leaves)
     # taint per mix eqn is ACCUMULATED across visits and judged after the
@@ -316,13 +367,29 @@ def check_dtype(
                 "values must stay i32 (epoch-rebased offsets)",
             )
 
-    # float-on-time: forward TIME taint; any floating-dtype output of an
-    # eqn with a TIME-tainted operand is the f32-skew bug class
+    # float-on-time: forward TIME taint; a floating-dtype output of an
+    # ARITHMETIC/conversion eqn with a TIME-tainted operand is the
+    # f32-skew bug class. Call primitives (their bodies are recursed
+    # into, so real arithmetic inside is still seen) and dtype-preserving
+    # data movement (a gather whose INDEX is time-derived moves float
+    # data, it doesn't do float math on a time value) are excluded —
+    # the refill step's cond/gather/select plumbing made the
+    # every-primitive form fire on pure routing.
     time_leaves = _time_leaves(sim)
     masks = _invar_masks(invar_names, time_leaves)
     hits: List[Tuple[object, str]] = []
+    from .jaxprutil import _sub_jaxprs
+
+    move_prims = frozenset({
+        "select_n", "gather", "scatter", "scatter-add", "concatenate",
+        "broadcast_in_dim", "transpose", "reshape", "squeeze",
+        "expand_dims", "slice", "dynamic_slice", "dynamic_update_slice",
+        "copy", "rev",
+    })
 
     def visit(eqn, read):
+        if eqn.primitive.name in move_prims or _sub_jaxprs(eqn):
+            return
         tainted = any(read(iv) & TIME for iv in eqn.invars)
         if not tainted:
             return
@@ -483,10 +550,17 @@ def check_run_carry(
 
 
 def check_donation(sim, state, hot, cold, const, where: str = "step") -> RuleResult:
-    """Donated/aliased carry coverage + the hot/cold/const structural split."""
+    """Donated/aliased carry coverage + the hot/cold/const structural split.
+
+    Two partitions are legal (engine.split_state): the plain sweep's
+    const = {key0, ctl, skew_ppm}, and the refill sweep's inverted split
+    — key0/ctl/skew IN the carry (a refilled lane rewrites them from its
+    new admission) with the admission queue as the only const. Which one
+    applies is read off the state's own structure."""
     from ..tpu.engine import carry_partition
 
     res = RuleResult("donation")
+    refill = state.refill is not None
     # the engine's own introspection hook IS the name source: if the
     # split and the hook ever disagree, this rule is checking the wrong
     # partition and should fail loudly with it
@@ -495,23 +569,51 @@ def check_donation(sim, state, hot, cold, const, where: str = "step") -> RuleRes
     cold_names = [f"cold.{n}" for n in part["cold"]]
     const_names = [f"const.{n}" for n in part["const"]]
 
-    # (1) structural split: const is exactly key0 + ctl (+ skew_ppm)
     res.checked += 1
-    if sim.triage and not any(n.startswith("const.ctl.") for n in const_names):
-        res.add(where, "TriageCtl leaves missing from ConstState")
-    if "const.key0" not in const_names:
-        res.add(
-            where,
-            "key0 is not in ConstState — if it rides the carry, donation "
-            "rotates the schedule root through fresh buffers every segment",
-        )
-    for n in ("key0", "ctl"):
+    if refill:
+        # (1') refill structural split: the queue is const, the (now
+        # per-admission) key0/ctl ride the carry, and no queue leaf may
+        # leak into the donated carry
+        if "const.queue.seeds" not in const_names:
+            res.add(where, "refill state without a const admission queue")
+        if "hot.key0" not in hot_names:
+            res.add(
+                where,
+                "refill carry without hot.key0 — a refilled lane cannot "
+                "adopt its admission's schedule root",
+            )
+        if sim.triage and not any(
+            n.startswith("hot.ctl.") for n in hot_names
+        ):
+            res.add(where, "refill carry without per-lane TriageCtl rows")
         leaked = [
-            h for h in hot_names + cold_names
-            if h.split(".", 1)[1].startswith(n)
+            n for n in hot_names + cold_names
+            if n.split(".", 1)[1].startswith("queue")
         ]
         if leaked:
-            res.add(where, f"loop-invariant leaf leaked into the carry: {leaked}")
+            res.add(where, f"queue leaves leaked into the carry: {leaked}")
+    else:
+        # (1) structural split: const is exactly key0 + ctl (+ skew_ppm)
+        if sim.triage and not any(
+            n.startswith("const.ctl.") for n in const_names
+        ):
+            res.add(where, "TriageCtl leaves missing from ConstState")
+        if "const.key0" not in const_names:
+            res.add(
+                where,
+                "key0 is not in ConstState — if it rides the carry, donation "
+                "rotates the schedule root through fresh buffers every segment",
+            )
+        for n in ("key0", "ctl"):
+            leaked = [
+                h for h in hot_names + cold_names
+                if h.split(".", 1)[1].startswith(n)
+            ]
+            if leaked:
+                res.add(
+                    where,
+                    f"loop-invariant leaf leaked into the carry: {leaked}",
+                )
 
     # (2) lowered donation flags on the real _step_split program
     check_step_donation(
@@ -562,6 +664,7 @@ class WorkloadTrace:
     out_names: List[str]  # outvar leaf names (hot./cold./rec. prefixed)
     invars_avals: List[Any]
     time_leaves: Set[str]
+    refill: bool = False  # tracing the continuous-batching partition?
 
 
 _TRACE_CACHE: Dict[Tuple[str, int], WorkloadTrace] = {}
@@ -569,16 +672,24 @@ _TRACE_CACHE: Dict[Tuple[str, int], WorkloadTrace] = {}
 
 def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
     """The per-workload trace, built once per process (abstract only:
-    ShapeDtypeStructs, no XLA compile, no device)."""
+    ShapeDtypeStructs, no XLA compile, no device). A `<workload>-refill`
+    name traces the SAME workload's continuously batched step (the
+    refill carry partition + a REFILL_ADMISSIONS-deep queue) — the
+    target `make analyze` runs every rule against alongside the plain
+    partitions."""
     from ..tpu.engine import named_leaves
 
     key = (name, lanes)
     cached = _TRACE_CACHE.get(key)
     if cached is not None:
         return cached
+    refill = name.endswith("-refill")
+    base = name[: -len("-refill")] if refill else name
     if log:
         log(f"[analysis] tracing {name} step program (L={lanes}) ...")
-    sim, state, hot, cold, const = build_verified_sim(name, lanes=lanes)
+    sim, state, hot, cold, const = build_verified_sim(
+        base, lanes=lanes, refill=refill,
+    )
     closed = jax.make_jaxpr(sim._step_split)(hot, cold, const)
     out_template = jax.eval_shape(sim._step_split, hot, cold, const)
     seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
@@ -603,6 +714,7 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
             + [x for _, x in named_leaves(const, "const")]
         ),
         time_leaves=_time_leaves(sim),
+        refill=refill,
     )
     _TRACE_CACHE[key] = trace
     return trace
@@ -650,7 +762,10 @@ def verify_workload(
             closed, sim, trace.hot, out_template, names, where,
         ))
     if on("lane-independence"):
-        results.append(check_lane_independence(closed, trace.lanes, where))
+        results.append(check_lane_independence(
+            closed, trace.lanes, where,
+            allow=REFILL_LANE_ALLOW if trace.refill else (),
+        ))
     if on("donation"):
         results.append(check_donation(
             sim, trace.state, trace.hot, trace.cold, trace.const,

@@ -124,14 +124,30 @@ class TaintMap:
 
     `invar_masks[i]` seeds the i-th invar; constvars (and literals, read
     lazily) whose scalar value is in `salt_values` carry SALT. Default
-    propagation is the OR of input masks; TIME is stripped from boolean
-    outputs (comparisons launder magnitude taint — the time-f32 rule is
-    about arithmetic on time VALUES, not control flow that looked at
-    one). Sub-jaxprs are entered with each inner invar seeded by the
-    union of the call's operand masks (a sound over-approximation; the
-    engine's step has no nested jaxprs, so in practice this path only
-    runs on the outer `_run` loop check), and their equations are
-    visited too.
+    propagation is the OR of input masks; ALL taint is stripped from
+    boolean outputs (r9 — previously only TIME): a bool is a 1-bit
+    control value, and every rule here targets VALUE flows — keys,
+    times, magnitudes — not control flow that looked at one. The refill
+    engine made this load-bearing: lane-retirement flags are data-flow
+    descendants of handler state (which carries KEY2 through the event
+    merges), and the admission machinery derives from those flags —
+    under bool-carried taint no trajectory-dependent scheduler could
+    ever verify. The trade is explicit: a draw whose index is rebuilt
+    from BOOLEAN trajectory flags launders here (the carry-boundary
+    re-seeding of the occurrence counters always laundered the same
+    way); integer-valued coupling (index=clock and friends) is still
+    caught. Sub-jaxpr handling (r9, grown for the refill step's
+    lax.cond):
+    `pjit` and `cond` bodies are entered with each inner invar seeded by
+    its MATCHING operand's mask (precise 1:1 mapping — the old
+    union-of-all-operands seeding made every value inside the refill
+    branch carry every taint at once), and their per-branch outvar masks
+    map back to the call's outvars (joined across cond branches). The
+    cond PREDICATE deliberately does not fold into the outputs: control
+    dependence does not launder data taint, the same principle as the
+    TIME strip at bools. Loop primitives (while/scan) keep the
+    conservative union seeding iterated to a fixpoint — their carries
+    genuinely re-enter. All sub-jaxpr equations are visited too.
     """
 
     def __init__(
@@ -156,6 +172,11 @@ class TaintMap:
         self._jaxpr = jaxpr
 
     def read(self, atom: Any) -> int:
+        # bools carry no taint wherever they come from (invar, output,
+        # constant): they are 1-bit control values — see the class doc
+        dt = getattr(getattr(atom, "aval", None), "dtype", None)
+        if dt is not None and str(dt) == "bool":
+            return 0
         lv = lit_value(atom)
         if lv is not None and lv in self.salt_values:
             return SALT
@@ -181,19 +202,74 @@ class TaintMap:
         for cv in sub.constvars[len(consts):]:
             self.env.setdefault(cv, 0)
 
+    def _set_outs(self, eqn, masks: Sequence[int]) -> None:
+        # (bool outputs are additionally zeroed at read() — the one
+        # uniform enforcement point of the control-boundary strip)
+        for ov, om in zip(eqn.outvars, masks):
+            dt = getattr(ov.aval, "dtype", None)
+            if dt is not None and str(dt) == "bool":
+                om = 0
+            self.env[ov] = om
+
+    def _call_sub(
+        self, sub: jcore.Jaxpr, consts: tuple, in_masks: Sequence[int],
+        visit,
+    ) -> List[int]:
+        """Enter a sub-jaxpr with 1:1 operand->invar mask seeding and
+        return its outvar masks. Seeding OVERWRITES: jax caches traced
+        helper jaxprs (clip, where, take, ...), so two call sites can
+        share the very same Var objects — OR-accumulating across sites
+        would leak one call's taint into every other (a clip used on a
+        time value somewhere would time-taint the refill step's cursor
+        clip). Each precise call re-propagates the shared body under its
+        own operand masks; the body's bindings are recomputed, so
+        clobbering a previous site's is sound."""
+        self._seed_consts(sub, consts)
+        for v, m in zip(sub.invars, in_masks):
+            self.env[v] = int(m)
+        self._run(sub, visit)
+        return [self.read(ov) for ov in sub.outvars]
+
     def _run(self, jaxpr: jcore.Jaxpr, visit, top: bool = False) -> None:
         for eqn in jaxpr.eqns:
             if top:
                 self.top_eqn = eqn
             if visit is not None:
                 visit(eqn, self.read)
+            name = eqn.primitive.name
+            subs = _sub_jaxprs(eqn)
+            # precise call handling: pjit (1:1 invars) and cond (operand
+            # k+1 -> branch invar k; outvars joined across branches, the
+            # predicate excluded — control flow doesn't launder data
+            # taint). Shape-mismatched calls fall through to the
+            # conservative union path below.
+            if name == "pjit" and len(subs) == 1 and len(
+                subs[0][0].invars
+            ) == len(eqn.invars):
+                in_masks = [self.read(iv) for iv in eqn.invars]
+                outs = self._call_sub(
+                    subs[0][0], subs[0][1], in_masks, visit
+                )
+                self._set_outs(eqn, outs)
+                continue
+            if name == "cond" and subs and all(
+                len(sub.invars) == len(eqn.invars) - 1 for sub, _ in subs
+            ):
+                in_masks = [self.read(iv) for iv in eqn.invars]
+                outs: Optional[List[int]] = None
+                for sub, consts in subs:
+                    res = self._call_sub(sub, consts, in_masks[1:], visit)
+                    outs = res if outs is None else [
+                        a | b for a, b in zip(outs, res)
+                    ]
+                self._set_outs(eqn, outs or [])
+                continue
             m = 0
             for iv in eqn.invars:
                 m |= self.read(iv)
-            subs = _sub_jaxprs(eqn)
             # loop bodies re-enter with their own outputs: iterate to a
             # fixpoint (bounded — masks only grow in a 5-bit lattice)
-            passes = 4 if eqn.primitive.name in _LOOP_PRIMS and subs else 1
+            passes = 4 if name in _LOOP_PRIMS and subs else 1
             for _ in range(passes):
                 grew = False
                 for sub, consts in subs:
@@ -211,12 +287,7 @@ class TaintMap:
                         m = nm
                 if not grew:
                     break
-            for ov in eqn.outvars:
-                om = m
-                dt = getattr(ov.aval, "dtype", None)
-                if dt is not None and str(dt) == "bool":
-                    om &= ~TIME
-                self.env[ov] = om
+            self._set_outs(eqn, [m] * len(eqn.outvars))
 
 
 def is_mix_mul(eqn) -> bool:

@@ -60,6 +60,17 @@ class RuntimeMetrics:
         reports for a device sweep."""
         return self._executor.loop_busy_s * 1e3
 
+    @property
+    def occupancy(self) -> float:
+        """Fraction of scheduling rounds that actually polled a task —
+        the host runtime's counter behind `BatchResult.occupancy`'s
+        busy-lane-steps / total-lane-steps (r9 continuous batching), so
+        refill-vs-host comparisons stay apples-to-apples: both report
+        "of the execution slots the machinery ran, how many did real
+        work"."""
+        ex = self._executor
+        return ex.busy_rounds / max(ex.sched_rounds, 1)
+
     # -- chaos coverage (the nemesis / buggify fire registries) --
 
     def chaos_fires(self) -> Dict[str, int]:

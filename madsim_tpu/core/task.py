@@ -328,6 +328,12 @@ class Executor:
         # drained and wall time spent draining them
         self.sched_rounds = 0
         self.loop_busy_s = 0.0
+        # rounds that actually polled a task (ready queue non-empty at
+        # drain): busy_rounds / sched_rounds is the host runtime's
+        # occupancy counter — the single-lane mirror of the device
+        # engine's busy-lane-steps / total-lane-steps (r9 continuous
+        # batching), so `vs_host` comparisons read one vocabulary
+        self.busy_rounds = 0
 
     # -- task plumbing --
 
@@ -472,6 +478,8 @@ class Executor:
 
     def run_all_ready(self) -> None:
         self.sched_rounds += 1
+        if self.ready:
+            self.busy_rounds += 1
         t0 = _time.perf_counter()
         try:
             self._run_all_ready()

@@ -476,6 +476,8 @@ class Explorer:
         max_shrinks: Optional[int] = None,
         shrink_kwargs: Optional[Dict[str, Any]] = None,
         pipeline: bool = True,
+        refill: bool = True,
+        refill_lanes: Optional[int] = None,
         sim=None,
         log: Optional[Callable[[str], None]] = None,
     ) -> None:
@@ -501,6 +503,17 @@ class Explorer:
         self._shrinks_done = 0
         self.shrink_kwargs = dict(shrink_kwargs or {})
         self.pipeline = bool(pipeline)
+        # continuous batching (r9): a generation's candidates become
+        # ADMISSIONS of one refill sweep over `refill_lanes` device lanes
+        # (default: the chunk width) — lanes whose candidates finish
+        # early (short mutant horizons, early violations) retire and
+        # admit the next genome in-jit instead of idling to the longest
+        # fresh seed's horizon. Decode order stays admission (= pop)
+        # order, so corpus contents, curves and fingerprints are
+        # bit-identical to the chunked path (tested); refill=False keeps
+        # the chunked reference loop.
+        self.refill = bool(refill)
+        self.refill_lanes = None if refill_lanes is None else int(refill_lanes)
         self.say = log or (lambda msg: None)
 
         # ONE sim serves search, shrink and replay: triage threads the ctl
@@ -664,28 +677,17 @@ class Explorer:
         return ctl_for(pop, self._full_h)
 
     def _run_generation(self, gen: int, pop: List[Candidate]) -> None:
-        """Dispatch one generation (chunked + double-buffered like
-        run_batch: chunk k+1 on device while the host ranks chunk k) and
-        fold its coverage into the corpus."""
+        """Dispatch one generation — continuously batched by default (the
+        whole population is the admission queue of one refill sweep), or
+        chunked + double-buffered like run_batch (chunk k+1 on device
+        while the host ranks chunk k) — and fold its coverage into the
+        corpus. Both paths fold candidates in pop order, so the corpus,
+        union, and violation records are bit-identical."""
         from .tpu.batch import pipelined
 
         new_violations: List[Tuple[Candidate, np.ndarray]] = []
 
-        def dispatch(lo: int):
-            part = pop[lo:lo + self.chunk]
-            seeds = np.asarray([c.seed for c in part], np.uint32)
-            st = self.sim.run(
-                seeds, max_steps=self.workload.max_steps,
-                ctl=self._ctl_for(part),
-            )
-            return part, st
-
-        def decode(entry) -> None:
-            part, st = entry
-            bitmaps = np.asarray(st.cov.bitmap, np.uint32)
-            hiwater = np.asarray(st.cov.hiwater)
-            transitions = np.asarray(st.cov.transitions)
-            violated = np.asarray(st.violated)
+        def fold(part, bitmaps, hiwater, transitions, violated) -> None:
             self.seeds_run += len(part)
             for i, cand in enumerate(part):
                 new = bitmaps[i] & ~self.union
@@ -704,10 +706,45 @@ class Explorer:
                     self._violated_seeds.add(cand.seed)
                     new_violations.append((cand, bitmaps[i].copy()))
 
-        pipelined(
-            range(0, len(pop), self.chunk), dispatch, decode,
-            serial=not self.pipeline,
-        )
+        if self.refill:
+            from .tpu.engine import refill_results
+
+            seeds = np.asarray([c.seed for c in pop], np.uint32)
+            st = self.sim.run_refill(
+                seeds,
+                lanes=min(self.refill_lanes or self.chunk, len(pop)),
+                max_steps=self.workload.max_steps,
+                ctl=self._ctl_for(pop),
+            )
+            res = refill_results(st)
+            fold(
+                pop, np.asarray(res["cov_bitmap"], np.uint32),
+                res["cov_hiwater"], res["cov_transitions"],
+                res["violated"],
+            )
+        else:
+            def dispatch(lo: int):
+                part = pop[lo:lo + self.chunk]
+                seeds = np.asarray([c.seed for c in part], np.uint32)
+                st = self.sim.run(
+                    seeds, max_steps=self.workload.max_steps,
+                    ctl=self._ctl_for(part),
+                )
+                return part, st
+
+            def decode(entry) -> None:
+                part, st = entry
+                fold(
+                    part, np.asarray(st.cov.bitmap, np.uint32),
+                    np.asarray(st.cov.hiwater),
+                    np.asarray(st.cov.transitions),
+                    np.asarray(st.violated),
+                )
+
+            pipelined(
+                range(0, len(pop), self.chunk), dispatch, decode,
+                serial=not self.pipeline,
+            )
         for cand, bitmap in new_violations:
             if self.first_violation_dispatch is None:
                 self.first_violation_dispatch = gen
@@ -954,6 +991,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         "without a bundle)",
     )
     parser.add_argument("--no-pipeline", action="store_true")
+    parser.add_argument(
+        "--no-refill", action="store_true",
+        help="run generations as padded chunks instead of the "
+        "continuously batched (lane-refill) engine",
+    )
+    parser.add_argument(
+        "--refill-lanes", type=int, default=None,
+        help="device lane count for the refill engine (default: the "
+        "chunk width); smaller = more refills per generation",
+    )
     parser.add_argument("--out-dir", default=None)
     parser.add_argument(
         "--out", default=None, metavar="DIR",
@@ -971,6 +1018,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         chunk=args.chunk or None, shrink_violations=not args.no_shrink,
         max_shrinks=args.max_shrinks,
         shrink_kwargs=shrink_kwargs, pipeline=not args.no_pipeline,
+        refill=not args.no_refill, refill_lanes=args.refill_lanes,
         log=None if args.json else lambda m: print(m, flush=True),
     )
     report = ex.run(args.dispatches)

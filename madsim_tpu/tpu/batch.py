@@ -185,6 +185,18 @@ class BatchResult:
     # ~1.4 s/sweep of per-op dispatch latency) can't silently return.
     dispatches: int = 0
     device_ms: float = 0.0
+    # -- continuous batching (r9, docs/continuous_batching.md) --
+    # lane occupancy: busy-lane-steps / total-lane-steps over the sweep.
+    # Exact on the refill path (engine counters); on the chunked path an
+    # estimate from per-lane step counts (each chunk's denominator is its
+    # longest lane's step count), reported so refill-vs-chunked reads off
+    # one field. per-admission rows ride along in seed order: the step at
+    # which each admission retired (refill: global sweep step; chunked: the
+    # lane's own final step count — lanes start together, so the two agree
+    # up to chunk phase) and its first violating step (-1 = none).
+    occupancy: Optional[float] = None
+    retired_step: Optional[np.ndarray] = None  # i32 [L]
+    violation_step: Optional[np.ndarray] = None  # i32 [L]
 
     @property
     def violations(self) -> int:
@@ -310,6 +322,7 @@ def run_batch(
     shrink_kwargs: Optional[Dict[str, Any]] = None,
     pipeline: bool = True,
     coverage: bool = False,
+    refill: int = 0,
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
 
@@ -350,16 +363,49 @@ def run_batch(
     explorer's novelty signal, madsim_tpu/explore.py): the result carries a
     `LaneCoverage` and the summary a `coverage_bits` union count. Off by
     default — the bitmap costs a few percent of step time.
+
+    `refill=<lanes>` runs the sweep CONTINUOUSLY BATCHED over that many
+    device lanes (docs/continuous_batching.md): a lane that finishes —
+    violates or reaches its horizon — retires and admits the next queued
+    seed inside the jitted loop, so heterogeneous-length seeds never
+    leave the chip idling on finished lanes. Each `chunk` of seeds is one
+    device-resident queue segment; the host tops the queue up between
+    segments through the same `pipelined` loop. Per-seed results are
+    BIT-IDENTICAL to the chunked path (tested): an admission's trajectory
+    is the pure per-seed function either way, and decode reads the
+    per-admission result rows in admission (= seed) order. Restrictions:
+    the refill path keeps no final node state per admission, so
+    workloads with a `lane_check` deep oracle (and spec lane_metrics
+    diagnostics) must run chunked, and the sweep is single-device
+    (`mesh` ignored; the multi-chip farm shards whole queues, ROADMAP 1).
     """
     seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
     if seeds_arr.ndim != 1 or seeds_arr.size == 0:
         raise ValueError("seeds must be a non-empty 1-D sequence")
+    if refill and workload.lane_check is not None:
+        raise ValueError(
+            "run_batch(refill=...) keeps no per-admission node state, so "
+            "lane_check deep oracles cannot run — use the chunked path "
+            "(refill=0) or strip the workload's lane_check"
+        )
     sim = BatchedSim(workload.spec, workload.config, coverage=coverage)
+    if refill:
+        return _run_batch_refill(
+            seeds_arr, workload, sim, int(refill), chunk=chunk,
+            pipeline=pipeline, coverage=coverage,
+            check_determinism=check_determinism,
+            repro_on_host=repro_on_host, max_host_repros=max_host_repros,
+            max_traces=max_traces, shrink_on_violation=shrink_on_violation,
+            shrink_kwargs=shrink_kwargs,
+        )
     mesh = resolve_mesh(mesh)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
 
     violated_parts: List[np.ndarray] = []
     deadlocked_parts: List[np.ndarray] = []
+    vstep_parts: List[np.ndarray] = []
+    steps_parts: List[np.ndarray] = []
+    occ_num = occ_den = 0  # chunked occupancy estimate (see BatchResult)
     cov_parts: List[tuple] = []  # (bitmap, occ_fired, hiwater, transitions)
     state: Optional[SimState] = None
     totals: Dict[str, float] = {}
@@ -399,9 +445,15 @@ def run_batch(
             )
         if pad:
             st = jax.tree_util.tree_map(lambda x: x[:size], st)
+        nonlocal occ_num, occ_den
         state = st
         violated_parts.append(np.asarray(st.violated))
         deadlocked_parts.append(np.asarray(st.deadlocked))
+        vstep_parts.append(np.asarray(st.violation_step))
+        chunk_steps = np.asarray(st.steps)
+        steps_parts.append(chunk_steps)
+        occ_num += int(chunk_steps.astype(np.int64).sum())
+        occ_den += int(chunk_steps.max(initial=0)) * chunk_steps.shape[0]
         if coverage:
             cov_parts.append((
                 np.asarray(st.cov.bitmap, np.uint32),
@@ -477,6 +529,8 @@ def run_batch(
         # the union count over ALL lanes (summarize's per-chunk counts sum
         # bits that chunks may share; the union is the explorer's currency)
         totals["coverage_bits"] = cov.union_bits()
+    occupancy = occ_num / occ_den if occ_den else 1.0
+    totals["occupancy"] = round(occupancy, 4)
     result = BatchResult(
         seeds=seeds_arr,
         violated=violated,
@@ -487,8 +541,29 @@ def run_batch(
         coverage=cov,
         dispatches=sweep_dispatches,
         device_ms=sweep_ms,
+        occupancy=occupancy,
+        retired_step=np.concatenate(steps_parts),
+        violation_step=np.concatenate(vstep_parts),
     )
 
+    return _post_sweep(
+        result, sim, workload, shrink_on_violation, shrink_kwargs,
+        max_traces, repro_on_host, max_host_repros,
+    )
+
+
+def _post_sweep(
+    result: BatchResult,
+    sim: BatchedSim,
+    workload: BatchWorkload,
+    shrink_on_violation: bool,
+    shrink_kwargs: Optional[Dict[str, Any]],
+    max_traces: int,
+    repro_on_host: bool,
+    max_host_repros: int,
+) -> BatchResult:
+    """The shared post-sweep tail of run_batch's chunked and refill
+    paths: auto-triage, violation traces, host repros."""
     if result.violations and shrink_on_violation:
         # auto-triage: ddmin the FIRST violating seed into a minimal repro
         # bundle (a handful of extra device dispatches; see triage.py).
@@ -524,6 +599,135 @@ def run_batch(
             except BaseException as e:  # noqa: BLE001 - a raising repro IS a repro
                 result.host_repros[seed] = e
     return result
+
+
+def _run_batch_refill(
+    seeds_arr: np.ndarray,
+    workload: BatchWorkload,
+    sim: BatchedSim,
+    lanes: int,
+    chunk: int,
+    pipeline: bool,
+    coverage: bool,
+    check_determinism: bool,
+    repro_on_host: bool,
+    max_host_repros: int,
+    max_traces: int,
+    shrink_on_violation: bool,
+    shrink_kwargs: Optional[Dict[str, Any]],
+) -> BatchResult:
+    """run_batch's continuously batched sweep: each `chunk` of seeds is
+    one device-resident queue SEGMENT run by engine.run_refill over
+    `lanes` lanes; the host tops up the queue with the next segment
+    through the same double-buffered `pipelined` loop the chunked path
+    uses. Decode reads the per-admission result rows in admission (=
+    seed) order, so every per-seed output is bit-identical to the
+    chunked sweep's row for that seed."""
+    from .engine import refill_results, summarize_refill
+
+    if lanes < 1:
+        raise ValueError(f"refill lane count must be >= 1, got {lanes}")
+    res_parts: List[dict] = []
+    totals: Dict[str, float] = {}
+    weights: Dict[str, int] = {}
+    occ_num = occ_den = 0
+    state: Optional[SimState] = None
+    disp_before = sim.dispatch_count
+    t_sweep = time.perf_counter()
+
+    def dispatch(off: int):
+        part = seeds_arr[off : off + chunk]
+        st = sim.run_refill(part, lanes=lanes, max_steps=workload.max_steps)
+        rerun = (
+            sim.run_refill(part, lanes=lanes, max_steps=workload.max_steps)
+            if check_determinism else None
+        )
+        return off, part.size, st, rerun
+
+    def decode(entry) -> None:
+        nonlocal state, occ_num, occ_den
+        off, size, st, rerun = entry
+        if rerun is not None:
+            _assert_runs_bitwise_equal(
+                st, rerun, f"seeds[{off}:{off + size}] (refill)"
+            )
+        state = st
+        res = refill_results(st)
+        res_parts.append(res)
+        occ_num += res["busy_lane_steps"]
+        occ_den += res["total_lane_steps"]
+        s = summarize_refill(res)
+        for k, v in s.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if k == "first_violation_step":
+                totals[k] = min(totals.get(k, v), v)
+            elif k in ("coverage_hiwater",):
+                totals[k] = max(totals.get(k, v), v)
+            elif k == "occupancy":
+                continue  # exact busy/total ratio set after the loop
+            elif k.startswith("mean_"):
+                totals[k] = totals.get(k, 0) + v * size
+                weights[k] = weights.get(k, 0) + size
+            else:
+                totals[k] = totals.get(k, 0) + v
+
+    pipelined(
+        range(0, seeds_arr.size, chunk), dispatch, decode,
+        serial=not pipeline,
+    )
+    for k, w in weights.items():
+        totals[k] = totals[k] / w
+    sweep_dispatches = sim.dispatch_count - disp_before
+    sweep_ms = (time.perf_counter() - t_sweep) * 1e3
+
+    violated = np.concatenate([r["violated"] for r in res_parts])
+    deadlocked = np.concatenate([r["deadlocked"] for r in res_parts])
+    occupancy = occ_num / occ_den if occ_den else 1.0
+    totals["violation_lanes"] = np.nonzero(violated)[0].tolist()[:32]
+    totals["n_devices"] = 1
+    totals["occupancy"] = round(occupancy, 4)
+    totals["refill_lanes"] = lanes
+    from .nemesis import coverage_report, enabled_fire_kinds
+
+    if enabled_fire_kinds(sim.config):
+        totals["chaos_coverage"] = coverage_report(totals, sim.config)
+    totals["dispatches"] = sweep_dispatches
+    totals["device_ms"] = round(sweep_ms, 3)
+    cov = None
+    if coverage:
+        cov = LaneCoverage(
+            bitmap=np.concatenate([r["cov_bitmap"] for r in res_parts]),
+            occ_fired=(
+                None if res_parts[0]["occ_fired"] is None
+                else np.concatenate([r["occ_fired"] for r in res_parts])
+            ),
+            hiwater=np.concatenate([r["cov_hiwater"] for r in res_parts]),
+            transitions=np.concatenate(
+                [r["cov_transitions"] for r in res_parts]
+            ),
+        )
+        totals["coverage_bits"] = cov.union_bits()
+    result = BatchResult(
+        seeds=seeds_arr,
+        violated=violated,
+        deadlocked=deadlocked,
+        summary=totals,
+        state=state,
+        workload=workload,
+        coverage=cov,
+        dispatches=sweep_dispatches,
+        device_ms=sweep_ms,
+        occupancy=occupancy,
+        retired_step=np.concatenate([r["retired"] for r in res_parts]),
+        violation_step=np.concatenate(
+            [r["violation_step"] for r in res_parts]
+        ),
+    )
+    return _post_sweep(
+        result, sim, workload, shrink_on_violation, shrink_kwargs,
+        max_traces, repro_on_host, max_host_repros,
+    )
 
 
 def batch_test(

@@ -269,6 +269,74 @@ class TriageCtl(NamedTuple):
     h_off: Any  # i32 [L] per-lane horizon, offset part
 
 
+class RefillQueue(NamedTuple):
+    """The device-resident admission queue (continuous batching, r9).
+
+    One row per ADMISSION — a (seed, ctl genome) unit of work. The queue
+    is loop-INVARIANT (ConstState side): only the cursor in `RefillLog`
+    moves. Admission a < L starts resident in lane a at init; admissions
+    a >= L are admitted in retirement order — when a lane violates or
+    reaches its per-lane horizon, it re-inits from the next queue row
+    inside the jitted step, with no host round-trip until the queue
+    drains. The ctl rows exist iff the sim is in triage mode (every
+    admission then carries its own clause/occurrence/rate/horizon
+    genome — the ddmin and explorer refill face); a plain sweep queues
+    seeds only.
+    """
+
+    seeds: Any  # u32 [A] admission seeds
+    off: Any  # i32 [A] | None (triage: per-admission TriageCtl rows)
+    occ: Any  # i32 [A, len(OCC_CLAUSES)] | None
+    rate_scale: Any  # f32 [A, len(RATE_CLAUSES)] | None
+    h_epoch: Any  # i32 [A] | None
+    h_off: Any  # i32 [A] | None
+
+
+class RefillLog(NamedTuple):
+    """Refill-mode carry: per-lane admission bookkeeping, the queue
+    cursor, occupancy counters, and the per-ADMISSION result buffers the
+    decode reads in admission order (the retirement-time harvest of the
+    cold accumulators a re-init would otherwise wipe).
+
+    Everything here is donated carry (cold side): the result buffers are
+    written by a masked scatter exactly once per admission — at the step
+    its lane retires — and `run_refill`'s decode performs one final
+    host-side harvest for lanes still mid-admission when the step budget
+    ran out (the chunked path's truncation semantics)."""
+
+    cursor: Any  # i32 [] next queue row to admit (starts at L)
+    admitted: Any  # i32 [L] lane's CURRENT admission index
+    step_cap: Any  # i32 [] per-ADMISSION step budget == the chunked
+    #            path's max_steps: an admission reaching it retires
+    #            TRUNCATED (violated as-is, normally False) exactly like
+    #            a chunked lane at its loop bound — without this, a
+    #            violation past max_steps would be found by refill but
+    #            not by the chunked twin (or vice versa under skewed
+    #            retirement), breaking per-admission bit-identity
+    iters: Any  # i32 [] sweep-loop iterations run (occupancy denominator)
+    busy: Any  # i32 [L] per-lane active-step count (occupancy numerator)
+    # -- per-admission result rows ([A, ...]; written at retirement) --
+    retired: Any  # i32 [A] global step index at retirement (-1 = live)
+    violated: Any  # bool [A]
+    deadlocked: Any  # bool [A]
+    violation_at: Any  # i32 [A] (offset us; INF_US = none)
+    violation_epoch: Any  # i32 [A]
+    violation_step: Any  # i32 [A] first violating step of the ADMISSION
+    #            (admission-relative: its own `steps` counter, exactly
+    #             what the chunked path records for the same seed)
+    steps: Any  # i32 [A]
+    events: Any  # i32 [A]
+    overflow: Any  # i32 [A]
+    dead_drops: Any  # i32 [A]
+    clock: Any  # i32 [A] final clock offset at retirement
+    epoch: Any  # i32 [A]
+    fires: Any  # i32 [A, len(FIRE_KINDS)]
+    occ_fired: Any  # u32 [A, len(OCC_CLAUSES)] | None
+    cov_bitmap: Any  # u32 [A, COV_WORDS] | None (coverage mode)
+    cov_hiwater: Any  # i32 [A] | None
+    cov_transitions: Any  # i32 [A] | None
+
+
 def default_ctl(L: int, horizon_us: int) -> TriageCtl:
     """The no-op ctl: every clause and occurrence on, full horizon."""
     eh, oh = divmod(int(horizon_us), REBASE_US)
@@ -393,6 +461,11 @@ class SimState(NamedTuple):
     nem: Any  # NemesisState | None (None unless a nemesis clause is on)
     ctl: Any  # TriageCtl | None (None unless BatchedSim(triage=True))
     cov: Any  # Coverage | None (None unless BatchedSim(coverage=True))
+    queue: Any  # RefillQueue | None — loop-invariant admission queue
+    #           (None unless the state was built by init_refill; see
+    #           docs/continuous_batching.md)
+    refill: Any  # RefillLog | None — refill carry: queue cursor, per-lane
+    #           admission ids, occupancy counters, per-admission results
 
     @property
     def alive(self):
@@ -421,6 +494,8 @@ class ColdState(NamedTuple):
     fires: Any
     occ_fired: Any
     cov: Any
+    refill: Any  # RefillLog | None (refill mode only): the result
+    #            buffers accumulate, the cursor advances rarely — cold
 
 
 COLD_FIELDS = ColdState._fields
@@ -432,32 +507,57 @@ class ConstState(NamedTuple):
     carry made every fused step re-emit them as outputs (copied bytes per
     step, and per-segment donation rotation). key0 feeds every
     schedule-pure nemesis draw; ctl is the triage shrinker's per-lane
-    switchboard; skew_ppm the per-(seed, node) clock-skew assignment."""
+    switchboard; skew_ppm the per-(seed, node) clock-skew assignment.
+
+    REFILL mode inverts the first three: a refilled lane adopts a NEW
+    seed's key0/ctl/skew mid-sweep, so those become carry and the only
+    loop invariant left is the admission queue itself (the queue rows
+    never change; only RefillLog's cursor moves)."""
 
     key0: Any
     ctl: Any
     skew_ppm: Any
+    queue: Any  # RefillQueue | None (refill mode only)
 
 
 def split_state(state: SimState):
     """SimState -> (hot, cold, const) for the sweep loop. Pure pytree
-    restructuring: no data moves, the leaves are the same buffers."""
+    restructuring: no data moves, the leaves are the same buffers.
+
+    Two partitions, selected by the state's structure:
+      * plain sweeps: const = (key0, ctl, skew_ppm) — the r8 split;
+      * refill sweeps (state.refill is not None): key0/ctl/skew_ppm
+        STAY IN THE CARRY (a refilled lane rewrites them from its new
+        admission), and const = the admission queue alone."""
     nem = state.nem
+    cold = ColdState(*(getattr(state, f) for f in COLD_FIELDS))
+    if state.refill is not None:
+        hot = state._replace(
+            queue=None, **{f: None for f in COLD_FIELDS},
+        )
+        const = ConstState(
+            key0=None, ctl=None, skew_ppm=None, queue=state.queue,
+        )
+        return hot, cold, const
     hot = state._replace(
-        key0=None, ctl=None,
+        key0=None, ctl=None, queue=None,
         nem=None if nem is None else nem._replace(skew_ppm=None),
         **{f: None for f in COLD_FIELDS},
     )
-    cold = ColdState(*(getattr(state, f) for f in COLD_FIELDS))
     const = ConstState(
         key0=state.key0, ctl=state.ctl,
         skew_ppm=None if nem is None else nem.skew_ppm,
+        queue=None,
     )
     return hot, cold, const
 
 
 def merge_state(hot: SimState, cold: ColdState, const: ConstState) -> SimState:
     """(hot, cold, const) -> flat SimState (inverse of split_state)."""
+    if const.queue is not None:  # refill partition: key0/ctl/skew in hot
+        return hot._replace(
+            queue=const.queue, **dict(zip(COLD_FIELDS, cold)),
+        )
     nem = hot.nem
     if nem is not None:
         nem = nem._replace(skew_ppm=const.skew_ppm)
@@ -509,9 +609,14 @@ def carry_partition(state: SimState) -> dict:
     }
 
 
-def interval_hints(sim: "BatchedSim") -> dict:
+def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
     """{carry leaf name -> (lo, hi, may_inf)} seed intervals for the
     ENGINE-OWNED leaves, keyed by the `named_leaves` hot/cold/const paths.
+
+    `refill=True` keys the hints for the refill carry partition (key0 /
+    ctl / skew_ppm live under `hot.`, the queue under `const.queue.`)
+    and adds the RefillLog leaves — notably the queue cursor and the
+    per-admission `retired` step rows the range certifier must bound.
 
     The introspection hook behind the Layer-3 range certifier
     (analysis/ranges.py): these are the engine's own documented value
@@ -595,6 +700,52 @@ def interval_hints(sim: "BatchedSim") -> dict:
     # time tensor
     for f in sim.spec.time_fields:
         hints[f"hot.node.{f}"] = toff
+    if refill:
+        # the refill carry partition: key0/ctl/skew ride in hot (a
+        # refilled lane rewrites them), only the queue is const
+        ren = {
+            "const.key0": "hot.key0",
+            "const.skew_ppm": "hot.nem.skew_ppm",
+        }
+        hints = {
+            ren.get(k, k.replace("const.ctl.", "hot.ctl.")): v
+            for k, v in hints.items()
+        }
+        ctr = (0, ctr_hi, False)
+        hints.update({
+            # the queue cursor / admission ids are bounded by the queue
+            # length at runtime; ctr_hi is the sound static envelope the
+            # certifier needs (the gathers are clipped, the scatters
+            # drop-moded — both provable/guarded from these seeds)
+            "cold.refill.cursor": ctr,
+            "cold.refill.admitted": ctr,
+            "cold.refill.step_cap": ctr,
+            "cold.refill.iters": ctr,
+            "cold.refill.busy": ctr,
+            "cold.refill.retired": (-1, ctr_hi, False),
+            "cold.refill.violated": (0, 1, False),
+            "cold.refill.deadlocked": (0, 1, False),
+            "cold.refill.violation_at": toff,
+            "cold.refill.violation_epoch": (0, ep_hi, False),
+            "cold.refill.violation_step": (-1, ctr_hi, False),
+            "cold.refill.steps": ctr,
+            "cold.refill.events": ctr,
+            "cold.refill.overflow": ctr,
+            "cold.refill.dead_drops": ctr,
+            "cold.refill.clock": (0, off_hi, True),
+            "cold.refill.epoch": (0, ep_hi, False),
+            "cold.refill.fires": ctr,
+            "cold.refill.occ_fired": u32,
+            "cold.refill.cov_bitmap": u32,
+            "cold.refill.cov_hiwater": ctr,
+            "cold.refill.cov_transitions": ctr,
+            "const.queue.seeds": u32,
+            "const.queue.off": (0, (1 << 31) - 1, False),
+            "const.queue.occ": (0, (1 << 31) - 1, False),
+            "const.queue.rate_scale": (0, 1, False),
+            "const.queue.h_epoch": (0, ep_hi, False),
+            "const.queue.h_off": (0, REBASE_US - 1, False),
+        })
     return hints
 
 
@@ -1188,6 +1339,8 @@ class BatchedSim:
                 )
                 if self.coverage else None
             ),
+            queue=None,
+            refill=None,
         )
 
     # ------------------------------------------------------------------ step
@@ -2320,7 +2473,15 @@ class BatchedSim:
             nem=new_nem,
             ctl=state.ctl,
             cov=cov,
+            queue=state.queue,
+            refill=state.refill,
         )
+        # -- 9. continuous batching: retire finished lanes, admit the next
+        # queued seed/genome in-jit (docs/continuous_batching.md). A no-op
+        # branch (lax.cond) on steps where no lane retires, so plain sweep
+        # steps pay one lane-axis any() and nothing else.
+        if state.refill is not None:
+            new_state = self._refill_apply(state, new_state, active)
         record = TraceRecord(
             clock=clock,
             epoch=epoch,
@@ -2346,6 +2507,254 @@ class BatchedSim:
             spike_off=tr_spike_off,
         )
         return new_state, record
+
+    # ------------------------------------------------- continuous batching
+
+    def _refill_apply(
+        self, state: SimState, ns: SimState, active: jnp.ndarray
+    ) -> SimState:
+        """Retire lanes that finished THIS step and admit queued work.
+
+        Runs at the end of every refill-mode step: (1) occupancy counters
+        tick unconditionally; (2) under `lax.cond` (taken only on steps
+        where some lane retired — each admission retires exactly once, so
+        this branch runs at most A times per sweep): harvest the retiring
+        lanes' cold accumulators into the per-admission result buffers
+        (masked scatter at their admission index, drop-moded), then admit
+        the next queue rows — retiring lanes take queue slots in LANE
+        ORDER (the exclusive prefix count over the retire mask), re-init
+        from the admitted seed (and ctl genome, in triage mode), and the
+        cursor advances by the number admitted.
+
+        DETERMINISM: the admitted-seed assignment is the ONLY cross-lane
+        coupling in the engine, and it never touches a surviving lane's
+        draws — a refilled lane's state is exactly `_init(seed)`'s row,
+        so every admission's trajectory is the pure per-seed function the
+        chunked path computes, and results are a pure function of
+        (admission order, seeds): bit-identical to the chunked sweep for
+        any fixed admission order. The lane-axis cumsum/any/sum here are
+        the engine's one sanctioned exception to the lane-independence
+        rule (see analysis REFILL_LANE_ALLOW)."""
+        rf: RefillLog = state.refill
+        q: RefillQueue = state.queue
+        L = ns.done.shape[0]
+        A = q.seeds.shape[0]
+        rf = rf._replace(
+            iters=rf.iters + jnp.int32(1),
+            busy=rf.busy + active.astype(jnp.int32),
+        )
+        # per-admission step budget: an admission at step_cap retires
+        # truncated — the exact state a chunked lane holds when its
+        # run(max_steps=cap) loop ends (steps counts active steps, and a
+        # live lane is active every iteration, so the cut lands on the
+        # same step)
+        expired = ~ns.done & (ns.steps >= rf.step_cap)
+        ns = ns._replace(done=ns.done | expired)
+        just = ns.done & ~state.done  # lanes whose admission retired now
+
+        def retire_and_admit(ns: SimState, rf: RefillLog) -> SimState:
+            # -- harvest: one masked scatter per result buffer. idx = A
+            # for non-retiring lanes — out of bounds, dropped by mode.
+            idx = jnp.where(just, rf.admitted, jnp.int32(A))
+
+            def put(dst, src):
+                return dst.at[idx].set(src, mode="drop")
+
+            rf2 = rf._replace(
+                retired=put(
+                    rf.retired,
+                    jnp.broadcast_to(rf.iters - 1, (L,)),
+                ),
+                violated=put(rf.violated, ns.violated),
+                deadlocked=put(rf.deadlocked, ns.deadlocked),
+                violation_at=put(rf.violation_at, ns.violation_at),
+                violation_epoch=put(rf.violation_epoch, ns.violation_epoch),
+                violation_step=put(rf.violation_step, ns.violation_step),
+                steps=put(rf.steps, ns.steps),
+                events=put(rf.events, ns.events),
+                overflow=put(rf.overflow, ns.overflow),
+                dead_drops=put(rf.dead_drops, ns.dead_drops),
+                clock=put(rf.clock, ns.clock),
+                epoch=put(rf.epoch, ns.epoch),
+                fires=put(rf.fires, ns.fires),
+                occ_fired=(
+                    None if rf.occ_fired is None
+                    else put(rf.occ_fired, ns.occ_fired)
+                ),
+                cov_bitmap=(
+                    None if rf.cov_bitmap is None
+                    else put(rf.cov_bitmap, ns.cov.bitmap)
+                ),
+                cov_hiwater=(
+                    None if rf.cov_hiwater is None
+                    else put(rf.cov_hiwater, ns.cov.hiwater)
+                ),
+                cov_transitions=(
+                    None if rf.cov_transitions is None
+                    else put(rf.cov_transitions, ns.cov.transitions)
+                ),
+            )
+
+            # -- admit: retiring lane r takes queue row cursor + rank(r),
+            # rank = exclusive prefix count over the retire mask in lane
+            # order (admission order is therefore deterministic given the
+            # retirement schedule, which is itself a pure function of the
+            # admitted seeds)
+            ji = just.astype(jnp.int32)
+            rank = jnp.cumsum(ji) - ji
+            adm = rf.cursor + rank
+            take = just & (adm < A)
+            n_take = jnp.sum(take.astype(jnp.int32))
+            adm_c = jnp.clip(adm, 0, A - 1)  # provably in-bounds gathers
+            seeds_new = jnp.take(q.seeds, adm_c, axis=0)
+            ctl_new = None
+            if self.triage:
+                ctl_new = TriageCtl(
+                    off=jnp.take(q.off, adm_c, axis=0),
+                    occ=jnp.take(q.occ, adm_c, axis=0),
+                    rate_scale=jnp.take(q.rate_scale, adm_c, axis=0),
+                    h_epoch=jnp.take(q.h_epoch, adm_c, axis=0),
+                    h_off=jnp.take(q.h_off, adm_c, axis=0),
+                )
+            # full-width re-init (the REAL _init: same draws, same
+            # schedule roots as a fresh chunked lane), then a lane-masked
+            # select: non-refilled lanes keep their post-step state
+            # bit-for-bit — the schedule-purity half of the contract
+            fresh = self._init(seeds_new, ctl_new)
+            base = ns._replace(queue=None, refill=None)
+            fresh = fresh._replace(queue=None, refill=None)
+
+            def sel(f, b):
+                m = take.reshape(take.shape + (1,) * (f.ndim - 1))
+                return jnp.where(m, f, b)
+
+            merged = jax.tree_util.tree_map(sel, fresh, base)
+            rf2 = rf2._replace(
+                cursor=rf.cursor + n_take,
+                admitted=jnp.where(take, adm, rf.admitted),
+            )
+            return merged._replace(queue=q, refill=rf2)
+
+        def tick_only(ns: SimState, rf: RefillLog) -> SimState:
+            return ns._replace(refill=rf)
+
+        return jax.lax.cond(jnp.any(just), retire_and_admit, tick_only,
+                            ns, rf)
+
+    def init_refill(
+        self, seeds, lanes: int, ctl=None,
+        step_cap: int = 100_000,
+    ) -> SimState:
+        """Build a refill-mode state: `lanes` device lanes fed from a
+        device-resident queue of ALL `seeds` (one admission per seed).
+
+        `ctl` (triage mode) is an [A]-row TriageCtl giving EVERY
+        admission its own clause/occurrence/rate/horizon genome — the
+        shape `triage.build_ctl` / `explore.ctl_for` already produce.
+        Admissions 0..L-1 start resident (lane order == admission
+        order); the rest admit in retirement order. `step_cap` is the
+        per-admission step budget — the chunked path's max_steps, and
+        the truncation semantics are identical. See run_refill."""
+        seeds = jnp.asarray(seeds, jnp.uint32)
+        if seeds.ndim != 1 or seeds.shape[0] == 0:
+            raise ValueError("init_refill needs a non-empty 1-D seed array")
+        A = int(seeds.shape[0])
+        L = max(1, min(int(lanes), A))
+        if ctl is not None and not self.triage:
+            raise ValueError(
+                "a refill ctl queue requires BatchedSim(..., triage=True)"
+            )
+        if self.triage and ctl is None:
+            ctl = default_ctl(A, self.config.horizon_us)
+        head_ctl = None
+        if self.triage:
+            if int(ctl.off.shape[0]) != A:
+                raise ValueError(
+                    f"refill ctl has {int(ctl.off.shape[0])} rows for "
+                    f"{A} admissions — one genome per admission"
+                )
+            head_ctl = jax.tree_util.tree_map(lambda x: x[:L], ctl)
+        state = (
+            self.init(seeds[:L]) if head_ctl is None
+            else self.init(seeds[:L], head_ctl)
+        )
+        self.dispatch_count += 1
+        queue = RefillQueue(
+            seeds=seeds,
+            off=None if ctl is None else jnp.asarray(ctl.off, jnp.int32),
+            occ=None if ctl is None else jnp.asarray(ctl.occ, jnp.int32),
+            rate_scale=(
+                None if ctl is None
+                else jnp.asarray(ctl.rate_scale, jnp.float32)
+            ),
+            h_epoch=(
+                None if ctl is None else jnp.asarray(ctl.h_epoch, jnp.int32)
+            ),
+            h_off=(
+                None if ctl is None else jnp.asarray(ctl.h_off, jnp.int32)
+            ),
+        )
+        zi = functools.partial(jnp.zeros, dtype=jnp.int32)
+        if step_cap <= 0:
+            raise ValueError(f"step_cap must be positive, got {step_cap}")
+        log = RefillLog(
+            cursor=jnp.int32(L),
+            admitted=jnp.arange(L, dtype=jnp.int32),
+            step_cap=jnp.int32(step_cap),
+            iters=jnp.int32(0),
+            busy=zi((L,)),
+            retired=jnp.full((A,), -1, jnp.int32),
+            violated=jnp.zeros((A,), jnp.bool_),
+            deadlocked=jnp.zeros((A,), jnp.bool_),
+            violation_at=jnp.full((A,), INF_US, jnp.int32),
+            violation_epoch=zi((A,)),
+            violation_step=jnp.full((A,), -1, jnp.int32),
+            steps=zi((A,)),
+            events=zi((A,)),
+            overflow=zi((A,)),
+            dead_drops=zi((A,)),
+            clock=zi((A,)),
+            epoch=zi((A,)),
+            fires=zi((A, len(FIRE_KINDS))),
+            occ_fired=(
+                jnp.zeros((A, len(OCC_CLAUSES)), jnp.uint32)
+                if self._occ_track else None
+            ),
+            cov_bitmap=(
+                jnp.zeros((A, COV_WORDS), jnp.uint32)
+                if self.coverage else None
+            ),
+            cov_hiwater=zi((A,)) if self.coverage else None,
+            cov_transitions=zi((A,)) if self.coverage else None,
+        )
+        return state._replace(queue=queue, refill=log)
+
+    def run_refill(
+        self, seeds, lanes: int, max_steps: int = 100_000,
+        dispatch_steps: int = 10_000, ctl=None,
+        total_steps: Optional[int] = None,
+    ) -> SimState:
+        """Run ALL `seeds` as admissions of a continuously batched sweep
+        over `lanes` device lanes: a lane that violates or reaches its
+        per-admission horizon retires and admits the next queued seed
+        inside the jitted loop, so the chip never idles on finished
+        lanes (docs/continuous_batching.md). Decode with
+        `refill_results` / `summarize_refill`.
+
+        `max_steps` is the PER-ADMISSION step budget, with exactly the
+        chunked path's semantics: an admission reaching it retires
+        truncated (violated as-is) inside the step, so a violation past
+        max_steps is invisible to both paths alike. `total_steps` bounds
+        the WHOLE sweep's loop iterations; its default (max_steps * A)
+        can never bind — even fully serialized admissions fit — and the
+        speculative early-stop exits the segment loop as soon as the
+        queue drains, so the generous bound costs at most one no-op
+        segment."""
+        state = self.init_refill(seeds, lanes, ctl, step_cap=max_steps)
+        A = int(state.queue.seeds.shape[0])
+        if total_steps is None:
+            total_steps = int(max_steps) * A
+        return self.run_state(state, total_steps, dispatch_steps)
 
     # ------------------------------------------------------------------ run
 
@@ -2428,6 +2837,18 @@ class BatchedSim:
                 )
             state = self.shard_state(state, mesh, lane_axis=mesh.axis_names[0])
             self.dispatch_count += 1  # the single whole-pytree device_put
+        return self.run_state(state, max_steps, dispatch_steps)
+
+    def run_state(
+        self, state: SimState, max_steps: int, dispatch_steps: int = 10_000,
+    ) -> SimState:
+        """run()'s chunked segment loop on a PRE-BUILT state (the shared
+        tail of run / run_refill): speculative early-stop, donated
+        segments, dispatch accounting — see run()'s docstring."""
+        if dispatch_steps <= 0:
+            raise ValueError(
+                f"dispatch_steps must be positive, got {dispatch_steps}"
+            )
         remaining = max_steps
         alive = None
         while remaining > 0:
@@ -2695,4 +3116,128 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
                 out[name] = int(a.sum())
             else:
                 out[name] = float(a.mean())
+    return out
+
+
+def refill_results(state: SimState) -> dict:
+    """Decode a finished refill sweep into per-ADMISSION numpy rows.
+
+    Rows are in admission order (== the seed order handed to
+    run_refill), so chunked-vs-refill comparisons are row-for-row. Each
+    retired admission's row was harvested on device at its retirement
+    step; admissions still mid-flight when the step budget ran out (the
+    truncation case — see run_refill) are harvested here from their
+    lane's final state, which is exactly what the chunked path reports
+    for a lane truncated at max_steps. Also computes the sweep's lane
+    OCCUPANCY: busy-lane-steps / total-lane-steps — the continuous-
+    batching headline metric (benches/roofline.py reports it)."""
+    import numpy as np
+
+    rf = state.refill
+    if rf is None:
+        raise ValueError("refill_results needs a run_refill final state")
+    # np.array (COPY), not np.asarray: the jax-array views are read-only
+    # and the final-harvest loop below writes rows in place
+    out = {
+        f: np.array(getattr(rf, f))
+        for f in (
+            "retired", "violated", "deadlocked", "violation_at",
+            "violation_epoch", "violation_step", "steps", "events",
+            "overflow", "dead_drops", "clock", "epoch", "fires",
+        )
+    }
+    for f in ("occ_fired", "cov_bitmap", "cov_hiwater", "cov_transitions"):
+        v = getattr(rf, f)
+        out[f] = None if v is None else np.array(v)
+    A = out["violated"].shape[0]
+    L = int(np.asarray(rf.busy).shape[0])
+    # final harvest: lanes that ran out of step budget mid-admission
+    done = np.asarray(state.done)
+    live = ~done
+    li = np.asarray(rf.admitted)[live]
+    if li.size:
+        pairs = {
+            "violated": state.violated, "deadlocked": state.deadlocked,
+            "violation_at": state.violation_at,
+            "violation_epoch": state.violation_epoch,
+            "violation_step": state.violation_step,
+            "steps": state.steps, "events": state.events,
+            "overflow": state.overflow, "dead_drops": state.dead_drops,
+            "clock": state.clock, "epoch": state.epoch,
+            "fires": state.fires,
+        }
+        if out["occ_fired"] is not None:
+            pairs["occ_fired"] = state.occ_fired
+        if out["cov_bitmap"] is not None:
+            pairs["cov_bitmap"] = state.cov.bitmap
+            pairs["cov_hiwater"] = state.cov.hiwater
+            pairs["cov_transitions"] = state.cov.transitions
+        for name, src in pairs.items():
+            out[name][li] = np.asarray(src)[live]
+    iters = int(np.asarray(rf.iters))
+    busy = int(np.asarray(rf.busy, np.int64).sum())
+    out["admissions"] = A
+    out["lanes"] = L
+    out["iters"] = iters
+    out["busy_lane_steps"] = busy
+    out["total_lane_steps"] = iters * L
+    out["occupancy"] = busy / max(iters * L, 1)
+    out["truncated"] = int(live.sum())
+    return out
+
+
+def summarize_refill(res: dict) -> dict:
+    """summarize()'s vocabulary over refill_results rows: the same keys,
+    aggregated over ADMISSIONS, so run_batch's chunk-total folding and
+    the chaos-coverage report read both paths identically. (lane_metrics
+    diagnostics need final node state, which a refilled lane no longer
+    holds — the refill path reports the engine counters only.)"""
+    import numpy as np
+
+    A = int(res["admissions"])
+    violated = res["violated"]
+    steps_total = int(res["steps"].astype(np.int64).sum())
+    vt_total_us = int(
+        res["epoch"].astype(np.int64).sum() * REBASE_US
+        + res["clock"].astype(np.int64).sum()
+    )
+    out = {
+        "lanes": A,
+        "violations": int(violated.sum()),
+        "violation_lanes": np.nonzero(violated)[0].tolist()[:32],
+        "deadlocked": int(res["deadlocked"].sum()),
+        "total_events": int(res["events"].astype(np.int64).sum()),
+        "total_overflow": int(res["overflow"].astype(np.int64).sum()),
+        "total_dead_drops": int(res["dead_drops"].astype(np.int64).sum()),
+        "mean_steps": steps_total / A,
+        "mean_virtual_secs": vt_total_us / A / 1e6,
+        "occupancy": round(float(res["occupancy"]), 4),
+    }
+    if out["violations"]:
+        out["first_violation_step"] = int(
+            res["violation_step"][violated].min()
+        )
+    fires = res["fires"].astype(np.int64).sum(axis=0)
+    for i, name in enumerate(FIRE_KINDS):
+        out[f"fires_{name}"] = int(fires[i])
+    if res.get("occ_fired") is not None:
+        bits = (
+            res["occ_fired"][:, :, None]
+            >> np.arange(32, dtype=np.uint32)[None, None, :]
+        ) & np.uint32(1)
+        occ_counts = bits.sum(axis=0)
+        for row, clause in enumerate(OCC_CLAUSES):
+            for k in range(32):
+                n = int(occ_counts[row, k])
+                if n:
+                    out[f"occfires_{clause}_k{k}"] = n
+    if res.get("cov_bitmap") is not None:
+        union = np.bitwise_or.reduce(res["cov_bitmap"], axis=0)
+        out["coverage_bits"] = int(
+            np.unpackbits(union.view(np.uint8)).sum()
+        )
+        out["coverage_hiwater"] = int(res["cov_hiwater"].max())
+        out["coverage_transitions"] = int(
+            res["cov_transitions"].astype(np.int64).sum()
+        )
     return out

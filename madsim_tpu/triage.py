@@ -400,32 +400,104 @@ class ShrinkResult:
 class _Eval:
     """Evaluates shrink candidates as lanes of one batched dispatch."""
 
-    def __init__(self, sim, seed: int, max_steps: int, lane_width: int):
+    def __init__(
+        self, sim, seed: int, max_steps: int, lane_width: int,
+        refill: bool = True,
+    ):
         import jax.numpy as jnp  # noqa: F401  (device backend required)
 
         self.sim = sim
         self.seed = int(seed)
         self.max_steps = int(max_steps)
         self.lane_width = max(2, int(lane_width))
+        self.refill = bool(refill)
         self.dispatches = 0
 
-    def run(
-        self, rows: List[Tuple[int, List[int], List[float], int]]
-    ) -> List[Dict[str, int]]:
-        """rows: (off_bits, occ_masks[4], rate_scales[3], horizon_us) per
-        candidate. Returns per-candidate {violated, step, t_us}. Rows are
-        padded to `lane_width` so every generation reuses ONE compiled
-        program; oversized generations chunk into several dispatches,
-        double-buffered like run_batch's chunk loop — chunk k+1's device
-        program is dispatched before the host decodes chunk k's violation
-        scalars (legal: every candidate of one generation is independent),
-        so the host decode overlaps device time instead of serializing."""
+    def _rows_ctl(self, rows):
+        """One TriageCtl with a row per candidate (the refill queue)."""
         import jax.numpy as jnp
         import numpy as np
 
         from .tpu.engine import TriageCtl
         from .tpu.spec import REBASE_US
 
+        return TriageCtl(
+            off=jnp.asarray(np.asarray([r[0] for r in rows], np.int32)),
+            occ=jnp.asarray(np.asarray([r[1] for r in rows], np.int32)),
+            rate_scale=jnp.asarray(
+                np.asarray([r[2] for r in rows], np.float32)
+            ),
+            h_epoch=jnp.asarray(
+                np.asarray([r[3] // REBASE_US for r in rows], np.int32)
+            ),
+            h_off=jnp.asarray(
+                np.asarray([r[3] % REBASE_US for r in rows], np.int32)
+            ),
+        )
+
+    def _run_refill(
+        self, rows: List[Tuple[int, List[int], List[float], int]]
+    ) -> List[Dict[str, int]]:
+        """The continuous-batching generation (r9): every candidate is an
+        ADMISSION of one refill sweep over `lane_width` lanes — a lane
+        whose candidate violates (or hits its bisected horizon) retires
+        and admits the next candidate in-jit, so a generation is one
+        always-full engine run instead of padded chunks all running to
+        the longest candidate's horizon. Per-candidate verdicts are
+        bit-identical to the chunked path (pure per-(seed, ctl) rows)."""
+        import numpy as np
+
+        from .tpu.engine import refill_results
+        from .tpu.spec import REBASE_US
+
+        A = len(rows)
+        # pad the QUEUE to a lane_width multiple with replays of row 0
+        # (results discarded): the refill program's shapes are (lanes,
+        # queue length), so bucketing queue lengths keeps the number of
+        # compiled programs per shrink at O(distinct buckets), like the
+        # chunked path's fixed lane_width padding
+        pad = (-A) % self.lane_width
+        rows_p = rows + [rows[0]] * pad
+        seeds = np.full((len(rows_p),), self.seed, np.uint32)
+        st = self.sim.run_refill(
+            seeds, lanes=self.lane_width,
+            max_steps=self.max_steps, ctl=self._rows_ctl(rows_p),
+        )
+        self.dispatches += 1
+        res = refill_results(st)
+        t_us = (
+            res["violation_epoch"].astype(np.int64) * REBASE_US
+            + res["violation_at"].astype(np.int64)
+        )
+        return [
+            {
+                "violated": bool(res["violated"][i]),
+                "step": int(res["violation_step"][i]),
+                "t_us": int(t_us[i]) if res["violated"][i] else -1,
+            }
+            for i in range(A)
+        ]
+
+    def run(
+        self, rows: List[Tuple[int, List[int], List[float], int]]
+    ) -> List[Dict[str, int]]:
+        """rows: (off_bits, occ_masks[4], rate_scales[3], horizon_us) per
+        candidate. Returns per-candidate {violated, step, t_us}. With
+        `refill` (the default) the whole generation runs as admissions of
+        one continuously batched sweep (`_run_refill`). The chunked
+        fallback pads rows to `lane_width` so every generation reuses ONE
+        compiled program; oversized generations chunk into several
+        dispatches, double-buffered like run_batch's chunk loop — chunk
+        k+1's device program is dispatched before the host decodes chunk
+        k's violation scalars (legal: every candidate of one generation
+        is independent), so the host decode overlaps device time instead
+        of serializing."""
+        import numpy as np
+
+        from .tpu.spec import REBASE_US
+
+        if self.refill:
+            return self._run_refill(rows)
         out: List[Dict[str, int]] = []
 
         def dispatch(lo: int):
@@ -434,16 +506,7 @@ class _Eval:
             pad = self.lane_width - n
             # pad lanes replay the first candidate; results are discarded
             part = part + [part[0]] * pad
-            off = np.asarray([r[0] for r in part], np.int32)
-            occ = np.asarray([r[1] for r in part], np.int32)
-            rs = np.asarray([r[2] for r in part], np.float32)
-            eh = np.asarray([r[3] // REBASE_US for r in part], np.int32)
-            oh = np.asarray([r[3] % REBASE_US for r in part], np.int32)
-            ctl = TriageCtl(
-                off=jnp.asarray(off), occ=jnp.asarray(occ),
-                rate_scale=jnp.asarray(rs), h_epoch=jnp.asarray(eh),
-                h_off=jnp.asarray(oh),
-            )
+            ctl = self._rows_ctl(part)
             seeds = np.full((self.lane_width,), self.seed, np.uint32)
             state = self.sim.run(seeds, max_steps=self.max_steps, ctl=ctl)
             self.dispatches += 1
@@ -560,6 +623,7 @@ def shrink_seed(
     sim=None,
     log: Optional[Callable[[str], None]] = None,
     base_ctl: Optional[Dict[str, Any]] = None,
+    refill: bool = True,
 ) -> ShrinkResult:
     """Shrink one violating seed of a BatchWorkload into a ReproBundle.
 
@@ -600,7 +664,13 @@ def shrink_seed(
         sim = BatchedSim(spec, cfg, triage=True)
     elif not sim.triage:
         raise ValueError("shrink_seed needs a BatchedSim(..., triage=True)")
-    ev = _Eval(sim, seed, workload.max_steps, lane_width)
+    # refill=True (default): each ddmin generation runs as admissions of
+    # one continuously batched sweep — the engine refills lanes whose
+    # candidates finished early instead of padding chunks to lane_width
+    # and running every lane to the longest candidate's horizon. Verdicts
+    # are bit-identical either way (tested); refill=False keeps the
+    # chunked reference path.
+    ev = _Eval(sim, seed, workload.max_steps, lane_width, refill=refill)
     plan = plan_from_config(cfg)
     base_ctl = base_ctl or {}
     base_off = set(base_ctl.get("off_clauses") or ())

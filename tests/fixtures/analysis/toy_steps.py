@@ -59,6 +59,39 @@ def contaminated_funnel(key, payload):
     return new_key, jnp.zeros_like(payload[..., 0])
 
 
+# ------------------------------------------------------------ leaky refill
+
+def clean_refill(key, key0, done, qseeds, cursor):
+    # the legal continuous-batching refill: a retiring lane's NEW chain
+    # roots derive from its admitted queue seed alone (key_from(seed)),
+    # exactly what a fresh chunked lane's _init would draw — survivors
+    # keep their chains untouched (the select's bool mask carries no
+    # value taint)
+    ji = done.astype(jnp.int32)
+    adm = jnp.clip(cursor + jnp.cumsum(ji) - ji, 0, qseeds.shape[0] - 1)
+    fresh = prng.key_from(jnp.take(qseeds, adm, axis=0))
+    new_key = jnp.where(done, fresh, prng.fold(key, 1))
+    new_key0 = jnp.where(done, fresh, key0)
+    victim = prng.randint(new_key0, 203, 0, 5)  # schedule draw: key0 only
+    return new_key, new_key0, victim
+
+
+def leaky_refill(key, key0, done, qseeds, cursor):
+    # the planted refill leak: the refilled lane's init FOLDS A
+    # SURVIVOR'S RUNNING KEY CHAIN into its new schedule root — its
+    # fault schedule is then a function of how far other work happened
+    # to have run, not of (seed, clause, occurrence); rng-taint must
+    # catch the key0-rooted draw mixing chain material
+    ji = done.astype(jnp.int32)
+    adm = jnp.clip(cursor + jnp.cumsum(ji) - ji, 0, qseeds.shape[0] - 1)
+    fresh = prng.key_from(jnp.take(qseeds, adm, axis=0))
+    contaminated = prng.fold(fresh, jnp.roll(key, 1))  # survivor's chain
+    new_key = jnp.where(done, contaminated, prng.fold(key, 1))
+    new_key0 = jnp.where(done, contaminated, key0)
+    victim = prng.randint(new_key0, 203, 0, 5)  # a schedule draw off it
+    return new_key, new_key0, victim
+
+
 # ----------------------------------------------------------------- dtype
 
 def time_f32_step(timer):

@@ -129,6 +129,46 @@ def test_rng_taint_passes_clean_funnel():
     assert res.ok, [v.render() for v in res.violations]
 
 
+# the refill toy signature: (key, key0, done, qseeds, cursor) — seeds are
+# key ROOTS (the _init verification convention), the cursor is a neutral
+# admission input, and `done` is a bool whose taint the control boundary
+# strips
+_REFILL_TOY_NAMES = [
+    "hot.key", "hot.key0", "hot.done", "const.key0",
+    "cold.refill.cursor",
+]
+
+
+def _refill_toy_args():
+    return (
+        _sds((LANES,), jnp.uint32), _sds((LANES,), jnp.uint32),
+        _sds((LANES,), jnp.bool_), _sds((29,), jnp.uint32),
+        _sds((), jnp.int32),
+    )
+
+
+def test_rng_taint_fires_on_leaky_refill():
+    """The planted continuous-batching leak: a refilled lane's init
+    folds a SURVIVOR'S running key chain into its new schedule root —
+    its fault schedule then depends on how far other admissions happened
+    to have run. rng-taint must flag the key0-rooted draw mixing chain
+    (KEY2) material."""
+    closed = jax.make_jaxpr(toys.leaky_refill)(*_refill_toy_args())
+    res = check_rng_taint(closed, _REFILL_TOY_NAMES, set(), "toy")
+    assert not res.ok
+    assert any("schedule-purity" in v.detail for v in res.violations)
+
+
+def test_rng_taint_passes_clean_refill():
+    """The legal refill twin: new chain roots derive from the admitted
+    queue seed alone (exactly a fresh lane's _init draw); the
+    retirement mask is control, not value material."""
+    closed = jax.make_jaxpr(toys.clean_refill)(*_refill_toy_args())
+    res = check_rng_taint(closed, _REFILL_TOY_NAMES, set(), "toy")
+    assert res.ok, [v.render() for v in res.violations]
+    assert res.checked > 0
+
+
 # --------------------------------------------------------------- rule: dtype
 
 
@@ -430,8 +470,9 @@ def test_one_trace_per_workload_is_cached():
 @pytest.mark.slow
 def test_full_analysis_all_stays_under_budget():
     """The --all acceptance bar: source lints + every jaxpr/range rule
-    over all five workloads in one process, sharing one trace per
-    workload, in well under 120 s on CPU (~20 s measured warm)."""
+    over all six trace targets (five workloads + raft's refill carry) in
+    one process, sharing one trace per target, in well under 120 s on
+    CPU (~45 s measured warm)."""
     import time
 
     t0 = time.perf_counter()

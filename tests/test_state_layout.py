@@ -118,6 +118,47 @@ LAYOUT = {
     "nem": None,
     "ctl": None,
     "cov": None,
+    # continuous batching (r9, docs/continuous_batching.md): both None
+    # outside refill mode — plain sweeps carry zero refill bytes
+    "queue": None,
+    "refill": None,
+}
+
+# the refill-mode additions (BatchedSim.init_refill with A admissions
+# over L lanes): the admission queue is loop-INVARIANT (const side,
+# never donated/rewritten), the RefillLog is cold carry — per-admission
+# result rows plus the cursor/occupancy scalars. Dtypes are EXACT here
+# for the same reason as LAYOUT: silent widening re-inflates the carry.
+A = 9
+REFILL_LAYOUT = {
+    "queue.seeds": ("uint32", (A,)),
+    "queue.off": None,  # triage-mode only (plain sweep queues seeds)
+    "queue.occ": None,
+    "queue.rate_scale": None,
+    "queue.h_epoch": None,
+    "queue.h_off": None,
+    "refill.cursor": ("int32", ()),
+    "refill.admitted": ("int32", (L,)),
+    "refill.step_cap": ("int32", ()),
+    "refill.iters": ("int32", ()),
+    "refill.busy": ("int32", (L,)),
+    "refill.retired": ("int32", (A,)),
+    "refill.violated": ("bool", (A,)),
+    "refill.deadlocked": ("bool", (A,)),
+    "refill.violation_at": ("int32", (A,)),
+    "refill.violation_epoch": ("int32", (A,)),
+    "refill.violation_step": ("int32", (A,)),
+    "refill.steps": ("int32", (A,)),
+    "refill.events": ("int32", (A,)),
+    "refill.overflow": ("int32", (A,)),
+    "refill.dead_drops": ("int32", (A,)),
+    "refill.clock": ("int32", (A,)),
+    "refill.epoch": ("int32", (A,)),
+    "refill.fires": ("int32", (A, 11)),
+    "refill.occ_fired": None,  # nemesis schedule clauses only
+    "refill.cov_bitmap": None,  # coverage mode only
+    "refill.cov_hiwater": None,
+    "refill.cov_transitions": None,
 }
 
 
@@ -159,6 +200,43 @@ def test_simstate_layout_table():
         assert tuple(got.shape) == shape, (
             f"{name}: shape {tuple(got.shape)} != declared {shape}"
         )
+
+
+def test_refill_state_layout_table():
+    """The refill-mode leaves match their declared dtypes/shapes too, and
+    the refill carry PARTITION holds: the queue is const (loop-invariant,
+    never in the donated carry), key0/ctl ride the carry (a refilled lane
+    rewrites them), and RefillLog is cold."""
+    from madsim_tpu.tpu.engine import carry_partition
+
+    sim = BatchedSim(make_raft_spec())
+    st = sim.init_refill(jnp.arange(A, dtype=jnp.uint32), lanes=L)
+    leaves: dict = {}
+    _walk("", st, leaves)
+    declared = dict(LAYOUT)
+    declared.update(REFILL_LAYOUT)
+    declared.pop("queue")
+    declared.pop("refill")
+    undeclared = set(leaves) - set(declared)
+    assert not undeclared, (
+        f"refill state grew undeclared leaves {sorted(undeclared)} — "
+        "declare them in REFILL_LAYOUT"
+    )
+    for name, want in declared.items():
+        got = leaves[name]
+        if want is None:
+            assert got is None, f"{name}: expected None, got {got!r}"
+            continue
+        dt, shape = want
+        assert str(got.dtype) == dt, f"{name}: {got.dtype} != {dt}"
+        assert tuple(got.shape) == shape, (
+            f"{name}: shape {tuple(got.shape)} != declared {shape}"
+        )
+    part = carry_partition(st)
+    assert all(n.startswith("queue.") for n in part["const"]), part["const"]
+    assert "key0" in part["hot"], "refilled lanes must rewrite key0"
+    assert any(n.startswith("refill.") for n in part["cold"])
+    assert not any(n.startswith("queue.") for n in part["hot"] + part["cold"])
 
 
 def test_cold_const_split_partition():

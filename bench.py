@@ -351,6 +351,10 @@ def bench_roofline(lanes: int, virtual_secs: float, client_rate: float) -> dict:
             # continuous batching (r9): lane occupancy refill-vs-chunked
             # on a 10x horizon-spread mix + the lane-step advantage
             "refill_occupancy": rl.refill_occupancy(),
+            # multi-chip fleet (r10): seeds/s + per-device occupancy +
+            # lane-step scaling at 1/2/4/8 devices on the same mix
+            # (device counts beyond the visible fleet are skipped)
+            "mesh_scaling": rl.mesh_scaling(),
         }
     except Exception as e:  # noqa: BLE001 - diagnostics must not kill BENCH
         return {"roofline_error": str(e)[:200]}

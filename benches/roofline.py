@@ -378,6 +378,125 @@ def per_workload_roofline(lanes: int = 32768, scan: int = 300,
     return {"attainable_hbm_gbs": round(bw, 1), "rows": rows}
 
 
+def _spread_mix_sim(virtual_secs: float):
+    """The 10x-horizon-spread workload mix's sim (shared by
+    refill_occupancy and mesh_scaling): raft under a crash+loss plan."""
+    from madsim_tpu import nemesis as nem
+    from madsim_tpu.tpu import make_raft_spec
+    from madsim_tpu.tpu import nemesis as tn
+    from madsim_tpu.tpu.engine import BatchedSim
+    from madsim_tpu.tpu.spec import SimConfig
+
+    horizon = int(virtual_secs * 1e6)
+    plan = nem.FaultPlan(name="refill-occ", clauses=(
+        nem.Crash(interval_lo_us=horizon // 6, interval_hi_us=horizon // 2,
+                  down_lo_us=horizon // 8, down_hi_us=horizon // 3),
+        nem.MsgLoss(rate=0.05),
+    ))
+    cfg = tn.compile_plan(plan, SimConfig(horizon_us=horizon))
+    return BatchedSim(make_raft_spec(), cfg, triage=True), horizon
+
+
+def _spread_ctl_rows(h):
+    """Per-admission TriageCtl rows for a horizon column `h` (int64 us)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from madsim_tpu.tpu.engine import TriageCtl
+    from madsim_tpu.tpu.spec import REBASE_US
+
+    n = len(h)
+    return TriageCtl(
+        off=jnp.zeros((n,), jnp.int32),
+        occ=jnp.zeros((n, 4), jnp.int32),
+        rate_scale=jnp.ones((n, 3), jnp.float32),
+        h_epoch=jnp.asarray((h // REBASE_US).astype(np.int32)),
+        h_off=jnp.asarray((h % REBASE_US).astype(np.int32)),
+    )
+
+
+def mesh_scaling(
+    lanes: int = 16, waves: int = 16, spread: int = 10,
+    long_every: int = 8, virtual_secs: float = 1.0,
+    device_counts=(1, 2, 4, 8), max_steps: int = 50_000,
+) -> dict:
+    """The multi-chip fleet's headline table (r10, docs/multichip.md):
+    the sharded refill sweep on the 10x horizon-spread mix at 1/2/4/8
+    devices with EQUAL per-device lanes and equal per-device queue depth
+    (admissions scale with the device count). Per row: seeds/s (wall —
+    hardware-dependent), per-device occupancy, and the aggregate
+    LANE-STEP THROUGHPUT per sweep iteration (busy-lane-steps / max
+    device iters — the hardware-independent scaling number: one device
+    caps at `lanes` per iteration, D devices at D * lanes).
+    `scaling_vs_1dev` on the D-device row is that number over the
+    1-device row's; the multichip smoke asserts >= 6x at D = 8.
+    Device counts beyond the visible device count are skipped."""
+    import numpy as np
+
+    import jax
+    from madsim_tpu.tpu.engine import (
+        refill_results, refill_results_sharded,
+    )
+
+    sim, horizon = _spread_mix_sim(virtual_secs)
+    devs = jax.devices()
+    rows = []
+    base_tp = None
+    for D in device_counts:
+        if D > len(devs):
+            continue
+        A = lanes * waves * D
+        seeds = np.arange(A, dtype=np.uint32)
+        h = np.where(
+            np.arange(A) % long_every == 0, horizon, horizon // spread
+        ).astype(np.int64)
+        ctl = _spread_ctl_rows(h)
+        t0 = time.perf_counter()
+        if D == 1:
+            st = sim.run_refill(
+                seeds, lanes=lanes, max_steps=max_steps, ctl=ctl
+            )
+            res = refill_results(st)
+            per_dev = [{
+                "iters": res["iters"],
+                "busy_lane_steps": res["busy_lane_steps"],
+                "total_lane_steps": res["total_lane_steps"],
+                "occupancy": res["occupancy"],
+            }]
+            tp = res["busy_lane_steps"] / max(res["iters"], 1)
+        else:
+            mesh = jax.sharding.Mesh(np.array(devs[:D]), ("seeds",))
+            st = sim.run_refill_sharded(
+                seeds, lanes=lanes, mesh=mesh, max_steps=max_steps,
+                ctl=ctl,
+            )
+            res = refill_results_sharded(st, admissions=A)
+            per_dev = res["per_device"]
+            tp = res["lane_steps_per_iter"]
+        wall_s = time.perf_counter() - t0
+        if base_tp is None:
+            base_tp = tp
+        rows.append({
+            "devices": D,
+            "admissions": A,
+            "lanes_per_device": lanes,
+            "seeds_per_sec": round(A / max(wall_s, 1e-9), 1),
+            "wall_ms": round(wall_s * 1e3, 1),
+            "occupancy": round(float(res["occupancy"]), 4),
+            "per_device_occupancy": [
+                round(float(p["occupancy"]), 4) for p in per_dev
+            ],
+            "lane_steps_per_iter": round(tp, 2),
+            "scaling_vs_1dev": round(tp / max(base_tp, 1e-9), 2),
+        })
+    return {
+        "horizon_spread": spread,
+        "long_every": long_every,
+        "visible_devices": len(devs),
+        "rows": rows,
+    }
+
+
 def refill_occupancy(
     lanes: int = 256, waves: int = 8, spread: int = 10,
     long_every: int = 8, virtual_secs: float = 2.0,
@@ -395,23 +514,9 @@ def refill_occupancy(
     asserted >= 0.9 occupancy by `make refill-smoke`."""
     import numpy as np
 
-    import jax.numpy as jnp
-    from madsim_tpu import nemesis as nem
-    from madsim_tpu.tpu import make_raft_spec
-    from madsim_tpu.tpu import nemesis as tn
-    from madsim_tpu.tpu.engine import (
-        BatchedSim, TriageCtl, refill_results,
-    )
-    from madsim_tpu.tpu.spec import REBASE_US, SimConfig
+    from madsim_tpu.tpu.engine import refill_results
 
-    horizon = int(virtual_secs * 1e6)
-    plan = nem.FaultPlan(name="refill-occ", clauses=(
-        nem.Crash(interval_lo_us=horizon // 6, interval_hi_us=horizon // 2,
-                  down_lo_us=horizon // 8, down_hi_us=horizon // 3),
-        nem.MsgLoss(rate=0.05),
-    ))
-    cfg = tn.compile_plan(plan, SimConfig(horizon_us=horizon))
-    sim = BatchedSim(make_raft_spec(), cfg, triage=True)
+    sim, horizon = _spread_mix_sim(virtual_secs)
     A = lanes * waves
     seeds = np.arange(A, dtype=np.uint32)
     h = np.where(
@@ -419,15 +524,7 @@ def refill_occupancy(
     ).astype(np.int64)
 
     def ctl_rows(sel):
-        n = int(sel.sum()) if sel.dtype == bool else len(sel)
-        hs = h[sel]
-        return TriageCtl(
-            off=jnp.zeros((n,), jnp.int32),
-            occ=jnp.zeros((n, 4), jnp.int32),
-            rate_scale=jnp.ones((n, 3), jnp.float32),
-            h_epoch=jnp.asarray((hs // REBASE_US).astype(np.int32)),
-            h_off=jnp.asarray((hs % REBASE_US).astype(np.int32)),
-        )
+        return _spread_ctl_rows(h[sel])
 
     all_rows = ctl_rows(np.ones((A,), bool))
     t0 = time.perf_counter()

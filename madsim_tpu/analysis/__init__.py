@@ -59,7 +59,16 @@ ALL_RULES = JAXPR_RULES + LINT_RULES
 # partition + device-resident admission queue, docs/continuous_batching.md)
 # — every jaxpr/range rule runs against that carry too, so `make analyze`
 # gates the refill engine exactly like the plain partitions.
-WORKLOADS = ("raft", "kv", "paxos", "twopc", "chain", "raft-refill")
+# "raft-refill-sharded" additionally traces the shard_map'd MULTI-CHIP
+# segment program (docs/multichip.md): the same refill rules over the
+# per-device step, plus the lane-independence rule walking the whole
+# sharded segment for cross-device collective primitives — allowlisted
+# by EXACT primitive name (jaxpr_check.SHARD_COLLECTIVE_ALLOW, empty
+# in-tree), never wholesale.
+WORKLOADS = (
+    "raft", "kv", "paxos", "twopc", "chain", "raft-refill",
+    "raft-refill-sharded",
+)
 
 
 @dataclasses.dataclass(frozen=True)

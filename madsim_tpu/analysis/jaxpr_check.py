@@ -87,6 +87,23 @@ REFILL_ADMISSIONS = 29
 # refill step remains subject to the lane rule.
 REFILL_LANE_ALLOW = ("cumsum", "reduce_sum", "reduce_or")
 
+# cross-device collective primitives: the multi-chip determinism contract
+# (docs/multichip.md) says the shard_map'd refill segment contains ZERO of
+# these — each device owns its sub-queue/lanes/result buffers and gathers
+# happen at segment end on the host. Any future exception must be
+# allowlisted by EXACT primitive name in SHARD_COLLECTIVE_ALLOW (empty
+# in-tree), never by disabling the walk.
+# real jaxpr PRIMITIVE names only (eqn.primitive.name): API sugar like
+# jnp/pmean/pshuffle and grouped collectives (axis_index_groups is a
+# psum/all_gather PARAM) all lower to these underlying primitives, so
+# they are caught via this set — listing non-primitive names here would
+# only misstate the coverage.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
+    "reduce_scatter", "pbroadcast", "pgather",
+})
+SHARD_COLLECTIVE_ALLOW: Tuple[str, ...] = ()
+
 # occurrence counters: the ONLY non-key values a schedule draw may touch
 NEUTRAL_LEAVES = frozenset({
     "hot.nem.crash_k", "hot.nem.part_k", "hot.nem.clog_k",
@@ -452,6 +469,35 @@ def check_lane_independence(
     return res
 
 
+def check_collectives(
+    closed,
+    where: str = "sharded-segment",
+    allow: Sequence[str] = SHARD_COLLECTIVE_ALLOW,
+) -> RuleResult:
+    """No cross-device collective primitive anywhere in the shard_map'd
+    refill segment (recursing every sub-jaxpr: the shard_map body, its
+    while_loop, the retire-and-admit cond). Folded into the
+    lane-independence rule: a cross-device collective is exactly a
+    cross-lane coupling lifted to the mesh axis, and it breaks the same
+    bit-identity contract. `allow` names permitted primitives EXACTLY
+    (empty in-tree)."""
+    res = RuleResult("lane-independence")
+    allowed = set(allow)
+    for eqn, depth in iter_eqns(closed.jaxpr):
+        res.checked += 1
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS and name not in allowed:
+            res.add(
+                where,
+                f"cross-device collective `{name}` at nesting depth "
+                f"{depth} inside the sharded refill segment — devices "
+                "must stay independent between segment boundaries "
+                "(allowlist by exact primitive in "
+                "SHARD_COLLECTIVE_ALLOW if ever intended)",
+            )
+    return res
+
+
 def check_step_donation(
     step_fn,
     hot,
@@ -665,6 +711,8 @@ class WorkloadTrace:
     invars_avals: List[Any]
     time_leaves: Set[str]
     refill: bool = False  # tracing the continuous-batching partition?
+    sharded: bool = False  # also tracing the shard_map'd segment?
+    closed_sharded: Any = None  # jaxpr of the multi-chip segment program
 
 
 _TRACE_CACHE: Dict[Tuple[str, int], WorkloadTrace] = {}
@@ -683,13 +731,34 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
     cached = _TRACE_CACHE.get(key)
     if cached is not None:
         return cached
-    refill = name.endswith("-refill")
-    base = name[: -len("-refill")] if refill else name
+    sharded = name.endswith("-sharded")
+    base = name[: -len("-sharded")] if sharded else name
+    refill = base.endswith("-refill")
+    base = base[: -len("-refill")] if refill else base
+    if sharded and not refill:
+        raise ValueError(
+            f"{name!r}: only the refill step has a sharded trace target"
+        )
     if log:
         log(f"[analysis] tracing {name} step program (L={lanes}) ...")
     sim, state, hot, cold, const = build_verified_sim(
         base, lanes=lanes, refill=refill,
     )
+    closed_sharded = None
+    if sharded:
+        # the multi-chip segment: the EXACT engine._sharded_segment
+        # program, traced abstractly over a 1-device mesh (the mesh size
+        # changes block shapes, never the primitive vocabulary — a
+        # collective would appear in this jaxpr at any device count)
+        import numpy as _np
+
+        mesh = jax.sharding.Mesh(_np.array(jax.devices()[:1]), ("devices",))
+        stacked = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct((1,) + x.shape, x.dtype), state
+        )
+        closed_sharded = jax.make_jaxpr(
+            lambda st: sim._sharded_segment(mesh, 8)(st)
+        )(stacked)
     closed = jax.make_jaxpr(sim._step_split)(hot, cold, const)
     out_template = jax.eval_shape(sim._step_split, hot, cold, const)
     seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
@@ -715,6 +784,8 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
         ),
         time_leaves=_time_leaves(sim),
         refill=refill,
+        sharded=sharded,
+        closed_sharded=closed_sharded,
     )
     _TRACE_CACHE[key] = trace
     return trace
@@ -766,6 +837,13 @@ def verify_workload(
             closed, trace.lanes, where,
             allow=REFILL_LANE_ALLOW if trace.refill else (),
         ))
+        if trace.sharded:
+            # the multi-chip face of the same rule: the whole shard_map'd
+            # segment program must contain zero cross-device collectives
+            # (exact-primitive allowlist, empty in-tree)
+            results.append(check_collectives(
+                trace.closed_sharded, f"{name}:_sharded_segment",
+            ))
     if on("donation"):
         results.append(check_donation(
             sim, trace.state, trace.hot, trace.cold, trace.const,

@@ -767,15 +767,34 @@ def load_corpus(dir: str) -> List[CorpusEntry]:
     ]
 
 
+def merge_entry_lists(
+    lists: Sequence[Sequence[CorpusEntry]],
+) -> List[CorpusEntry]:
+    """Concatenate several in-memory corpora, first occurrence of each
+    genome winning, in list order (the deterministic merge primitive
+    shared by `merge_corpora` and the island federation's coverage
+    exchange — explore.Federation feeds its islands' corpora through
+    here, then through `minimize`'s asserted union invariant)."""
+    entries: List[CorpusEntry] = []
+    seen: set = set()
+    for lst in lists:
+        for e in lst:
+            key = canon_genome(e.cand.key())
+            if key in seen:
+                continue
+            seen.add(key)
+            entries.append(e)
+    return entries
+
+
 def merge_corpora(dirs: Sequence[str]) -> Tuple[List[CorpusEntry], List[dict]]:
     """Concatenate the corpora of several campaign directories, first
     occurrence of each genome winning, and verify they fuzzed the SAME
     workload spec and compiled configuration (a corpus entry is only
     replayable against the draw layout that produced it — and config_hash
     covers only the SimConfig, so the spec name is checked separately)."""
-    entries: List[CorpusEntry] = []
     manifests: List[dict] = []
-    seen: set = set()
+    corpora: List[List[CorpusEntry]] = []
     hashes = set()
     spec_names = set()
     for d in dirs:
@@ -786,12 +805,8 @@ def merge_corpora(dirs: Sequence[str]) -> Tuple[List[CorpusEntry], List[dict]]:
             hashes.add(man["config_hash"])
         if man.get("spec_name"):
             spec_names.add(man["spec_name"])
-        for e in load_corpus(d):
-            key = canon_genome(e.cand.key())
-            if key in seen:
-                continue
-            seen.add(key)
-            entries.append(e)
+        corpora.append(load_corpus(d))
+    entries = merge_entry_lists(corpora)
     if len(hashes) > 1:
         raise ValueError(
             f"corpora were fuzzed under {len(hashes)} different configs "
@@ -1118,6 +1133,23 @@ def _default_factory(request: Dict[str, Any], campaign_dir: str,
     )
 
 
+def _device_ctx(dev):
+    """jax.default_device(dev) for a real jax Device; a no-op context for
+    None and for the stub tokens the scheduling tests use."""
+    import contextlib
+
+    if dev is None:
+        return contextlib.nullcontext()
+    try:
+        import jax
+
+        if isinstance(dev, jax.Device):
+            return jax.default_device(dev)
+    except ImportError:
+        pass
+    return contextlib.nullcontext()
+
+
 def serve(
     dir: str,
     poll_s: float = 0.5,
@@ -1128,19 +1160,33 @@ def serve(
     log: Optional[Callable[[str], None]] = None,
     factory: Optional[Callable[..., Any]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    devices: Optional[Sequence[Any]] = None,
 ) -> Dict[str, Any]:
     """The fuzz-farm front end: watch `<dir>/queue/` for request files,
-    time-slice the device between active campaigns round-robin
+    time-slice the DEVICE FLEET between active campaigns round-robin
     (`slice_generations` explorer generations per turn), stream ONE JSON
-    line per slice ({campaign, generation, fingerprint, report}), and
-    checkpoint after every slice — a kill at any slice boundary resumes
-    exactly where it stopped.
+    line per slice ({campaign, generation, device, fingerprint,
+    report}), and checkpoint after every slice — a kill at any slice
+    boundary resumes exactly where it stopped.
+
+    Device-aware scheduling (r10, docs/multichip.md): with `devices`
+    (e.g. jax.devices(), CLI `--devices all`), every round distributes
+    the active campaigns over the devices — least-loaded first, honoring
+    each request's optional `"devices": [idx, ...]` device-set pin — and
+    the per-device slice lanes run CONCURRENTLY (one thread per device;
+    each campaign's slice still runs alone on its device). Campaign
+    results stay bit-identical whatever the placement: a slice is the
+    same pure function of the campaign's meta-seed on any device, and
+    the checkpoint-per-slice discipline is unchanged, so per-campaign
+    kill/resume remains exact. Without `devices` the behavior is the
+    r6 single-device round-robin, unchanged.
 
     Request file (JSON): {"id"?, "workload", "virtual_secs"?, "storm"?,
     "meta_seed"?, "lanes"?, "chunk"?, "generations", "shrink"?,
-    "max_shrinks"?}. Requests move queue/ -> active/ -> done/. No new
-    dependencies: the queue is the filesystem (the "JSON on a watch-dir"
-    face; anything that can write a file can submit work).
+    "max_shrinks"?, "devices"?}. Requests move queue/ -> active/ ->
+    done/. No new dependencies: the queue is the filesystem (the "JSON
+    on a watch-dir" face; anything that can write a file can submit
+    work).
 
     `max_rounds` / `idle_rounds` bound the loop for tests and cron-style
     runs; the default (None/None) serves forever.
@@ -1150,6 +1196,9 @@ def serve(
             f"slice_generations must be >= 1 (got {slice_generations}): a "
             "zero-generation slice never finishes any request"
         )
+    # an empty device sequence is exactly "no pinning" — same as None
+    devs: List[Any] = list(devices) if devices else [None]
+    pinned_devices = bool(devices)
     queue_dir = os.path.join(dir, "queue")
     active_dir = os.path.join(dir, "active")
     done_dir = os.path.join(dir, "done")
@@ -1214,6 +1263,24 @@ def serve(
             if remaining <= 0:
                 reject(path, cid, "generations must be positive")
                 continue
+            # per-campaign device set: indices into this service's device
+            # list. Validated here so a bad pin is a loud reject, never a
+            # silently unschedulable job.
+            dev_set: Optional[set] = None
+            if request.get("devices") is not None:
+                try:
+                    dev_set = {int(i) for i in request["devices"]}
+                except (TypeError, ValueError):
+                    reject(path, cid, "devices must be a list of indices")
+                    continue
+                bad = {i for i in dev_set if not 0 <= i < len(devs)}
+                if bad or not dev_set:
+                    reject(
+                        path, cid,
+                        f"device indices {sorted(bad) or '[]'} out of "
+                        f"range — this service has {len(devs)} device(s)",
+                    )
+                    continue
             # active/ entries are keyed by CAMPAIGN id, not request-file
             # basename: two differently-named files with distinct explicit
             # ids must never share (and clobber) one in-flight path
@@ -1247,66 +1314,127 @@ def serve(
                 "active_path": active_path,
                 "campaign_dir": campaign_dir,
                 "remaining": left,
+                "devices": dev_set,
             }
             out(json.dumps({
                 "campaign": cid, "accepted": True, "generations": left,
+                **({"devices": sorted(dev_set)} if dev_set else {}),
             }))
 
-    while True:
-        poll_queue()
-        progressed = False
+    def assign_round() -> Dict[int, List[str]]:
+        """Distribute this round's campaigns over the devices: every
+        active campaign gets exactly ONE slice per round (the r6
+        time-slicing contract, now per device lane), placed on the
+        least-loaded device its device set allows — lowest index on
+        ties, in sorted-campaign order, so the assignment (and the
+        output stream) is deterministic."""
+        assignment: Dict[int, List[str]] = {i: [] for i in range(len(devs))}
         for cid in sorted(jobs):
+            allowed = jobs[cid]["devices"] or range(len(devs))
+            di = min(allowed, key=lambda i: (len(assignment[i]), i))
+            assignment[di].append(cid)
+        return assignment
+
+    def run_lane(assignment, di: int) -> Dict[str, tuple]:
+        """One device's slice lane: its campaigns' slices, sequentially,
+        pinned to the device. Raises never escape — a failing tenant is
+        reported per-campaign in the fold below."""
+        res: Dict[str, tuple] = {}
+        for cid in assignment[di]:
             job = jobs[cid]
             g = min(int(slice_generations), job["remaining"])
-            campaign = job["campaign"]
             try:
-                report = campaign.run(g)
-                campaign.checkpoint()
+                with _device_ctx(devs[di]):
+                    report = job["campaign"].run(g)
+                    job["campaign"].checkpoint()
+                res[cid] = (g, report, None)
             except Exception as e:  # noqa: BLE001 - one tenant's failing
-                # workload must not take the other campaigns down; its last
-                # good checkpoint stays resumable
-                reject(
-                    job["active_path"], cid,
-                    f"slice failed: {type(e).__name__}: {str(e)[:200]}",
-                )
-                del jobs[cid]
-                progressed = True
-                continue
-            job["remaining"] -= g
-            line = {
-                "campaign": cid,
-                "generation": campaign.generation,
-                "remaining": job["remaining"],
-                "fingerprint": report.fingerprint(),
-                "bugs": len(getattr(campaign, "bugs", ())),
-                "report": report.to_dict(),
+                # workload must not take the other campaigns down; its
+                # last good checkpoint stays resumable
+                res[cid] = (g, None, e)
+        return res
+
+    pool = None
+    if len(devs) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(
+            max_workers=len(devs), thread_name_prefix="madsim-serve",
+        )
+    try:
+        while True:
+            poll_queue()
+            progressed = False
+            assignment = assign_round()
+            lanes = [di for di in sorted(assignment) if assignment[di]]
+            device_of = {
+                cid: di for di in lanes for cid in assignment[di]
             }
-            out(json.dumps(line))
-            with open(
-                os.path.join(job["campaign_dir"], REPORTS_STREAM), "a"
-            ) as f:
-                f.write(json.dumps(line) + "\n")
-            progressed = True
-            if job["remaining"] <= 0:
-                os.replace(
-                    job["active_path"],
-                    os.path.join(
-                        done_dir, os.path.basename(job["active_path"])
-                    ),
-                )
-                completed.append(cid)
-                del jobs[cid]
-        rounds += 1
-        if max_rounds is not None and rounds >= max_rounds:
-            break
-        if progressed:
-            idle = 0
-        else:
-            idle += 1
-            if idle_rounds is not None and idle >= idle_rounds:
+            results: Dict[str, tuple] = {}
+            if pool is not None and len(lanes) > 1:
+                futs = [
+                    pool.submit(run_lane, assignment, di) for di in lanes
+                ]
+                for f in futs:
+                    results.update(f.result())
+            else:
+                for di in lanes:
+                    results.update(run_lane(assignment, di))
+            for cid in sorted(results):
+                g, report, err = results[cid]
+                job = jobs[cid]
+                if err is not None:
+                    reject(
+                        job["active_path"], cid,
+                        f"slice failed: {type(err).__name__}: "
+                        f"{str(err)[:200]}",
+                    )
+                    del jobs[cid]
+                    progressed = True
+                    continue
+                job["remaining"] -= g
+                campaign = job["campaign"]
+                line = {
+                    "campaign": cid,
+                    "generation": campaign.generation,
+                    "remaining": job["remaining"],
+                    "device": device_of[cid] if pinned_devices else None,
+                    "fingerprint": report.fingerprint(),
+                    "bugs": len(getattr(campaign, "bugs", ())),
+                    "report": report.to_dict(),
+                }
+                out(json.dumps(line))
+                with open(
+                    os.path.join(job["campaign_dir"], REPORTS_STREAM), "a"
+                ) as f:
+                    f.write(json.dumps(line) + "\n")
+                progressed = True
+                if job["remaining"] <= 0:
+                    os.replace(
+                        job["active_path"],
+                        os.path.join(
+                            done_dir, os.path.basename(job["active_path"])
+                        ),
+                    )
+                    completed.append(cid)
+                    del jobs[cid]
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
                 break
-            sleep(poll_s)
-    return {"rounds": rounds, "completed": completed, "pending": sorted(jobs)}
+            if progressed:
+                idle = 0
+            else:
+                idle += 1
+                if idle_rounds is not None and idle >= idle_rounds:
+                    break
+                sleep(poll_s)
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
+    return {
+        "rounds": rounds, "completed": completed, "pending": sorted(jobs),
+        "devices": len(devs) if pinned_devices else 1,
+    }
 
 
 # --------------------------------------------------------------------------
@@ -1402,11 +1530,33 @@ def _cmd_regress(args) -> int:
 
 
 def _cmd_serve(args) -> int:
+    devices = None
+    if args.devices:
+        import jax
+
+        devs = jax.devices()
+        if args.devices == "all":
+            devices = devs
+        else:
+            try:
+                n = int(args.devices)
+            except ValueError:
+                raise SystemExit(
+                    f"--devices must be an integer or 'all', got "
+                    f"{args.devices!r}"
+                ) from None
+            if n < 1 or n > len(devs):
+                raise SystemExit(
+                    f"--devices {n} out of range: {len(devs)} device(s) "
+                    "visible"
+                )
+            devices = devs[:n]
     serve(
         args.dir, poll_s=args.poll,
         slice_generations=args.slice_generations,
         max_rounds=args.max_rounds, idle_rounds=args.idle_rounds,
         log=lambda m: print(m, flush=True) if args.verbose else None,
+        devices=devices,
     )
     return 0
 
@@ -1464,6 +1614,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     s.add_argument("--slice-generations", type=int, default=1)
     s.add_argument("--max-rounds", type=int, default=None)
     s.add_argument("--idle-rounds", type=int, default=None)
+    s.add_argument(
+        "--devices", default=None, metavar="N|all",
+        help="schedule campaigns across this many visible devices "
+        "(concurrent per-device slice lanes; requests may pin a device "
+        "subset with \"devices\": [i, ...]) — default: single device, "
+        "the r6 behavior",
+    )
     s.add_argument("--verbose", action="store_true")
     s.set_defaults(fn=_cmd_serve)
 

@@ -68,6 +68,17 @@ from .nemesis import (
 # the explorer's single meta-draw site on the shared murmur3 chain (a site
 # is a namespace — keep unique across nemesis.py/engine draw sites)
 META_SITE_DRAW = 301
+# island-seed derivation site (Federation): island i's MetaRng root is
+# bits32(key_from_seed(meta_seed), META_SITE_ISLAND, i) — the whole
+# federation stays a pure function of ONE meta-seed
+META_SITE_ISLAND = 302
+
+
+def island_meta_seed(meta_seed: int, island: int) -> int:
+    """Island `island`'s own meta-seed, derived from the federation
+    meta-seed through the shared murmur3 chain (pure, collision-spread:
+    per-island MetaRng streams are independent counter chains)."""
+    return bits32(key_from_seed(int(meta_seed)), META_SITE_ISLAND, int(island))
 
 
 class MetaRng:
@@ -472,6 +483,7 @@ class Explorer:
         top_k: int = 16,
         swarm_group: int = 8,
         first_seed: int = 0,
+        fresh_stride: int = 1,
         shrink_violations: bool = True,
         max_shrinks: Optional[int] = None,
         shrink_kwargs: Optional[Dict[str, Any]] = None,
@@ -531,6 +543,11 @@ class Explorer:
         self.sim = sim
         self._rng = MetaRng(self.meta_seed)
         self._next_fresh = int(first_seed)
+        # fresh seeds advance by `fresh_stride` (default 1): the island
+        # federation gives island i the stride-n_islands progression
+        # first_seed=i, so per-island fresh-seed SUB-QUEUES are disjoint
+        # by construction (docs/multichip.md)
+        self._fresh_stride = max(1, int(fresh_stride))
         self._full_h = int(self.cfg.horizon_us)
 
         # the mutation vocabulary this config supports
@@ -580,7 +597,7 @@ class Explorer:
 
     def _fresh(self) -> Candidate:
         c = Candidate(seed=self._next_fresh)
-        self._next_fresh += 1
+        self._next_fresh += self._fresh_stride
         return c
 
     def _mutate(self, parent: Candidate) -> Candidate:
@@ -676,35 +693,84 @@ class Explorer:
     def _ctl_for(self, pop: List[Candidate]):
         return ctl_for(pop, self._full_h)
 
+    def _fold_part(
+        self, gen: int, part, bitmaps, hiwater, transitions, violated,
+        new_violations: List[Tuple[Candidate, np.ndarray]],
+    ) -> None:
+        """Fold one decoded slice of a generation's lanes (IN ADMISSION
+        ORDER) into the corpus/union, collecting novel violations into
+        `new_violations` for `_finish_generation`. Candidates fold in
+        pop order whatever dispatch produced the rows — chunked (called
+        per chunk from decode, overlapping device time), refill, or the
+        federation's sharded per-island rows — which is what keeps
+        corpus contents and fingerprints bit-identical across dispatch
+        shapes."""
+        self.seeds_run += len(part)
+        for i, cand in enumerate(part):
+            new = bitmaps[i] & ~self.union
+            nb = int(popcount_rows(new[None, :])[0])
+            if nb > 0:
+                # lane order IS admission order: earlier lanes absorb
+                # shared novelty, keeping the corpus deterministic
+                self.union |= bitmaps[i]
+                self.corpus.append(CorpusEntry(
+                    cand=cand, new_bits=nb, bitmap=bitmaps[i].copy(),
+                    hiwater=int(hiwater[i]),
+                    transitions=int(transitions[i]),
+                    violated=bool(violated[i]), dispatch=gen,
+                ))
+            if violated[i] and cand.seed not in self._violated_seeds:
+                self._violated_seeds.add(cand.seed)
+                new_violations.append((cand, bitmaps[i].copy()))
+
+    def _finish_generation(
+        self, gen: int,
+        new_violations: List[Tuple[Candidate, np.ndarray]],
+    ) -> None:
+        """Close one generation: shrink/record the novel violations and
+        append the coverage/corpus/violation curve points."""
+        for cand, bitmap in new_violations:
+            if self.first_violation_dispatch is None:
+                self.first_violation_dispatch = gen
+            self.violations.append(self._record_violation(cand, gen, bitmap))
+        self.coverage_curve.append(
+            int(popcount_rows(self.union[None, :])[0])
+        )
+        self.corpus_curve.append(len(self.corpus))
+        self.violation_curve.append(len(self.violations))
+        self.say(
+            f"dispatch {gen}: {self.coverage_curve[-1]} union bits, "
+            f"corpus {len(self.corpus)}, violations {len(self.violations)}"
+        )
+
+    def _fold_generation(self, gen: int, parts) -> None:
+        """One whole generation's rows at once (the refill and
+        federation face of _fold_part + _finish_generation)."""
+        new_violations: List[Tuple[Candidate, np.ndarray]] = []
+        for part, bitmaps, hiwater, transitions, violated in parts:
+            self._fold_part(
+                gen, part, bitmaps, hiwater, transitions, violated,
+                new_violations,
+            )
+        self._finish_generation(gen, new_violations)
+
     def _run_generation(self, gen: int, pop: List[Candidate]) -> None:
         """Dispatch one generation — continuously batched by default (the
         whole population is the admission queue of one refill sweep), or
         chunked + double-buffered like run_batch (chunk k+1 on device
-        while the host ranks chunk k) — and fold its coverage into the
-        corpus. Both paths fold candidates in pop order, so the corpus,
-        union, and violation records are bit-identical."""
+        while the host ranks chunk k: each chunk folds inside decode) —
+        and fold its coverage into the corpus. Both paths fold
+        candidates in pop order, so the corpus, union, and violation
+        records are bit-identical."""
         from .tpu.batch import pipelined
 
         new_violations: List[Tuple[Candidate, np.ndarray]] = []
 
         def fold(part, bitmaps, hiwater, transitions, violated) -> None:
-            self.seeds_run += len(part)
-            for i, cand in enumerate(part):
-                new = bitmaps[i] & ~self.union
-                nb = int(popcount_rows(new[None, :])[0])
-                if nb > 0:
-                    # lane order IS admission order: earlier lanes absorb
-                    # shared novelty, keeping the corpus deterministic
-                    self.union |= bitmaps[i]
-                    self.corpus.append(CorpusEntry(
-                        cand=cand, new_bits=nb, bitmap=bitmaps[i].copy(),
-                        hiwater=int(hiwater[i]),
-                        transitions=int(transitions[i]),
-                        violated=bool(violated[i]), dispatch=gen,
-                    ))
-                if violated[i] and cand.seed not in self._violated_seeds:
-                    self._violated_seeds.add(cand.seed)
-                    new_violations.append((cand, bitmaps[i].copy()))
+            self._fold_part(
+                gen, part, bitmaps, hiwater, transitions, violated,
+                new_violations,
+            )
 
         if self.refill:
             from .tpu.engine import refill_results
@@ -745,19 +811,7 @@ class Explorer:
                 range(0, len(pop), self.chunk), dispatch, decode,
                 serial=not self.pipeline,
             )
-        for cand, bitmap in new_violations:
-            if self.first_violation_dispatch is None:
-                self.first_violation_dispatch = gen
-            self.violations.append(self._record_violation(cand, gen, bitmap))
-        self.coverage_curve.append(
-            int(popcount_rows(self.union[None, :])[0])
-        )
-        self.corpus_curve.append(len(self.corpus))
-        self.violation_curve.append(len(self.violations))
-        self.say(
-            f"dispatch {gen}: {self.coverage_curve[-1]} union bits, "
-            f"corpus {len(self.corpus)}, violations {len(self.violations)}"
-        )
+        self._finish_generation(gen, new_violations)
 
     def _record_violation(
         self, cand: Candidate, gen: int,
@@ -913,6 +967,308 @@ class Explorer:
 
 
 # --------------------------------------------------------------------------
+# island-model federation (multi-chip explorer, docs/multichip.md)
+# --------------------------------------------------------------------------
+
+
+class Federation:
+    """Island-model explorer federation: `n_islands` independent
+    coverage-guided searches — one corpus per island, each fed from its
+    own disjoint fresh-seed sub-queue (island i draws seeds i, i + n,
+    i + 2n, ...) and its own MetaRng counter chain derived from ONE
+    federation meta-seed — with periodic coverage EXCHANGE built on the
+    campaign layer's merge + cmin (`campaign.merge_entry_lists` +
+    `campaign.minimize`, whose asserted union-preservation invariant IS
+    the exchange primitive).
+
+        fed = Federation(workload, n_islands=8, meta_seed=7, lanes=32)
+        report = fed.run(generations=12)
+
+    Device placement: when a `mesh` with exactly `n_islands` devices is
+    given, every generation runs as ONE shard_map'd refill dispatch —
+    island i's population is device i's admission sub-queue
+    (engine.run_refill_sharded), zero cross-device collectives in the
+    step, per-island rows gathered at segment end. Without a matching
+    mesh the islands dispatch sequentially through the same per-island
+    refill engine. The two paths produce BIT-IDENTICAL rows per island
+    (the r9/r10 refill contract), so the federation fingerprint is
+    pinned across device counts — and across kill/resume via
+    `snapshot()`/`restore()` (per-island MetaRng counter cursors).
+    """
+
+    def __init__(
+        self,
+        workload,
+        n_islands: int = 8,
+        meta_seed: int = 0,
+        lanes: int = 64,
+        exchange_every: int = 4,
+        minimize_on_exchange: bool = True,
+        mesh=None,
+        refill_lanes: Optional[int] = None,
+        shrink_violations: bool = False,
+        max_shrinks: Optional[int] = None,
+        shrink_kwargs: Optional[Dict[str, Any]] = None,
+        sim=None,
+        log: Optional[Callable[[str], None]] = None,
+        **island_kwargs,
+    ) -> None:
+        from .tpu.engine import BatchedSim
+
+        if n_islands < 1:
+            raise ValueError(f"n_islands must be >= 1, got {n_islands}")
+        if exchange_every < 1:
+            raise ValueError(
+                f"exchange_every must be >= 1, got {exchange_every}"
+            )
+        self.workload = workload
+        self.n_islands = int(n_islands)
+        self.meta_seed = int(meta_seed)
+        self.lanes = int(lanes)
+        self.exchange_every = int(exchange_every)
+        self.minimize_on_exchange = bool(minimize_on_exchange)
+        self.mesh = mesh
+        self.refill_lanes = (
+            self.lanes if refill_lanes is None else int(refill_lanes)
+        )
+        self.say = log or (lambda msg: None)
+        if sim is None:
+            sim = BatchedSim(
+                workload.spec, workload.config, triage=True, coverage=True,
+            )
+        elif not (sim.triage and sim.coverage):
+            raise ValueError(
+                "Federation needs a BatchedSim(..., triage=True, "
+                "coverage=True)"
+            )
+        self.sim = sim
+        # ONE sim (and its compiled programs) serves every island; each
+        # island keeps its OWN search state + MetaRng cursor
+        self.islands: List[Explorer] = [
+            Explorer(
+                workload,
+                meta_seed=island_meta_seed(self.meta_seed, i),
+                lanes=self.lanes,
+                first_seed=i,
+                fresh_stride=self.n_islands,
+                refill=True,
+                refill_lanes=self.refill_lanes,
+                shrink_violations=shrink_violations,
+                max_shrinks=max_shrinks,
+                shrink_kwargs=shrink_kwargs,
+                sim=self.sim,
+                log=None,
+                **island_kwargs,
+            )
+            for i in range(self.n_islands)
+        ]
+        self._gen = 0
+        self._wall_s = 0.0
+        # exchange log: one record per exchange, part of the fingerprint
+        # (an exchange changes every island's future ranking decisions,
+        # so it must be pinned by kill/resume too)
+        self.exchanges: List[Dict[str, Any]] = []
+
+    # ----------------------------------------------------------- dispatch
+
+    def _sharded(self) -> bool:
+        return (
+            self.mesh is not None
+            and int(self.mesh.devices.size) == self.n_islands
+        )
+
+    def _run_generation(self) -> None:
+        """One federated generation: every island contributes its next
+        population; rows come back from one shard_map'd refill dispatch
+        (mesh path) or per-island refill sweeps (no/mismatched mesh) and
+        fold into each island's corpus in island-major admission order."""
+        from .tpu.engine import refill_results, refill_results_sharded
+
+        pops = [ex._population(ex._gen) for ex in self.islands]
+        L = self.lanes
+        if self._sharded():
+            # island i's population IS device i's contiguous sub-queue:
+            # A = n_islands * lanes, D = n_islands => Ad = lanes exactly
+            cands = [c for pop in pops for c in pop]
+            seeds = np.asarray([c.seed for c in cands], np.uint32)
+            st = self.sim.run_refill_sharded(
+                seeds, lanes=min(self.refill_lanes, L), mesh=self.mesh,
+                max_steps=self.workload.max_steps,
+                ctl=ctl_for(cands, int(self.sim.config.horizon_us)),
+            )
+            res = refill_results_sharded(st, admissions=len(cands))
+            rows = [
+                (
+                    np.asarray(res["cov_bitmap"][i * L:(i + 1) * L],
+                               np.uint32),
+                    res["cov_hiwater"][i * L:(i + 1) * L],
+                    res["cov_transitions"][i * L:(i + 1) * L],
+                    res["violated"][i * L:(i + 1) * L],
+                )
+                for i in range(self.n_islands)
+            ]
+        else:
+            rows = []
+            for ex, pop in zip(self.islands, pops):
+                seeds = np.asarray([c.seed for c in pop], np.uint32)
+                st = self.sim.run_refill(
+                    seeds, lanes=min(self.refill_lanes, L),
+                    max_steps=self.workload.max_steps,
+                    ctl=ex._ctl_for(pop),
+                )
+                res = refill_results(st)
+                rows.append((
+                    np.asarray(res["cov_bitmap"], np.uint32),
+                    res["cov_hiwater"], res["cov_transitions"],
+                    res["violated"],
+                ))
+        for ex, pop, (bm, hw, tr, vi) in zip(self.islands, pops, rows):
+            ex._fold_generation(ex._gen, [(pop, bm, hw, tr, vi)])
+            ex._gen += 1
+
+    # ----------------------------------------------------------- exchange
+
+    def _exchange(self) -> None:
+        """Periodic coverage exchange: merge every island's corpus
+        (first-genome-wins in island order), cmin-minimize the union
+        (campaign.minimize — union preservation ASSERTED), and install
+        the merged view as every island's corpus/union. Islands keep
+        their own MetaRng cursors and fresh-seed sub-queues, so the
+        exchange never perturbs any island's draw stream — resume
+        stays bit-identical."""
+        from . import campaign
+
+        entries = campaign.merge_entry_lists(
+            [ex.corpus for ex in self.islands]
+        )
+        if entries and self.minimize_on_exchange:
+            res = campaign.minimize(
+                self.workload, entries, sim=self.sim,
+                lane_width=max(2, min(64, self.lanes)),
+            )
+            kept, union = res["kept"], res["union"]
+        else:
+            kept = entries
+            union = np.zeros((Explorer._cov_words(),), np.uint32)
+            for e in entries:
+                union |= e.bitmap
+        bits = int(popcount_rows(union[None, :])[0]) if entries else 0
+        seen = set()
+        violated = set()
+        for ex in self.islands:
+            seen |= ex._seen
+            violated |= ex._violated_seeds
+        for ex in self.islands:
+            ex.corpus = list(kept)
+            ex.union = union.copy()
+            ex._seen = set(seen)
+            ex._violated_seeds = set(violated)
+        self.exchanges.append({
+            "generation": self._gen,
+            "merged": len(entries),
+            "kept": len(kept),
+            "union_bits": bits,
+        })
+        self.say(
+            f"exchange @gen {self._gen}: {len(entries)} entries -> "
+            f"{len(kept)} kept, {bits} union bits"
+        )
+
+    # ---------------------------------------------------------------- run
+
+    def run(self, generations: int) -> Dict[str, Any]:
+        """Run `generations` federated generations (cumulative across
+        calls), exchanging coverage every `exchange_every`."""
+        t0 = time.perf_counter()
+        for _ in range(int(generations)):
+            self._run_generation()
+            self._gen += 1
+            if self._gen % self.exchange_every == 0:
+                self._exchange()
+        self._wall_s += time.perf_counter() - t0
+        return self.report()
+
+    def coverage_bits(self) -> int:
+        """Union bits across ALL islands (the federation's curve value)."""
+        union = np.zeros((Explorer._cov_words(),), np.uint32)
+        for ex in self.islands:
+            union |= ex.union
+        return int(popcount_rows(union[None, :])[0])
+
+    def report(self) -> Dict[str, Any]:
+        reports = [ex.report() for ex in self.islands]
+        island_fps = [r.fingerprint() for r in reports]
+        return {
+            "meta_seed": self.meta_seed,
+            "n_islands": self.n_islands,
+            "lanes": self.lanes,
+            "generations": self._gen,
+            "exchange_every": self.exchange_every,
+            "sharded": self._sharded(),
+            "coverage_bits": self.coverage_bits(),
+            "seeds_run": sum(r.seeds_run for r in reports),
+            "violations": sum(len(r.violations) for r in reports),
+            "exchanges": list(self.exchanges),
+            "wall_s": round(self._wall_s, 3),
+            "islands": [r.to_dict() for r in reports],
+            "fingerprint": self.fingerprint(island_fps),
+        }
+
+    def fingerprint(
+        self, island_fingerprints: Optional[List[str]] = None,
+    ) -> str:
+        """sha256 over every island's fingerprint plus the exchange log:
+        pinned across device counts (mesh vs no mesh) and kill/resume.
+        `island_fingerprints` reuses already-built island reports (an
+        Explorer fingerprint digests its whole corpus — report() passes
+        its own so the corpora are hashed once, not twice)."""
+        fps = island_fingerprints or [
+            ex.report().fingerprint() for ex in self.islands
+        ]
+        h = hashlib.sha256()
+        h.update(json.dumps({
+            "meta_seed": self.meta_seed,
+            "n_islands": self.n_islands,
+            "lanes": self.lanes,
+            "exchange_every": self.exchange_every,
+            "islands": fps,
+            "exchanges": self.exchanges,
+        }, sort_keys=True, separators=(",", ":")).encode())
+        return h.hexdigest()
+
+    # --------------------------------------------------------- persistence
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The complete federation state (JSON-safe): per-island Explorer
+        snapshots (each with its MetaRng counter cursor) + the exchange
+        log. restore() into a same-parameter Federation and `run(k)`
+        continues bit-identically (tested)."""
+        return {
+            "meta_seed": self.meta_seed,
+            "n_islands": self.n_islands,
+            "lanes": self.lanes,
+            "exchange_every": self.exchange_every,
+            "generation": self._gen,
+            "wall_s": self._wall_s,
+            "exchanges": json.loads(json.dumps(self.exchanges)),
+            "islands": [ex.snapshot() for ex in self.islands],
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        for key in ("meta_seed", "n_islands", "lanes", "exchange_every"):
+            if int(snap[key]) != getattr(self, key):
+                raise ValueError(
+                    f"snapshot {key} {snap[key]} != federation "
+                    f"{key} {getattr(self, key)}"
+                )
+        self._gen = int(snap["generation"])
+        self._wall_s = float(snap["wall_s"])
+        self.exchanges = [dict(e) for e in snap["exchanges"]]
+        for ex, isnap in zip(self.islands, snap["islands"]):
+            ex.restore(isnap)
+
+
+# --------------------------------------------------------------------------
 # CLI
 # --------------------------------------------------------------------------
 
@@ -1001,6 +1357,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="device lane count for the refill engine (default: the "
         "chunk width); smaller = more refills per generation",
     )
+    parser.add_argument(
+        "--islands", type=int, default=0,
+        help="run an island-model FEDERATION of this many explorers "
+        "(docs/multichip.md): per-island corpora + disjoint fresh-seed "
+        "sub-queues, periodic coverage exchange; when the visible device "
+        "count equals the island count, each generation runs as one "
+        "shard_map'd multi-chip dispatch (0 = single explorer)",
+    )
+    parser.add_argument(
+        "--exchange-every", type=int, default=4,
+        help="federation coverage-exchange period in generations",
+    )
     parser.add_argument("--out-dir", default=None)
     parser.add_argument(
         "--out", default=None, metavar="DIR",
@@ -1013,6 +1381,40 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     wl = _named_workload(args.workload, args.virtual_secs, args.storm)
     shrink_kwargs = {"out_dir": args.out_dir} if args.out_dir else {}
+    if args.islands:
+        import jax
+
+        devs = jax.devices()
+        mesh = (
+            jax.sharding.Mesh(
+                np.array(devs[: args.islands]), ("islands",)
+            )
+            if len(devs) >= args.islands and args.islands > 1 else None
+        )
+        fed = Federation(
+            wl, n_islands=args.islands, meta_seed=args.meta_seed,
+            lanes=args.lanes, exchange_every=args.exchange_every,
+            mesh=mesh, refill_lanes=args.refill_lanes,
+            shrink_violations=not args.no_shrink,
+            max_shrinks=args.max_shrinks, shrink_kwargs=shrink_kwargs,
+            log=None if args.json else lambda m: print(m, flush=True),
+        )
+        rep = fed.run(args.dispatches)
+        if args.json:
+            print(json.dumps(rep), flush=True)
+        else:
+            print(
+                f"federation meta_seed={rep['meta_seed']}: "
+                f"{rep['n_islands']} islands x {rep['lanes']} lanes, "
+                f"{rep['generations']} generations "
+                f"(sharded={rep['sharded']})\n"
+                f"  coverage: {rep['coverage_bits']} union bits, "
+                f"violations: {rep['violations']}, "
+                f"exchanges: {len(rep['exchanges'])}\n"
+                f"  fingerprint: {rep['fingerprint']}",
+                flush=True,
+            )
+        return
     ex = Explorer(
         wl, meta_seed=args.meta_seed, lanes=args.lanes,
         chunk=args.chunk or None, shrink_violations=not args.no_shrink,

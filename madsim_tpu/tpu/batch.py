@@ -365,19 +365,25 @@ def run_batch(
     default — the bitmap costs a few percent of step time.
 
     `refill=<lanes>` runs the sweep CONTINUOUSLY BATCHED over that many
-    device lanes (docs/continuous_batching.md): a lane that finishes —
-    violates or reaches its horizon — retires and admits the next queued
-    seed inside the jitted loop, so heterogeneous-length seeds never
-    leave the chip idling on finished lanes. Each `chunk` of seeds is one
-    device-resident queue segment; the host tops the queue up between
-    segments through the same `pipelined` loop. Per-seed results are
-    BIT-IDENTICAL to the chunked path (tested): an admission's trajectory
-    is the pure per-seed function either way, and decode reads the
-    per-admission result rows in admission (= seed) order. Restrictions:
+    device lanes PER DEVICE (docs/continuous_batching.md +
+    docs/multichip.md): a lane that finishes — violates or reaches its
+    horizon — retires and admits the next queued seed inside the jitted
+    loop, so heterogeneous-length seeds never leave the chip idling on
+    finished lanes. Each `chunk` of seeds is one device-resident queue
+    segment; the host tops the queue up between segments through the
+    same `pipelined` loop. The mesh is HONORED (r10): with more than
+    one device (mesh="auto" or an explicit mesh) each chunk's seed list
+    is partitioned into one contiguous sub-queue per device and the
+    segment runs as ONE shard_map'd program — each device owns its
+    sub-queue, its `refill` lanes and its result buffers, with zero
+    cross-device collectives inside the step (gathers at segment end
+    only). Per-seed results are BIT-IDENTICAL to the chunked path AND
+    across device counts (tested): an admission's trajectory is the
+    pure per-seed function either way, and decode reads the
+    per-admission result rows in admission (= seed) order. Restriction:
     the refill path keeps no final node state per admission, so
     workloads with a `lane_check` deep oracle (and spec lane_metrics
-    diagnostics) must run chunked, and the sweep is single-device
-    (`mesh` ignored; the multi-chip farm shards whole queues, ROADMAP 1).
+    diagnostics) must run chunked.
     """
     seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
     if seeds_arr.ndim != 1 or seeds_arr.size == 0:
@@ -392,6 +398,7 @@ def run_batch(
     if refill:
         return _run_batch_refill(
             seeds_arr, workload, sim, int(refill), chunk=chunk,
+            mesh=resolve_mesh(mesh),
             pipeline=pipeline, coverage=coverage,
             check_determinism=check_determinism,
             repro_on_host=repro_on_host, max_host_repros=max_host_repros,
@@ -607,6 +614,7 @@ def _run_batch_refill(
     sim: BatchedSim,
     lanes: int,
     chunk: int,
+    mesh: Optional[Any],
     pipeline: bool,
     coverage: bool,
     check_determinism: bool,
@@ -618,30 +626,45 @@ def _run_batch_refill(
 ) -> BatchResult:
     """run_batch's continuously batched sweep: each `chunk` of seeds is
     one device-resident queue SEGMENT run by engine.run_refill over
-    `lanes` lanes; the host tops up the queue with the next segment
-    through the same double-buffered `pipelined` loop the chunked path
-    uses. Decode reads the per-admission result rows in admission (=
-    seed) order, so every per-seed output is bit-identical to the
-    chunked sweep's row for that seed."""
-    from .engine import refill_results, summarize_refill
+    `lanes` lanes — or, with a mesh, by engine.run_refill_sharded over
+    `lanes` lanes PER DEVICE with the chunk's seeds partitioned into
+    per-device sub-queues (docs/multichip.md) — while the host tops up
+    the queue with the next segment through the same double-buffered
+    `pipelined` loop the chunked path uses. Decode reads the
+    per-admission result rows in admission (= seed) order, so every
+    per-seed output is bit-identical to the chunked sweep's row for
+    that seed, whatever the mesh."""
+    from .engine import (
+        refill_results, refill_results_sharded, summarize_refill,
+    )
 
     if lanes < 1:
         raise ValueError(f"refill lane count must be >= 1, got {lanes}")
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
     res_parts: List[dict] = []
     totals: Dict[str, float] = {}
     weights: Dict[str, int] = {}
     occ_num = occ_den = 0
+    dev_busy = [0] * n_dev
+    dev_total = [0] * n_dev
     state: Optional[SimState] = None
     disp_before = sim.dispatch_count
     t_sweep = time.perf_counter()
 
+    def run_part(part: np.ndarray):
+        if mesh is not None:
+            return sim.run_refill_sharded(
+                part, lanes=lanes, mesh=mesh,
+                max_steps=workload.max_steps,
+            )
+        return sim.run_refill(
+            part, lanes=lanes, max_steps=workload.max_steps
+        )
+
     def dispatch(off: int):
         part = seeds_arr[off : off + chunk]
-        st = sim.run_refill(part, lanes=lanes, max_steps=workload.max_steps)
-        rerun = (
-            sim.run_refill(part, lanes=lanes, max_steps=workload.max_steps)
-            if check_determinism else None
-        )
+        st = run_part(part)
+        rerun = run_part(part) if check_determinism else None
         return off, part.size, st, rerun
 
     def decode(entry) -> None:
@@ -652,7 +675,13 @@ def _run_batch_refill(
                 st, rerun, f"seeds[{off}:{off + size}] (refill)"
             )
         state = st
-        res = refill_results(st)
+        if mesh is not None:
+            res = refill_results_sharded(st, admissions=size)
+            for d, row in enumerate(res["per_device"]):
+                dev_busy[d] += row["busy_lane_steps"]
+                dev_total[d] += row["total_lane_steps"]
+        else:
+            res = refill_results(st)
         res_parts.append(res)
         occ_num += res["busy_lane_steps"]
         occ_den += res["total_lane_steps"]
@@ -685,9 +714,14 @@ def _run_batch_refill(
     deadlocked = np.concatenate([r["deadlocked"] for r in res_parts])
     occupancy = occ_num / occ_den if occ_den else 1.0
     totals["violation_lanes"] = np.nonzero(violated)[0].tolist()[:32]
-    totals["n_devices"] = 1
+    totals["n_devices"] = n_dev
     totals["occupancy"] = round(occupancy, 4)
     totals["refill_lanes"] = lanes
+    if mesh is not None:
+        totals["per_device_occupancy"] = [
+            round(dev_busy[d] / max(dev_total[d], 1), 4)
+            for d in range(n_dev)
+        ]
     from .nemesis import coverage_report, enabled_fire_kinds
 
     if enabled_fire_kinds(sim.config):

@@ -65,7 +65,7 @@ batch, any chunk, any mesh sharding.
 from __future__ import annotations
 
 import functools
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1123,6 +1123,10 @@ class BatchedSim:
         # dispatched BEFORE the next segment so reading it never leaves
         # the device idle for a host round-trip (see run())
         self._any_alive = jax.jit(lambda s: jnp.any(~s.done))
+        # per-(mesh, segment-length) compiled shard_map'd refill segment
+        # programs (see _sharded_segment): at most two lengths compile
+        # per mesh (chunk + tail), exactly like the unsharded run_state
+        self._sharded_cache: Dict[Tuple[Any, int], Any] = {}
         # device program launches made by this sim's run paths (init +
         # sweep segments + early-stop reductions + sharding device_put).
         # run_batch snapshots the counter around a sweep to fill
@@ -2679,19 +2683,24 @@ class BatchedSim:
             else self.init(seeds[:L], head_ctl)
         )
         self.dispatch_count += 1
+        # jnp.array (COPY), never asarray: the queue rides the donated
+        # sweep carry, so an aliased caller array would be DELETED by the
+        # first segment's donation — a caller must be able to reuse its
+        # seed/ctl arrays (e.g. to run the same queue sharded and
+        # unsharded for a bit-identity check)
         queue = RefillQueue(
-            seeds=seeds,
-            off=None if ctl is None else jnp.asarray(ctl.off, jnp.int32),
-            occ=None if ctl is None else jnp.asarray(ctl.occ, jnp.int32),
+            seeds=jnp.array(seeds, jnp.uint32),
+            off=None if ctl is None else jnp.array(ctl.off, jnp.int32),
+            occ=None if ctl is None else jnp.array(ctl.occ, jnp.int32),
             rate_scale=(
                 None if ctl is None
-                else jnp.asarray(ctl.rate_scale, jnp.float32)
+                else jnp.array(ctl.rate_scale, jnp.float32)
             ),
             h_epoch=(
-                None if ctl is None else jnp.asarray(ctl.h_epoch, jnp.int32)
+                None if ctl is None else jnp.array(ctl.h_epoch, jnp.int32)
             ),
             h_off=(
-                None if ctl is None else jnp.asarray(ctl.h_off, jnp.int32)
+                None if ctl is None else jnp.array(ctl.h_off, jnp.int32)
             ),
         )
         zi = functools.partial(jnp.zeros, dtype=jnp.int32)
@@ -2755,6 +2764,166 @@ class BatchedSim:
         if total_steps is None:
             total_steps = int(max_steps) * A
         return self.run_state(state, total_steps, dispatch_steps)
+
+    # --------------------------------------------------- sharded refill
+
+    def init_refill_sharded(
+        self, seeds, lanes: int, mesh: jax.sharding.Mesh, ctl=None,
+        step_cap: int = 100_000,
+    ) -> SimState:
+        """Build the MULTI-CHIP refill state: the admission list is
+        partitioned into one contiguous, equal-length sub-queue per mesh
+        device (tail-padded with repeats of the first seed; the pad rows
+        run normally and are stripped by `refill_results_sharded`), each
+        device gets its own `lanes`-lane engine plus its own RefillLog
+        result buffers and cursor, and every state leaf gains a leading
+        device axis [D, ...] sharded one row per device.
+
+        Device d's block IS the single-device refill state of sub-queue
+        d — same shapes, same init draws — which is what makes the
+        sharded sweep's per-admission rows bit-identical to the 1-device
+        refill path (and hence to the chunked path) by construction:
+        concatenating per-device rows in device order restores global
+        admission (= seed) order."""
+        import numpy as np
+
+        seeds = np.asarray(seeds, np.uint32)
+        if seeds.ndim != 1 or seeds.shape[0] == 0:
+            raise ValueError(
+                "init_refill_sharded needs a non-empty 1-D seed array"
+            )
+        D = int(mesh.devices.size)
+        A = int(seeds.shape[0])
+        Ad = -(-A // D)  # per-device sub-queue length (ceil)
+        pad = Ad * D - A
+        if pad:
+            seeds_in = np.concatenate([seeds, np.repeat(seeds[:1], pad)])
+        else:
+            seeds_in = seeds
+        ctl_in = ctl
+        if ctl is not None and pad:
+            ctl_in = jax.tree_util.tree_map(
+                lambda x: jnp.concatenate(
+                    [jnp.asarray(x), jnp.repeat(
+                        jnp.asarray(x)[:1], pad, axis=0
+                    )]
+                ),
+                ctl,
+            )
+        states = []
+        for d in range(D):
+            sub = seeds_in[d * Ad : (d + 1) * Ad]
+            sub_ctl = (
+                None if ctl_in is None
+                else jax.tree_util.tree_map(
+                    lambda x: x[d * Ad : (d + 1) * Ad], ctl_in
+                )
+            )
+            states.append(
+                self.init_refill(sub, lanes, sub_ctl, step_cap=step_cap)
+            )
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *states
+        )
+        sh = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(mesh.axis_names[0])
+        )
+        # ONE device_put over the whole pytree (see shard_state)
+        stacked = jax.device_put(
+            stacked, jax.tree_util.tree_map(lambda _: sh, stacked)
+        )
+        self.dispatch_count += 1
+        return stacked
+
+    def _sharded_segment(self, mesh: jax.sharding.Mesh, n_steps: int):
+        """The compiled multi-chip sweep segment: shard_map over the
+        leading device axis, each device running the REAL per-device
+        refill segment — `split_state`, the donated while_loop over
+        `_step_split` (its `lax.cond` retire-and-admit branch stays a
+        real cond, not a vmap-degraded select), per-device early exit
+        when the device's own queue drains. ZERO cross-device
+        collectives inside the step or the segment: devices touch only
+        their own sub-queue, lanes, and result buffers; the harvest /
+        early-stop gathers happen at segment end only, on the host side
+        (run_state_sharded / refill_results_sharded). The analysis
+        lane-independence rule walks this exact program and allowlists
+        collectives by exact primitive name (none in-tree)."""
+        key = (mesh, int(n_steps))
+        fn = self._sharded_cache.get(key)
+        if fn is not None:
+            return fn
+        from jax.experimental.shard_map import shard_map
+
+        spec = jax.sharding.PartitionSpec(mesh.axis_names[0])
+
+        def seg(stacked: SimState) -> SimState:
+            # each device sees its [1, ...] block: strip the device axis,
+            # run the ordinary refill segment, put the axis back
+            st = jax.tree_util.tree_map(lambda x: x[0], stacked)
+            hot, cold, const = split_state(st)
+
+            def cond(carry):
+                h, _c, i = carry
+                return jnp.logical_and(i < n_steps, jnp.any(~h.done))
+
+            def body(carry):
+                h, c, i = carry
+                h2, c2, _ = self._step_split(h, c, const)
+                return h2, c2, i + 1
+
+            h, c, _ = jax.lax.while_loop(
+                cond, body, (hot, cold, jnp.int32(0))
+            )
+            out = merge_state(h, c, const)
+            return jax.tree_util.tree_map(lambda x: x[None], out)
+
+        fn = jax.jit(
+            shard_map(
+                seg, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                check_rep=False,
+            ),
+            donate_argnums=(0,),
+        )
+        self._sharded_cache[key] = fn
+        return fn
+
+    def run_state_sharded(
+        self, state: SimState, mesh: jax.sharding.Mesh, max_steps: int,
+        dispatch_steps: int = 10_000,
+    ) -> SimState:
+        """run_state's segment loop over the shard_map'd segment program:
+        same speculative early-stop (the all-done reduction over the
+        sharded `done` plane is the one cross-device gather, dispatched
+        at segment boundaries only), same donation discipline — ONE
+        loop, parameterized by the segment runner."""
+        return self.run_state(
+            state, max_steps, dispatch_steps,
+            segment=lambda st, n: self._sharded_segment(mesh, n)(st),
+        )
+
+    def run_refill_sharded(
+        self, seeds, lanes: int, mesh: jax.sharding.Mesh,
+        max_steps: int = 100_000, dispatch_steps: int = 10_000, ctl=None,
+        total_steps: Optional[int] = None,
+    ) -> SimState:
+        """The multi-chip continuously batched sweep: ALL `seeds` run as
+        admissions of D independent per-device refill engines (`lanes`
+        lanes EACH), one shard_map'd program per segment. Decode with
+        `refill_results_sharded(state, admissions=len(seeds))`.
+
+        `max_steps` keeps the per-admission chunked-truncation semantics
+        of run_refill; `total_steps` bounds each DEVICE's segment-loop
+        iterations (default max_steps * per-device queue length — never
+        binding). Per-admission rows are bit-identical to run_refill's
+        and to the chunked path's for any fixed admission order (the
+        multichip matrix tests pin this)."""
+        state = self.init_refill_sharded(
+            seeds, lanes, mesh, ctl, step_cap=max_steps
+        )
+        Ad = int(state.queue.seeds.shape[1])
+        if total_steps is None:
+            total_steps = int(max_steps) * Ad
+        return self.run_state_sharded(state, mesh, total_steps, dispatch_steps)
 
     # ------------------------------------------------------------------ run
 
@@ -2841,14 +3010,19 @@ class BatchedSim:
 
     def run_state(
         self, state: SimState, max_steps: int, dispatch_steps: int = 10_000,
+        segment=None,
     ) -> SimState:
         """run()'s chunked segment loop on a PRE-BUILT state (the shared
-        tail of run / run_refill): speculative early-stop, donated
-        segments, dispatch accounting — see run()'s docstring."""
+        tail of run / run_refill / run_refill_sharded): speculative
+        early-stop, donated segments, dispatch accounting — see run()'s
+        docstring. `segment(state, n)` overrides the donated `_run`
+        program (run_state_sharded passes the shard_map'd segment), so
+        the loop logic exists exactly once."""
         if dispatch_steps <= 0:
             raise ValueError(
                 f"dispatch_steps must be positive, got {dispatch_steps}"
             )
+        run_segment = segment or (lambda st, n: self._run(st, n))
         remaining = max_steps
         alive = None
         while remaining > 0:
@@ -2860,10 +3034,10 @@ class BatchedSim:
                 alive = self._any_alive(state)
                 self.dispatch_count += 1
             n = min(dispatch_steps, remaining)
-            # _run DONATES state: the rebinding here is what makes that
-            # legal — the pre-segment buffers are dead the moment the
-            # segment is dispatched
-            state = self._run(state, n)
+            # the segment DONATES state: the rebinding here is what makes
+            # that legal — the pre-segment buffers are dead the moment
+            # the segment is dispatched
+            state = run_segment(state, n)
             self.dispatch_count += 1
             remaining -= n
             # block on the reduction only AFTER the next segment is in
@@ -3136,6 +3310,11 @@ def refill_results(state: SimState) -> dict:
     rf = state.refill
     if rf is None:
         raise ValueError("refill_results needs a run_refill final state")
+    if np.asarray(state.queue.seeds).ndim != 1:
+        raise ValueError(
+            "state has a leading device axis (run_refill_sharded) — "
+            "decode it with refill_results_sharded"
+        )
     # np.array (COPY), not np.asarray: the jax-array views are read-only
     # and the final-harvest loop below writes rows in place
     out = {
@@ -3183,6 +3362,84 @@ def refill_results(state: SimState) -> dict:
     out["total_lane_steps"] = iters * L
     out["occupancy"] = busy / max(iters * L, 1)
     out["truncated"] = int(live.sum())
+    return out
+
+
+def refill_results_sharded(
+    state: SimState, admissions: Optional[int] = None,
+) -> dict:
+    """Decode a finished SHARDED refill sweep (run_refill_sharded) into
+    the same per-admission rows `refill_results` produces, in global
+    admission (= seed) order: device d's rows are sub-queue d's rows,
+    concatenated in device order and stripped of the tail pad
+    (`admissions` = the original un-padded seed count).
+
+    This is the segment-end gather the multi-chip determinism contract
+    allows: the step itself never crosses devices, so each device's rows
+    are bit-identical to a 1-device refill of its sub-queue, and the
+    concatenation is bit-identical to the 1-device refill (and chunked)
+    rows of the whole list. Occupancy comes back both aggregate and
+    per-device (`per_device`): each device's busy-lane-steps over its
+    OWN iteration count — the per-chip utilization the mesh_scaling
+    bench and the multichip smoke assert on. `lane_steps_per_iter` is
+    the aggregate busy-lane-step throughput per sweep iteration
+    (busy total / max device iters): the hardware-independent scaling
+    number (1 device caps at L; D devices at D * L)."""
+    import numpy as np
+
+    if state.refill is None or state.queue is None:
+        raise ValueError(
+            "refill_results_sharded needs a run_refill_sharded final state"
+        )
+    lead = np.asarray(state.queue.seeds).ndim
+    if lead != 2:
+        raise ValueError(
+            "state has no leading device axis — use refill_results for "
+            "single-device refill sweeps"
+        )
+    D = int(np.asarray(state.queue.seeds).shape[0])
+    per = [
+        refill_results(jax.tree_util.tree_map(lambda x, _d=d: x[_d], state))
+        for d in range(D)
+    ]
+    row_fields = [
+        "retired", "violated", "deadlocked", "violation_at",
+        "violation_epoch", "violation_step", "steps", "events",
+        "overflow", "dead_drops", "clock", "epoch", "fires",
+        "occ_fired", "cov_bitmap", "cov_hiwater", "cov_transitions",
+    ]
+    out: dict = {}
+    for f in row_fields:
+        if per[0][f] is None:
+            out[f] = None
+            continue
+        rows = np.concatenate([p[f] for p in per])
+        out[f] = rows if admissions is None else rows[:admissions]
+    A = int(out["violated"].shape[0])
+    iters = [p["iters"] for p in per]
+    busy = [p["busy_lane_steps"] for p in per]
+    total = [p["total_lane_steps"] for p in per]
+    out["admissions"] = A
+    out["lanes"] = per[0]["lanes"]
+    out["devices"] = D
+    out["iters"] = max(iters)
+    out["busy_lane_steps"] = sum(busy)
+    out["total_lane_steps"] = sum(total)
+    out["occupancy"] = sum(busy) / max(sum(total), 1)
+    # count truncated admissions from the STRIPPED rows (a truncated
+    # admission never got its retirement scatter, so its `retired` row
+    # is still -1) — the per-device counts include tail-pad duplicates
+    out["truncated"] = int((out["retired"] == -1).sum())
+    out["per_device"] = [
+        {
+            "iters": iters[d],
+            "busy_lane_steps": busy[d],
+            "total_lane_steps": total[d],
+            "occupancy": busy[d] / max(total[d], 1),
+        }
+        for d in range(D)
+    ]
+    out["lane_steps_per_iter"] = sum(busy) / max(max(iters), 1)
     return out
 
 

@@ -92,6 +92,45 @@ def leaky_refill(key, key0, done, qseeds, cursor):
     return new_key, new_key0, victim
 
 
+# ------------------------------------------------- sharded collectives
+
+def clean_sharded_segment(mesh):
+    """The legal multi-chip refill shape: each device steps its own
+    block, no cross-device primitive anywhere (engine._sharded_segment's
+    contract, docs/multichip.md)."""
+    from jax.experimental.shard_map import shard_map
+
+    P = jax.sharding.PartitionSpec
+
+    def seg(x):
+        return x * 2 + 1
+
+    return shard_map(
+        seg, mesh=mesh, in_specs=(P(mesh.axis_names[0]),),
+        out_specs=P(mesh.axis_names[0]), check_rep=False,
+    )
+
+
+def leaky_sharded_segment(mesh):
+    """The planted multi-chip leak: a psum inside the sharded segment —
+    every device's step now depends on every other device's state, so
+    per-device rows stop being the pure per-seed function the mesh
+    bit-identity contract requires. The lane-independence rule's
+    collective walk must flag it by exact primitive name."""
+    from jax.experimental.shard_map import shard_map
+
+    P = jax.sharding.PartitionSpec
+    axis = mesh.axis_names[0]
+
+    def seg(x):
+        return x + jax.lax.psum(x.sum(), axis)
+
+    return shard_map(
+        seg, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis),
+        check_rep=False,
+    )
+
+
 # ----------------------------------------------------------------- dtype
 
 def time_f32_step(timer):

@@ -169,6 +169,45 @@ def test_rng_taint_passes_clean_refill():
     assert res.checked > 0
 
 
+def _toy_mesh():
+    import numpy as np
+
+    return jax.sharding.Mesh(np.array(jax.devices()[:2]), ("devices",))
+
+
+def test_collective_walk_fires_on_planted_psum():
+    """The planted multi-chip leak: a psum inside the shard_map'd
+    segment couples every device's rows to every other's — the
+    lane-independence rule's collective walk must name the exact
+    primitive."""
+    from madsim_tpu.analysis.jaxpr_check import check_collectives
+
+    mesh = _toy_mesh()
+    x = jax.ShapeDtypeStruct((2, 4), jnp.int32)
+    closed = jax.make_jaxpr(toys.leaky_sharded_segment(mesh))(x)
+    res = check_collectives(closed, "toy")
+    assert not res.ok
+    assert any("psum" in v.detail for v in res.violations)
+    assert res.rule == "lane-independence"
+
+
+def test_collective_walk_passes_clean_sharded_segment():
+    """The legal twin: per-device compute only — zero collectives. An
+    exact-primitive allowlist entry (never wholesale) would also pass
+    the planted psum, pinned here so the allowlist stays exact."""
+    from madsim_tpu.analysis.jaxpr_check import check_collectives
+
+    mesh = _toy_mesh()
+    x = jax.ShapeDtypeStruct((2, 4), jnp.int32)
+    closed = jax.make_jaxpr(toys.clean_sharded_segment(mesh))(x)
+    res = check_collectives(closed, "toy")
+    assert res.ok, [v.render() for v in res.violations]
+    assert res.checked > 0
+    leaky = jax.make_jaxpr(toys.leaky_sharded_segment(mesh))(x)
+    allowed = check_collectives(leaky, "toy", allow=("psum",))
+    assert allowed.ok  # exact-name allowlist is honored, nothing broader
+
+
 # --------------------------------------------------------------- rule: dtype
 
 

@@ -244,3 +244,68 @@ def test_simconfig_validation_fails_loudly():
         BatchedSim(spec, SimConfig(horizon_us=0))
     with pytest.raises(ValueError, match="msg_depth"):
         BatchedSim(spec, SimConfig(msg_depth_msg=0))
+
+
+@pytest.mark.chaos
+def test_planted_bug_found_and_harvested_on_owning_device(tmp_path):
+    """VERDICT weak item (r10): a planted-bug seed (the raft deposed-
+    leader re-stamp config) through the 8-device virtual mesh — the
+    violation FIRES on the sharded refill sweep, the lane is harvested
+    into the OWNING device's own RefillLog result buffers (the device
+    whose sub-queue holds the admission), and the shrunk ReproBundle
+    replays bit-identically on a single device."""
+    from madsim_tpu import triage
+    from madsim_tpu.repro import replay_device
+    from madsim_tpu.tpu.engine import (
+        BatchedSim,
+        refill_results,
+        refill_results_sharded,
+    )
+
+    from test_refill import _restamp_workload
+
+    wl = _restamp_workload()
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("seeds",))
+    sim = BatchedSim(wl.spec, wl.config, triage=True)
+    A, L = 32, 2
+    seeds = np.arange(A, dtype=np.uint32)
+    st = sim.run_refill_sharded(
+        seeds, lanes=L, mesh=mesh, max_steps=wl.max_steps
+    )
+    res = refill_results_sharded(st, admissions=A)
+    assert res["violated"].any(), "planted re-stamp bug must fire"
+    a = int(np.nonzero(res["violated"])[0][0])
+
+    # the admission was harvested on its OWNING device: sub-queues are
+    # contiguous, so admission a lives on device a // Ad, and THAT
+    # device's own RefillLog row (local index a % Ad) holds the harvest
+    Ad = int(np.asarray(st.queue.seeds).shape[1])
+    d = a // Ad
+    dev_state = jax.tree_util.tree_map(lambda x: x[d], st)
+    dev_rows = refill_results(dev_state)
+    local = a - d * Ad
+    assert bool(dev_rows["violated"][local])
+    assert dev_rows["violation_step"][local] == res["violation_step"][a]
+    assert int(np.asarray(st.queue.seeds)[d, local]) == a
+
+    # ...and the per-admission row equals the unsharded refill row
+    ref = refill_results(
+        sim.run_refill(seeds, lanes=L, max_steps=wl.max_steps)
+    )
+    assert bool(ref["violated"][a])
+    assert ref["violation_step"][a] == res["violation_step"][a]
+
+    # shrink the violating seed into a ReproBundle and replay it
+    # SINGLE-device: the violation must fire at the recorded step,
+    # bit-identically across repeats (replay_device raises otherwise)
+    sr = triage.shrink_seed(
+        wl, a, sim=sim, out_dir=str(tmp_path), mesh=mesh,
+    )
+    assert sr.bundle.seed == a
+    # (the shrunk plan's violation step is the MINIMAL plan's, not the
+    # full plan's — replay_device asserts the bundle's own recorded
+    # step/time fire bit-identically across repeats)
+    report = replay_device(
+        sr.bundle, spec=wl.spec, repeats=2, out=lambda *_: None,
+    )
+    assert report["violated"] and report["step"] == sr.bundle.violation_step

@@ -1,0 +1,452 @@
+"""Multi-chip fuzz fleet (r10, docs/multichip.md): shard_map'd refill
+sweeps, the island-model explorer federation, and the device-aware
+campaign farm.
+
+The contract under test at every layer is the one the single-chip refill
+engine already pinned (r9), lifted to the mesh: per-admission results
+are a pure function of (admission order, seeds) — BIT-IDENTICAL across
+device counts (1-device refill, 8-device shard_map'd refill, and the
+chunked path all agree row-for-row), with zero cross-device collectives
+inside the step (gathers at segment end only; `make analyze` walks the
+sharded segment program for collective primitives). On top of that:
+per-device occupancy >= 0.9 and >= 6x aggregate lane-step scaling at 8
+devices on the 10x horizon-spread mix, the federation fingerprint
+pinned across device counts and kill/resume, ddmin bundles identical
+with and without a mesh, and `campaign serve` draining >= 3 concurrent
+campaigns across devices with per-campaign bit-identical resume.
+
+The fast (`chaos and not slow`) subset here IS the CI multichip smoke
+(`make multichip-smoke`, <60s warm on the virtual 8-device mesh the
+suite conftest forces).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import nemesis
+from madsim_tpu.tpu import make_raft_spec
+from madsim_tpu.tpu import nemesis as tpu_nemesis
+from madsim_tpu.tpu.batch import BatchWorkload, run_batch
+from madsim_tpu.tpu.engine import (
+    BatchedSim,
+    TriageCtl,
+    refill_results,
+    refill_results_sharded,
+)
+from madsim_tpu.tpu.spec import REBASE_US, SimConfig
+
+pytestmark = pytest.mark.chaos
+
+PLAN = nemesis.FaultPlan(
+    name="multichip-tests",
+    clauses=(
+        nemesis.Crash(interval_lo_us=150_000, interval_hi_us=450_000,
+                      down_lo_us=100_000, down_hi_us=300_000),
+        nemesis.Partition(interval_lo_us=200_000, interval_hi_us=600_000,
+                          heal_lo_us=150_000, heal_hi_us=450_000),
+        nemesis.MsgLoss(rate=0.05),
+    ),
+)
+HORIZON = 1_000_000
+CFG = tpu_nemesis.compile_plan(PLAN, SimConfig(horizon_us=HORIZON))
+
+# the per-admission rows the cross-device determinism contract covers
+# (`retired` is scheduling metadata — the global sweep step at
+# retirement legitimately differs between queue partitionings, exactly
+# as it differs between the refill and chunked paths)
+ROW_FIELDS = (
+    "violated", "deadlocked", "violation_at", "violation_epoch",
+    "violation_step", "steps", "events", "overflow", "dead_drops",
+    "clock", "epoch", "fires", "occ_fired",
+)
+
+
+def _mesh(n: int):
+    devs = jax.devices()
+    assert len(devs) >= n, "suite conftest forces an 8-device CPU mesh"
+    return jax.sharding.Mesh(np.array(devs[:n]), ("seeds",))
+
+
+@pytest.fixture(scope="module")
+def tsim():
+    return BatchedSim(make_raft_spec(), CFG, triage=True, coverage=True)
+
+
+def _spread_ctl(A: int, spread: int = 10, long_every: int = 4):
+    h = np.where(
+        np.arange(A) % long_every == 0, HORIZON, HORIZON // spread
+    ).astype(np.int64)
+    return TriageCtl(
+        off=jnp.zeros((A,), jnp.int32),
+        occ=jnp.zeros((A, 4), jnp.int32),
+        rate_scale=jnp.ones((A, 3), jnp.float32),
+        h_epoch=jnp.asarray((h // REBASE_US).astype(np.int32)),
+        h_off=jnp.asarray((h % REBASE_US).astype(np.int32)),
+    )
+
+
+# ------------------------------------------------- engine bit-identity
+
+
+def test_sharded_refill_rows_bit_identical_across_device_counts(tsim):
+    """The matrix row the whole fleet rests on: the SAME admissions
+    (triage ctl genomes with a 10x horizon spread, coverage on) through
+    the 1-device refill engine and the 2- and 8-device shard_map'd
+    engines produce bit-identical per-admission rows — seeds,
+    violations, chaos fire/occurrence tensors, coverage bitmaps, and
+    the admission-relative step rows all equal."""
+    A, L = 40, 2
+    seeds = np.arange(A, dtype=np.uint32)
+    ctl = _spread_ctl(A)
+    ref = refill_results(
+        tsim.run_refill(seeds, lanes=L, max_steps=30_000, ctl=ctl)
+    )
+    for D in (2, 8):
+        st = tsim.run_refill_sharded(
+            seeds, lanes=L, mesh=_mesh(D), max_steps=30_000, ctl=ctl
+        )
+        res = refill_results_sharded(st, admissions=A)
+        assert res["devices"] == D
+        assert res["truncated"] == 0
+        for f in ROW_FIELDS + ("cov_bitmap", "cov_hiwater",
+                               "cov_transitions"):
+            if ref[f] is None:
+                continue
+            np.testing.assert_array_equal(
+                ref[f], res[f], err_msg=f"{D}-device row {f} != 1-device"
+            )
+        # every device really worked and harvested its own sub-queue
+        assert len(res["per_device"]) == D
+        assert all(p["busy_lane_steps"] > 0 for p in res["per_device"])
+
+
+def test_run_batch_refill_explicit_mesh_honored(tsim):
+    """REGRESSION (the r9 gap this PR closes): run_batch(refill=...,
+    mesh=<explicit mesh>) used to drop the mesh silently. It must now
+    be HONORED — the summary reports the mesh's device count and
+    per-device occupancy, and every per-seed output equals the
+    unsharded refill sweep's."""
+    wl = BatchWorkload(spec=make_raft_spec(), config=CFG, max_steps=30_000)
+    r1 = run_batch(range(24), wl, mesh=None, max_traces=0, refill=2,
+                   coverage=True)
+    r8 = run_batch(range(24), wl, mesh=_mesh(8), max_traces=0, refill=2,
+                   coverage=True)
+    assert r8.summary["n_devices"] == 8
+    assert len(r8.summary["per_device_occupancy"]) == 8
+    np.testing.assert_array_equal(r1.violated, r8.violated)
+    np.testing.assert_array_equal(r1.violation_step, r8.violation_step)
+    np.testing.assert_array_equal(r1.coverage.bitmap, r8.coverage.bitmap)
+    for k in ("violations", "total_events", "coverage_bits",
+              "fires_crash", "fires_partition", "fires_loss",
+              "mean_steps"):
+        assert r1.summary[k] == r8.summary[k], k
+
+
+def test_sharded_refill_occupancy_and_scaling_bars():
+    """The fleet's two headline numbers on the 10x horizon-spread mix
+    (the CI smoke assertions): per-device occupancy >= 0.9 on EVERY
+    device of the 8-device mesh, and aggregate lane-step throughput per
+    sweep iteration >= 6x the 1-device number at equal per-device
+    lanes (near-linear scaling, hardware-independent form)."""
+    import sys
+
+    bench_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benches",
+    )
+    sys.path.insert(0, bench_dir)
+    try:
+        import roofline as rl
+    finally:
+        sys.path.remove(bench_dir)
+    out = rl.mesh_scaling(
+        lanes=8, waves=32, virtual_secs=0.5, device_counts=(1, 8),
+    )
+    rows = {r["devices"]: r for r in out["rows"]}
+    assert set(rows) == {1, 8}
+    for occ in rows[8]["per_device_occupancy"]:
+        assert occ >= 0.90, rows[8]
+    assert rows[8]["scaling_vs_1dev"] >= 6.0, rows[8]
+
+
+def test_sharded_truncated_count_excludes_tail_pad(tsim):
+    """A seed count not divisible by the device count pads the last
+    sub-queue with duplicates of admission 0; when the whole-sweep step
+    budget bites, the aggregate `truncated` count must cover the
+    STRIPPED admissions only (it is recomputed from the stripped
+    `retired == -1` rows), never the pad duplicates."""
+    A = 9  # D=8, Ad=2 -> 7 pad rows, all duplicates of admission 0
+    seeds = np.arange(A, dtype=np.uint32)
+    st = tsim.run_refill_sharded(
+        seeds, lanes=1, mesh=_mesh(8), max_steps=30_000,
+        ctl=_spread_ctl(A), total_steps=50,
+    )
+    res = refill_results_sharded(st, admissions=A)
+    assert res["truncated"] == int((res["retired"] == -1).sum())
+    assert res["truncated"] <= A, res["truncated"]
+    assert res["truncated"] > 0  # the budget really bit mid-admission
+
+
+def test_sharded_state_refused_by_plain_decoder(tsim):
+    """Mis-pairing the decoders fails LOUDLY in both directions: the
+    plain refill_results refuses a device-stacked state (it would
+    fancy-index the device axis into garbage), and refill_results_sharded
+    refuses a 1-device state."""
+    seeds = np.arange(8, dtype=np.uint32)
+    st8 = tsim.run_refill_sharded(
+        seeds, lanes=2, mesh=_mesh(8), max_steps=2_000,
+        ctl=_spread_ctl(8),
+    )
+    with pytest.raises(ValueError, match="refill_results_sharded"):
+        refill_results(st8)
+    st1 = tsim.run_refill(
+        seeds, lanes=2, max_steps=2_000, ctl=_spread_ctl(8)
+    )
+    with pytest.raises(ValueError, match="leading device axis"):
+        refill_results_sharded(st1)
+
+
+# ------------------------------------------------------ triage / ddmin
+
+
+def test_triage_chunked_shrink_refuses_mesh(tsim):
+    """An explicitly-passed mesh is honored or refused loudly, never
+    dropped (the r9 run_batch bug class): the chunked ddmin evaluator
+    has no sharded form, so refill=False + mesh raises."""
+    from madsim_tpu import triage
+
+    wl = BatchWorkload(spec=make_raft_spec(), config=CFG, max_steps=1_000)
+    sim = BatchedSim(make_raft_spec(), CFG, triage=True)
+    with pytest.raises(ValueError, match="refill"):
+        triage.shrink_seed(wl, 0, sim=sim, refill=False, mesh=_mesh(2))
+
+
+def test_triage_shrink_bundle_identical_with_mesh(tsim):
+    """ddmin generations ride the sharded path: a shrink whose refill
+    generations run shard_map'd over the mesh produces the same minimal
+    bundle (kept atoms, masks, bisected horizon, violation step) as the
+    single-device shrink — verdicts are pure per-(seed, ctl) rows on
+    any device."""
+    from madsim_tpu import triage
+
+    from test_refill import _restamp_workload
+
+    wl = _restamp_workload()
+    sim = BatchedSim(wl.spec, wl.config, triage=True)
+    a = triage.shrink_seed(wl, 0, sim=sim, mesh=_mesh(8))
+    b = triage.shrink_seed(wl, 0, sim=sim)
+    assert a.kept_atoms == b.kept_atoms
+    assert a.bundle.occ_off == b.bundle.occ_off
+    assert a.bundle.violation_step == b.bundle.violation_step
+    assert a.bundle.horizon_us == b.bundle.horizon_us
+
+
+# --------------------------------------------------- island federation
+
+
+def test_federation_fingerprint_pinned_across_device_counts(tsim):
+    """The island-model federation is a pure function of one meta-seed:
+    the SAME 4-island search run (a) as one shard_map'd dispatch per
+    generation on a 4-device mesh, (b) island-by-island on the default
+    device, fingerprints identically — device placement never touches
+    the search."""
+    from madsim_tpu.explore import Federation
+
+    wl = BatchWorkload(spec=make_raft_spec(), config=CFG, max_steps=30_000)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("islands",))
+    ra = Federation(
+        wl, n_islands=4, meta_seed=7, lanes=8, exchange_every=2,
+        mesh=mesh, sim=tsim,
+    ).run(4)
+    rb = Federation(
+        wl, n_islands=4, meta_seed=7, lanes=8, exchange_every=2,
+        mesh=None, sim=tsim,
+    ).run(4)
+    assert ra["sharded"] and not rb["sharded"]
+    assert ra["fingerprint"] == rb["fingerprint"]
+    # the exchange really ran and preserved the union (campaign.minimize
+    # raises on any dropped bit; reaching here means it held)
+    assert ra["exchanges"] and ra["exchanges"] == rb["exchanges"]
+    # islands draw disjoint fresh-seed sub-queues (stride = n_islands)
+    from madsim_tpu.explore import Explorer
+
+    ex = Explorer(wl, meta_seed=1, lanes=4, first_seed=2, fresh_stride=4,
+                  shrink_violations=False, sim=tsim)
+    pop = ex._population(0)
+    assert [c.seed for c in pop] == [2, 6, 10, 14]
+
+
+def test_federation_kill_resume_bit_identical(tsim):
+    """snapshot()/restore() across a JSON round trip: 2 + 2 generations
+    with a kill at the boundary fingerprint identically to the
+    uninterrupted 4-generation federation (per-island MetaRng counter
+    cursors + the exchange log are the whole state)."""
+    from madsim_tpu.explore import Federation
+
+    wl = BatchWorkload(spec=make_raft_spec(), config=CFG, max_steps=30_000)
+
+    def fed():
+        return Federation(
+            wl, n_islands=4, meta_seed=7, lanes=8, exchange_every=2,
+            mesh=None, sim=tsim,
+        )
+
+    full = fed().run(4)["fingerprint"]
+    fa = fed()
+    fa.run(2)
+    snap = json.loads(json.dumps(fa.snapshot()))
+    fb = fed()
+    fb.restore(snap)
+    assert fb.run(2)["fingerprint"] == full
+
+
+@pytest.mark.slow
+def test_federation_coverage_dominates_single_island(tsim):
+    """The federation bar: at EQUAL total lane budget, the 8-island
+    federated coverage curve dominates (or ties) the 1-chip curve —
+    the exchange merges what eight independent searches found, and
+    minimize's asserted union invariant guarantees no merged bit is
+    ever lost."""
+    from madsim_tpu.explore import Explorer, Federation
+
+    wl = BatchWorkload(spec=make_raft_spec(), config=CFG, max_steps=30_000)
+    gens = 4
+    fed = Federation(
+        wl, n_islands=8, meta_seed=5, lanes=8, exchange_every=2,
+        mesh=None, sim=tsim,
+    )
+    fed_bits = fed.run(gens)["coverage_bits"]
+    single = Explorer(
+        wl, meta_seed=5, lanes=64, shrink_violations=False, sim=tsim,
+    ).run(gens)
+    assert fed_bits >= single.coverage_bits, (
+        fed_bits, single.coverage_bits,
+    )
+
+
+# ------------------------------------------------------- campaign farm
+
+
+def test_serve_schedules_campaigns_across_devices_stub(tmp_path):
+    """Device-aware time-slicing without touching a real device: three
+    queued campaigns on a 4-device service land on three DIFFERENT
+    devices (least-loaded placement), a request's "devices" pin is
+    honored, an out-of-range pin is rejected loudly, and every slice
+    line carries its device index."""
+    from madsim_tpu import campaign
+    from madsim_tpu.explore import ExploreReport
+
+    d = str(tmp_path / "svc")
+    os.makedirs(os.path.join(d, "queue"))
+
+    class Stub:
+        def __init__(self, cid):
+            self.cid, self.generation, self.bugs = cid, 0, []
+
+        def run(self, g):
+            self.generation += g
+            return ExploreReport(
+                meta_seed=0, lanes=1, dispatches=1, coverage_curve=[1],
+                corpus_curve=[1], violation_curve=[0], violations=[],
+                coverage_bits=1, corpus_size=1, seeds_run=1,
+                first_violation_dispatch=None, wall_s=0.0,
+                device_dispatches=2, corpus_digest="00" * 32,
+            )
+
+        def checkpoint(self):
+            os.makedirs(
+                os.path.join(d, "campaigns", self.cid), exist_ok=True
+            )
+
+    def factory(request, campaign_dir, regression_dir, log):
+        return Stub(request["id"])
+
+    reqs = {
+        "a": {"workload": "raft", "generations": 2},
+        "b": {"workload": "raft", "generations": 2, "devices": [1]},
+        "c": {"workload": "raft", "generations": 2, "devices": [2, 3]},
+        "bad": {"workload": "raft", "generations": 1, "devices": [9]},
+    }
+    for name, req in reqs.items():
+        with open(os.path.join(d, "queue", f"{name}.json"), "w") as f:
+            json.dump(req, f)
+    lines = []
+    res = campaign.serve(
+        d, slice_generations=1, max_rounds=4, idle_rounds=1,
+        out=lambda s: lines.append(json.loads(s)), factory=factory,
+        sleep=lambda s: None, devices=["d0", "d1", "d2", "d3"],
+    )
+    assert sorted(res["completed"]) == ["a", "b", "c"]
+    assert res["devices"] == 4
+    rejected = [l for l in lines if l.get("rejected")]
+    assert len(rejected) == 1 and "out of range" in rejected[0]["rejected"]
+    devmap = {}
+    for l in lines:
+        if "report" in l:
+            devmap.setdefault(l["campaign"], set()).add(l["device"])
+    assert devmap["a"] == {0}
+    assert devmap["b"] == {1}  # pinned device set honored
+    assert devmap["c"] <= {2, 3}
+    # >= 3 campaigns ran CONCURRENTLY across devices in one round: all
+    # three appear in the first round's slice lines
+    first_round = [l["campaign"] for l in lines if "report" in l][:3]
+    assert sorted(first_round) == ["a", "b", "c"]
+
+
+@pytest.mark.slow
+def test_serve_drains_three_real_campaigns_across_devices(tmp_path):
+    """The farm e2e bar: `campaign serve` with a 3-device fleet drains
+    three REAL concurrent campaigns (distinct meta-seeds), slicing each
+    on its own device, with a kill + restart at a slice boundary — and
+    every campaign's final fingerprint equals its uninterrupted
+    single-device run (placement and preemption never touch results)."""
+    from madsim_tpu import campaign
+    from madsim_tpu.campaign import Campaign, build_workload
+    from madsim_tpu.campaign import named_workload_ref
+
+    d = str(tmp_path / "farm")
+    os.makedirs(os.path.join(d, "queue"))
+    gens = 2
+    seeds = {"a": 1, "b": 2, "c": 3}
+    for name, ms in seeds.items():
+        with open(os.path.join(d, "queue", f"{name}.json"), "w") as f:
+            json.dump({
+                "workload": "raft", "virtual_secs": 0.5, "lanes": 8,
+                "meta_seed": ms, "generations": gens, "shrink": False,
+            }, f)
+    devices = jax.devices()[:3]
+    lines = []
+
+    def run_serve(max_rounds):
+        return campaign.serve(
+            d, slice_generations=1, max_rounds=max_rounds, idle_rounds=1,
+            out=lambda s: lines.append(json.loads(s)),
+            sleep=lambda s: None, devices=devices,
+        )
+
+    run_serve(1)  # one slice each, then the service "dies"
+    res = run_serve(4)  # restart: resumes from checkpoints, drains
+    assert sorted(res["completed"]) == ["a", "b", "c"]
+    finals = {}
+    for l in lines:
+        if "report" in l and l["remaining"] == 0:
+            finals[l["campaign"]] = l["fingerprint"]
+    assert set(finals) == {"a", "b", "c"}
+    # slices really spread across the fleet
+    used = {l["device"] for l in lines if "report" in l}
+    assert len(used) == 3
+    # uninterrupted single-device reference runs, same search identity
+    for name, ms in seeds.items():
+        ref_dir = str(tmp_path / f"ref-{name}")
+        wl = build_workload(named_workload_ref("raft", 0.5, False))
+        c = Campaign(
+            wl, ref_dir, meta_seed=ms, lanes=8, shrink=False,
+            workload_ref=named_workload_ref("raft", 0.5, False),
+        )
+        rep = c.run(gens)
+        assert rep.fingerprint() == finals[name], name

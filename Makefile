@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -39,6 +39,9 @@ refill-smoke:    ## continuous batching: >=90% occupancy on a 10x horizon-spread
 
 multichip-smoke: ## multi-chip fleet on the virtual 8-device mesh: refill bit-identity across device counts, >=0.9 per-device occupancy, >=6x lane-step scaling, federation fingerprint (<60s warm)
 	$(PY) -m pytest tests/test_multichip.py -q -m "chaos and not slow"
+
+telemetry-smoke: ## telemetry observe-only contract: on/off bit-identity (fingerprint + golden digest), schema round-trip, Perfetto/format_trace parity, repro --perfetto, serve status atomicity, <2% span overhead (<2min warm; runs the WHOLE file incl. slow-marked tests — the tier-1 budget keeps only the fast ones)
+	$(PY) -m pytest tests/test_telemetry.py -q -m "not deep"
 
 regression:      ## replay the regression corpus of deduped bug bundles green
 	$(PY) -m madsim_tpu.campaign regress $(if $(REGRESSION_DIR),--dir $(REGRESSION_DIR),)

@@ -435,6 +435,73 @@ def bench_chain(lanes: int, virtual_secs: float) -> dict:
     }
 
 
+def bench_telemetry_overhead(
+    lanes: int = 256, virtual_secs: float = 0.5, iters: int = 6,
+    repeats: int = 3,
+) -> dict:
+    """Span-wrapped vs bare dispatch loop on the smoke raft workload.
+
+    Telemetry's contract is observe-only AND near-free: the span sites in
+    run_batch/explore/triage/serve wrap ms-scale device dispatches with a
+    µs-scale perf_counter pair, so enabling capture must cost <2% wall
+    (asserted by tests/test_telemetry.py on this same measurement). Both
+    loops run the SAME compiled program on the SAME seeds — identical
+    device work, only the span machinery differs (per-seed wall varies
+    with trajectory length, so fresh-seed A/B would measure seed luck,
+    not telemetry) — and min-of-`repeats` damps scheduler noise. Also
+    reports the raw per-span cost so the budget is auditable:
+    overhead ≈ spans/dispatch x span_us / wall."""
+    import numpy as np
+
+    import madsim_tpu.telemetry as telemetry
+    from madsim_tpu.tpu import BatchedSim, make_raft_spec
+
+    spec = make_raft_spec(n_nodes=5)
+    sim = BatchedSim(spec, raft_bench_config(virtual_secs))
+    max_steps = int(virtual_secs * 600) + 500
+
+    def loop() -> None:
+        for i in range(iters):
+            seeds = np.arange(i * lanes, (i + 1) * lanes, dtype=np.uint32)
+            with telemetry.span("dispatch", site="bench"):
+                st = sim.run(seeds, max_steps=max_steps)
+            with telemetry.span("decode", site="bench"):
+                st.violated.block_until_ready()
+
+    telemetry.disable()
+    loop()  # warm the compile outside both timed loops
+    bare, wrapped = [], []
+    for _ in range(repeats):
+        telemetry.disable()
+        t0 = time.perf_counter()
+        loop()
+        bare.append(time.perf_counter() - t0)
+        telemetry.enable()
+        t0 = time.perf_counter()
+        loop()
+        wrapped.append(time.perf_counter() - t0)
+    # per-span machinery cost, measured directly (enabled path)
+    telemetry.enable()
+    n_micro = 10_000
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        with telemetry.span("micro"):
+            pass
+    span_us = (time.perf_counter() - t0) / n_micro * 1e6
+    telemetry.disable()
+    bare_s, wrapped_s = min(bare), min(wrapped)
+    return {
+        "bare_s": round(bare_s, 4),
+        "wrapped_s": round(wrapped_s, 4),
+        "overhead_pct": round(
+            max(wrapped_s - bare_s, 0.0) / bare_s * 100, 3
+        ),
+        "span_us": round(span_us, 3),
+        "spans_per_dispatch": 2,
+        "dispatches": iters,
+    }
+
+
 def bench_cpp_baseline(n_seeds: int, virtual_secs: float, client_rate: float) -> dict:
     """The HONEST CPU denominator: a compiled thread-per-seed DES fuzzer
     (native/raft_bench.cpp) running the same protocol + chaos + invariant
@@ -560,6 +627,7 @@ def main() -> None:
     )
     ttfb = {} if args.skip_ttfb else bench_ttfb()
     explore = {} if args.skip_explore else bench_explore()
+    telemetry_overhead = bench_telemetry_overhead()
 
     # vs_baseline is computed against the STRONGEST CPU execution available:
     # the compiled C++ thread-per-seed DES (the reference's execution model)
@@ -684,6 +752,10 @@ def main() -> None:
             explore.get("chain_straggler", {}).get("coverage_gain_pct")
             if isinstance(explore, dict) else None
         ),
+        # telemetry span-site cost: wrapped vs bare dispatch loop on the
+        # smoke workload (<2% pinned by tests/test_telemetry.py)
+        "telemetry_overhead": telemetry_overhead,
+        "telemetry_overhead_pct": telemetry_overhead["overhead_pct"],
         "backend": tpu["backend"],
         "notes": (
             "r6 changes, engine + measurement: (1) buffer donation "

@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import telemetry
 from .explore import (
     Candidate,
     CorpusEntry,
@@ -65,6 +66,8 @@ from .explore import (
 CAMPAIGN_FORMAT = "madsim-tpu-campaign/1"
 
 MANIFEST = "manifest.json"
+STATUS = "status.json"  # the serve farm-status surface (observability.md)
+METRICS_TEXTFILE = "metrics.prom"
 CORPUS = "corpus.jsonl"
 SEEN = "seen.jsonl"
 VIOLATIONS = "violations.jsonl"
@@ -806,7 +809,8 @@ def merge_corpora(dirs: Sequence[str]) -> Tuple[List[CorpusEntry], List[dict]]:
         if man.get("spec_name"):
             spec_names.add(man["spec_name"])
         corpora.append(load_corpus(d))
-    entries = merge_entry_lists(corpora)
+    with telemetry.span("merge", site="campaign", corpora=len(dirs)):
+        entries = merge_entry_lists(corpora)
     if len(hashes) > 1:
         raise ValueError(
             f"corpora were fuzzed under {len(hashes)} different configs "
@@ -868,10 +872,11 @@ def minimize(
         part = part + [part[0]] * pad  # pad lanes are discarded at decode
         cands = [e.cand for e in part]
         seeds = np.asarray([c.seed for c in cands], np.uint32)
-        st = sim.run(
-            seeds, max_steps=workload.max_steps,
-            ctl=ctl_for(cands, full_h),
-        )
+        with telemetry.span("dispatch", site="cmin", off=lo):
+            st = sim.run(
+                seeds, max_steps=workload.max_steps,
+                ctl=ctl_for(cands, full_h),
+            )
         dispatches += 1
         return n, st
 
@@ -1315,6 +1320,14 @@ def serve(
                 "campaign_dir": campaign_dir,
                 "remaining": left,
                 "devices": dev_set,
+                # status-surface seeds/s baseline: a RESUMED campaign's
+                # explorer already carries its pre-restart cumulative
+                # seeds_run — without this, the first slice would credit
+                # the device with the whole checkpointed history
+                "seeds_run_prev": int(
+                    getattr(getattr(built, "ex", None), "seeds_run", 0)
+                    or 0
+                ),
             }
             out(json.dumps({
                 "campaign": cid, "accepted": True, "generations": left,
@@ -1338,21 +1351,77 @@ def serve(
     def run_lane(assignment, di: int) -> Dict[str, tuple]:
         """One device's slice lane: its campaigns' slices, sequentially,
         pinned to the device. Raises never escape — a failing tenant is
-        reported per-campaign in the fold below."""
+        reported per-campaign in the fold below. Each slice's wall time
+        rides along for the status surface's per-device occupancy and
+        seeds/s."""
         res: Dict[str, tuple] = {}
         for cid in assignment[di]:
             job = jobs[cid]
             g = min(int(slice_generations), job["remaining"])
+            t_slice = time.perf_counter()
             try:
                 with _device_ctx(devs[di]):
-                    report = job["campaign"].run(g)
-                    job["campaign"].checkpoint()
-                res[cid] = (g, report, None)
+                    with telemetry.span(
+                        "slice", site="serve", campaign=cid, device=di
+                    ):
+                        report = job["campaign"].run(g)
+                    with telemetry.span(
+                        "checkpoint", site="serve", campaign=cid
+                    ):
+                        job["campaign"].checkpoint()
+                res[cid] = (g, report, None, time.perf_counter() - t_slice)
             except Exception as e:  # noqa: BLE001 - one tenant's failing
                 # workload must not take the other campaigns down; its
                 # last good checkpoint stays resumable
-                res[cid] = (g, None, e)
+                res[cid] = (g, None, e, time.perf_counter() - t_slice)
         return res
+
+    # -- the live status surface (docs/observability.md): status.json +
+    # a Prometheus textfile, BOTH atomically replaced after every round,
+    # so any agent can scrape queue depth, per-campaign cursors and
+    # per-device occupancy / seeds/s without touching the service
+    t_serve = time.perf_counter()
+    dev_busy_s = [0.0] * len(devs)
+    dev_seeds = [0] * len(devs)
+    last_device: Dict[str, Optional[int]] = {}
+
+    def write_status_surfaces() -> None:
+        uptime = max(time.perf_counter() - t_serve, 1e-9)
+        status = {
+            "uptime_s": round(uptime, 3),
+            "rounds": rounds,
+            "devices": len(devs) if pinned_devices else 1,
+            "queue_depth": len(glob.glob(os.path.join(queue_dir, "*.json"))),
+            "active": {
+                cid: {
+                    "generation": int(getattr(
+                        jobs[cid]["campaign"], "generation", 0
+                    )),
+                    "remaining": int(jobs[cid]["remaining"]),
+                    "bugs": len(getattr(jobs[cid]["campaign"], "bugs", ())),
+                    "device": (
+                        last_device.get(cid) if pinned_devices else None
+                    ),
+                }
+                for cid in sorted(jobs)
+            },
+            "completed": list(completed),
+            "per_device": [
+                {
+                    "busy_s": round(dev_busy_s[d], 3),
+                    "occupancy": round(dev_busy_s[d] / uptime, 4),
+                    "seeds_run": dev_seeds[d],
+                    "seeds_per_sec": round(
+                        dev_seeds[d] / dev_busy_s[d], 1
+                    ) if dev_busy_s[d] > 0 else 0.0,
+                }
+                for d in range(len(devs))
+            ],
+        }
+        telemetry.write_status(os.path.join(dir, STATUS), status)
+        telemetry.write_farm_textfile(
+            os.path.join(dir, METRICS_TEXTFILE), status
+        )
 
     pool = None
     if len(devs) > 1:
@@ -1370,6 +1439,7 @@ def serve(
             device_of = {
                 cid: di for di in lanes for cid in assignment[di]
             }
+            last_device.update(device_of)
             results: Dict[str, tuple] = {}
             if pool is not None and len(lanes) > 1:
                 futs = [
@@ -1381,8 +1451,9 @@ def serve(
                 for di in lanes:
                     results.update(run_lane(assignment, di))
             for cid in sorted(results):
-                g, report, err = results[cid]
+                g, report, err, slice_s = results[cid]
                 job = jobs[cid]
+                dev_busy_s[device_of[cid]] += slice_s
                 if err is not None:
                     reject(
                         job["active_path"], cid,
@@ -1394,6 +1465,11 @@ def serve(
                     continue
                 job["remaining"] -= g
                 campaign = job["campaign"]
+                seeds_run = int(getattr(report, "seeds_run", 0))
+                dev_seeds[device_of[cid]] += max(
+                    seeds_run - job.get("seeds_run_prev", 0), 0
+                )
+                job["seeds_run_prev"] = seeds_run
                 line = {
                     "campaign": cid,
                     "generation": campaign.generation,
@@ -1404,6 +1480,8 @@ def serve(
                     "report": report.to_dict(),
                 }
                 out(json.dumps(line))
+                if telemetry.enabled():
+                    telemetry.record_slice(line)
                 with open(
                     os.path.join(job["campaign_dir"], REPORTS_STREAM), "a"
                 ) as f:
@@ -1419,6 +1497,7 @@ def serve(
                     completed.append(cid)
                     del jobs[cid]
             rounds += 1
+            write_status_surfaces()
             if max_rounds is not None and rounds >= max_rounds:
                 break
             if progressed:
@@ -1431,6 +1510,7 @@ def serve(
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
+        write_status_surfaces()
     return {
         "rounds": rounds, "completed": completed, "pending": sorted(jobs),
         "devices": len(devs) if pinned_devices else 1,

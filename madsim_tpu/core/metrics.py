@@ -3,11 +3,16 @@
 plus the host half of the chaos-coverage report: per-fault-kind nemesis
 fire counts and named buggify fire counts (`chaos_fires`), mirroring the
 device-side counters in `BatchResult.summary`.
+
+`madsim_tpu.telemetry.record_runtime_metrics(handle.metrics())` routes
+everything here through the unified metrics registry (host_* gauges and
+counters, chaos fires labeled `backend=host`) — see
+docs/observability.md — or call `to_telemetry()` for the flat dict.
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, Optional
+from typing import TYPE_CHECKING, Any, Dict, Optional
 
 if TYPE_CHECKING:
     from .task import Executor
@@ -17,6 +22,21 @@ class RuntimeMetrics:
     def __init__(self, executor: "Executor", handle=None) -> None:
         self._executor = executor
         self._handle = handle
+
+    def to_telemetry(self) -> Dict[str, Any]:
+        """This runtime's counters as one flat JSON-safe dict — the host
+        analog of `BatchResult.summary` in the telemetry vocabulary."""
+        return {
+            "host_nodes": self.num_nodes(),
+            "host_tasks": self.num_tasks(),
+            "host_dispatches": self.dispatches,
+            "host_device_ms": round(self.device_ms, 3),
+            "host_occupancy": round(self.occupancy, 4),
+            "chaos_fires": dict(sorted(self.chaos_fires().items())),
+            "chaos_occ_fired": dict(
+                sorted(self.chaos_occ_fired().items())
+            ),
+        }
 
     def num_nodes(self) -> int:
         return len(self._executor.nodes)

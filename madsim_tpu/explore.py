@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import telemetry
 from .nemesis import (
     OCC_CLAUSES,
     OCC_ROW,
@@ -738,6 +739,10 @@ class Explorer:
         )
         self.corpus_curve.append(len(self.corpus))
         self.violation_curve.append(len(self.violations))
+        if telemetry.enabled():
+            # observe-only, at the host boundary: the generation's device
+            # work is done and folded before any gauge moves
+            telemetry.record_explore_generation(self)
         self.say(
             f"dispatch {gen}: {self.coverage_curve[-1]} union bits, "
             f"corpus {len(self.corpus)}, violations {len(self.violations)}"
@@ -776,36 +781,41 @@ class Explorer:
             from .tpu.engine import refill_results
 
             seeds = np.asarray([c.seed for c in pop], np.uint32)
-            st = self.sim.run_refill(
-                seeds,
-                lanes=min(self.refill_lanes or self.chunk, len(pop)),
-                max_steps=self.workload.max_steps,
-                ctl=self._ctl_for(pop),
-            )
-            res = refill_results(st)
-            fold(
-                pop, np.asarray(res["cov_bitmap"], np.uint32),
-                res["cov_hiwater"], res["cov_transitions"],
-                res["violated"],
-            )
+            with telemetry.span("dispatch", site="explore", gen=gen):
+                st = self.sim.run_refill(
+                    seeds,
+                    lanes=min(self.refill_lanes or self.chunk, len(pop)),
+                    max_steps=self.workload.max_steps,
+                    ctl=self._ctl_for(pop),
+                )
+            with telemetry.span("decode", site="explore", gen=gen):
+                # refill_results is where the host blocks on the device
+                res = refill_results(st)
+                fold(
+                    pop, np.asarray(res["cov_bitmap"], np.uint32),
+                    res["cov_hiwater"], res["cov_transitions"],
+                    res["violated"],
+                )
         else:
             def dispatch(lo: int):
                 part = pop[lo:lo + self.chunk]
                 seeds = np.asarray([c.seed for c in part], np.uint32)
-                st = self.sim.run(
-                    seeds, max_steps=self.workload.max_steps,
-                    ctl=self._ctl_for(part),
-                )
+                with telemetry.span("dispatch", site="explore", gen=gen):
+                    st = self.sim.run(
+                        seeds, max_steps=self.workload.max_steps,
+                        ctl=self._ctl_for(part),
+                    )
                 return part, st
 
             def decode(entry) -> None:
                 part, st = entry
-                fold(
-                    part, np.asarray(st.cov.bitmap, np.uint32),
-                    np.asarray(st.cov.hiwater),
-                    np.asarray(st.cov.transitions),
-                    np.asarray(st.violated),
-                )
+                with telemetry.span("decode", site="explore", gen=gen):
+                    fold(
+                        part, np.asarray(st.cov.bitmap, np.uint32),
+                        np.asarray(st.cov.hiwater),
+                        np.asarray(st.cov.transitions),
+                        np.asarray(st.violated),
+                    )
 
             pipelined(
                 range(0, len(pop), self.chunk), dispatch, decode,

@@ -79,10 +79,17 @@ def replay_device(
     spec=None,
     repeats: int = 2,
     trace: int = 0,
+    perfetto: Optional[str] = None,
     out=print,
 ) -> Dict[str, Any]:
     """Device replay: the violation must fire at the recorded step/time,
-    bit-identically across `repeats` runs. Returns a report dict."""
+    bit-identically across `repeats` runs. Returns a report dict.
+
+    `trace=N` prints the last N trace events; `perfetto=PATH` additionally
+    writes the FULL replayed trajectory as a Chrome-trace/Perfetto
+    timeline (madsim_tpu.telemetry.write_perfetto) — one track per node,
+    deliveries as src→dst flow arrows, chaos windows as slices, the
+    violation as an instant marker."""
     _configure_jax_cache()
     import jax
     import numpy as np
@@ -136,15 +143,23 @@ def replay_device(
             f"recorded step {bundle.violation_step} / "
             f"t={bundle.violation_t_us}us"
         )
-    if trace > 0:
+    if trace > 0 or perfetto:
         from .tpu.trace import trace_seed
 
         events = trace_seed(
             sim, bundle.seed, max_steps=step + 2,
             kind_names=spec.msg_kind_names, ctl=ctl,
         )
-        for e in events[-trace:]:
+        for e in events[-trace:] if trace > 0 else []:
             out(str(e))
+        if perfetto:
+            from . import telemetry
+
+            telemetry.write_perfetto(
+                perfetto, events, n_nodes=spec.n_nodes,
+                label=f"{bundle.spec_name} seed {bundle.seed}",
+            )
+            out(f"perfetto timeline: {perfetto}")
     out(
         f"device replay OK: seed {bundle.seed} violates at step {step}, "
         f"t={t_us}us, bit-identical across {max(1, repeats)} runs"
@@ -221,17 +236,19 @@ def replay_host(bundle: ReproBundle, out=print) -> Dict[str, Any]:
 
 def replay(
     bundle: ReproBundle, backend: str = "tpu", spec=None, repeats: int = 2,
-    trace: int = 0, out=print,
+    trace: int = 0, perfetto: Optional[str] = None, out=print,
 ) -> Dict[str, Any]:
     if backend == "tpu":
         return replay_device(
-            bundle, spec=spec, repeats=repeats, trace=trace, out=out
+            bundle, spec=spec, repeats=repeats, trace=trace,
+            perfetto=perfetto, out=out,
         )
     if backend == "host":
         return replay_host(bundle, out=out)
     if backend == "both":
         rep = replay_device(
-            bundle, spec=spec, repeats=repeats, trace=trace, out=out
+            bundle, spec=spec, repeats=repeats, trace=trace,
+            perfetto=perfetto, out=out,
         )
         rep.update(replay_host(bundle, out=out))
         return rep
@@ -262,14 +279,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--trace", type=int, default=0, metavar="N",
         help="print the last N trace events of the replayed violation",
     )
+    p.add_argument(
+        "--perfetto", nargs="?", const="", default=None, metavar="PATH",
+        help="write the replayed trajectory as a Chrome-trace/Perfetto "
+        "timeline; with no PATH it lands next to the bundle "
+        "(<bundle>.perfetto.json). Device replay only.",
+    )
     args = p.parse_args(argv)
     bundle = ReproBundle.load(args.bundle)
     if args.spec_ref:
         bundle.spec_ref = args.spec_ref
+    perfetto = args.perfetto
+    if perfetto == "":
+        # default: next to the bundle, so the timeline ships with it
+        root, _ = os.path.splitext(args.bundle)
+        perfetto = f"{root}.perfetto.json"
     try:
         replay(
             bundle, backend=args.backend, repeats=args.repeats,
-            trace=args.trace,
+            trace=args.trace, perfetto=perfetto,
         )
     except (ReplayError, ValueError) as e:
         print(f"REPLAY FAILED: {e}", file=sys.stderr)

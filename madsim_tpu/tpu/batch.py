@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from .. import telemetry
 from .engine import BatchedSim, SimState, summarize
 from .spec import ProtocolSpec, SimConfig
 
@@ -434,16 +435,21 @@ def run_batch(
             part_in = np.concatenate([part, np.repeat(part[:1], pad)])
         else:
             part_in = part
-        st = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
-        rerun = (
-            sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
-            if check_determinism else None
-        )
+        with telemetry.span("dispatch", site="run_batch", off=off):
+            st = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+            rerun = (
+                sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+                if check_determinism else None
+            )
         return off, part.size, pad, st, rerun
 
     def decode(entry) -> None:
         """Read one chunk's small outputs and fold them into the totals
         (this is where the host blocks on device results)."""
+        with telemetry.span("decode", site="run_batch", off=entry[0]):
+            _decode(entry)
+
+    def _decode(entry) -> None:
         nonlocal state
         off, size, pad, st, rerun = entry
         if rerun is not None:
@@ -594,10 +600,27 @@ def _post_sweep(
         from .trace import trace_seed
 
         for seed in result.violating_seeds[:max_traces]:
-            result.traces[seed] = trace_seed(
-                sim, seed, max_steps=workload.max_steps,
-                kind_names=workload.spec.msg_kind_names,
-            )
+            with telemetry.span("trace", site="run_batch", seed=seed):
+                result.traces[seed] = trace_seed(
+                    sim, seed, max_steps=workload.max_steps,
+                    kind_names=workload.spec.msg_kind_names,
+                )
+
+    if telemetry.enabled():
+        # observe-only: the sweep above is already finished — this reads
+        # host-side numbers (and the traced TraceEvent streams) only
+        telemetry.record_batch_result(result, workload=workload.spec.name)
+        tdir = telemetry.out_dir()
+        if tdir is not None:
+            for seed, events in result.traces.items():
+                telemetry.write_perfetto(
+                    os.path.join(
+                        tdir,
+                        f"{workload.spec.name}-seed{seed}.perfetto.json",
+                    ),
+                    events, n_nodes=workload.spec.n_nodes,
+                    label=f"{workload.spec.name} seed {seed}",
+                )
 
     if repro_on_host and workload.host_repro is not None and result.violations:
         for seed in result.violating_seeds[:max_host_repros]:
@@ -663,11 +686,17 @@ def _run_batch_refill(
 
     def dispatch(off: int):
         part = seeds_arr[off : off + chunk]
-        st = run_part(part)
-        rerun = run_part(part) if check_determinism else None
+        with telemetry.span("dispatch", site="run_batch_refill", off=off):
+            st = run_part(part)
+            rerun = run_part(part) if check_determinism else None
         return off, part.size, st, rerun
 
     def decode(entry) -> None:
+        with telemetry.span("decode", site="run_batch_refill",
+                            off=entry[0]):
+            _decode(entry)
+
+    def _decode(entry) -> None:
         nonlocal state, occ_num, occ_den
         off, size, st, rerun = entry
         if rerun is not None:

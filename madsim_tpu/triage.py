@@ -460,6 +460,7 @@ class _Eval:
         bit-identical to the chunked path (pure per-(seed, ctl) rows)."""
         import numpy as np
 
+        from . import telemetry
         from .tpu.engine import refill_results, refill_results_sharded
         from .tpu.spec import REBASE_US
 
@@ -472,20 +473,21 @@ class _Eval:
         pad = (-A) % self.lane_width
         rows_p = rows + [rows[0]] * pad
         seeds = np.full((len(rows_p),), self.seed, np.uint32)
-        if self.mesh is not None:
-            st = self.sim.run_refill_sharded(
-                seeds, lanes=self.lane_width, mesh=self.mesh,
-                max_steps=self.max_steps, ctl=self._rows_ctl(rows_p),
-            )
-            self.dispatches += 1
-            res = refill_results_sharded(st, admissions=len(rows_p))
-        else:
-            st = self.sim.run_refill(
-                seeds, lanes=self.lane_width,
-                max_steps=self.max_steps, ctl=self._rows_ctl(rows_p),
-            )
-            self.dispatches += 1
-            res = refill_results(st)
+        with telemetry.span("dispatch", site="shrink", candidates=A):
+            if self.mesh is not None:
+                st = self.sim.run_refill_sharded(
+                    seeds, lanes=self.lane_width, mesh=self.mesh,
+                    max_steps=self.max_steps, ctl=self._rows_ctl(rows_p),
+                )
+                self.dispatches += 1
+                res = refill_results_sharded(st, admissions=len(rows_p))
+            else:
+                st = self.sim.run_refill(
+                    seeds, lanes=self.lane_width,
+                    max_steps=self.max_steps, ctl=self._rows_ctl(rows_p),
+                )
+                self.dispatches += 1
+                res = refill_results(st)
         t_us = (
             res["violation_epoch"].astype(np.int64) * REBASE_US
             + res["violation_at"].astype(np.int64)
@@ -515,6 +517,7 @@ class _Eval:
         of serializing."""
         import numpy as np
 
+        from . import telemetry
         from .tpu.spec import REBASE_US
 
         if self.refill:
@@ -529,7 +532,10 @@ class _Eval:
             part = part + [part[0]] * pad
             ctl = self._rows_ctl(part)
             seeds = np.full((self.lane_width,), self.seed, np.uint32)
-            state = self.sim.run(seeds, max_steps=self.max_steps, ctl=ctl)
+            with telemetry.span("dispatch", site="shrink", candidates=n):
+                state = self.sim.run(
+                    seeds, max_steps=self.max_steps, ctl=ctl
+                )
             self.dispatches += 1
             return n, state
 
@@ -883,13 +889,20 @@ def shrink_seed(
         f"shrunk seed {seed}: {len(base_atoms)} atoms -> {len(kept)} in "
         f"{ev.dispatches} dispatches; bundle {path or '(unsaved)'}"
     )
-    return ShrinkResult(
+    result = ShrinkResult(
         bundle=bundle,
         bundle_path=path,
         dispatches=ev.dispatches,
         original_atoms=len(base_atoms),
         kept_atoms=kept,
     )
+    from . import telemetry
+
+    if telemetry.enabled():
+        # shrink progress (atoms remaining, dispatch cost) at the host
+        # boundary — the sweep/ddmin work above is already complete
+        telemetry.record_shrink(result, workload=spec.name, seed=int(seed))
+    return result
 
 
 def default_bundle_dir() -> str:

@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -42,6 +42,10 @@ multichip-smoke: ## multi-chip fleet on the virtual 8-device mesh: refill bit-id
 
 telemetry-smoke: ## telemetry observe-only contract: on/off bit-identity (fingerprint + golden digest), schema round-trip, Perfetto/format_trace parity, repro --perfetto, serve status atomicity, <2% span overhead (<2min warm; runs the WHOLE file incl. slow-marked tests — the tier-1 budget keeps only the fast ones)
 	$(PY) -m pytest tests/test_telemetry.py -q -m "not deep"
+
+explain-smoke:   ## causal explainability end to end: the <60s-warm bench gate (planted raft re-stamp -> lineage slice names the re-stamp APPEND delivery chain -> cross-witness skeleton; lineage carry <= 15% budget), then the WHOLE causal suite incl. the slow-marked shrink/anatomy tests the tier-1 wall budget keeps out
+	$(PY) benches/explain_smoke.py
+	$(PY) -m pytest tests/test_causal.py -q -m "not deep"
 
 regression:      ## replay the regression corpus of deduped bug bundles green
 	$(PY) -m madsim_tpu.campaign regress $(if $(REGRESSION_DIR),--dir $(REGRESSION_DIR),)

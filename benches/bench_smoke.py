@@ -58,6 +58,15 @@ CARRY_BUDGET_B_PER_LANE = {
 }
 EST_OVER_FLOOR_MAX = 6.0
 
+# r12 lineage-plane budget (docs/causality.md): with lineage=True the
+# carry gains per-node Lamport clocks, the per-lane eid counter, and ONE
+# u16 sent_eid stamp per pool slot — measured 3.9% (raft) to 10.3%
+# (paxos, the smallest carry) at this smoke config. The 15% ceiling is
+# the acceptance bar: a u32 stamp (or a second stamp plane) blows it on
+# paxos/twopc, which is exactly the regression this guards. Lineage OFF
+# must cost zero bytes — pinned structurally in test_state_layout.py.
+LINEAGE_OVERHEAD_PCT_MAX = 15.0
+
 
 def workloads():
     from madsim_tpu.tpu import chain_workload, raft_workload
@@ -87,12 +96,28 @@ def layout_budget(name: str, wl) -> dict:
     cb = rl.carry_bytes(st)
     carry = cb["hot_bytes"] + cb["cold_bytes"]
     mem = rl.mem_bytes_per_step(sim, st)
+    # lineage-plane carry cost: same config, lineage=True (pure
+    # dtype x shape accounting — no run, no compile)
+    sim_lin = BatchedSim(wl.spec, wl.config, lineage=True)
+    st_lin = sim_lin.init(jnp.arange(LANES, dtype=jnp.uint32))
+    cb_lin = rl.carry_bytes(st_lin)
+    carry_lin = cb_lin["hot_bytes"] + cb_lin["cold_bytes"]
+    lin_pct = round(100.0 * (carry_lin - carry) / carry, 2)
     row = {
         "carry_bytes_per_lane": round(carry / LANES, 1),
         "bytes_per_step": mem["bytes_per_step"],
         "est_over_floor": round(mem["bytes_per_step"] / (2 * carry), 2),
+        "lineage_carry_bytes_per_lane": round(carry_lin / LANES, 1),
+        "lineage_overhead_pct": lin_pct,
     }
     errors = []
+    if lin_pct > LINEAGE_OVERHEAD_PCT_MAX:
+        errors.append(
+            f"lineage plane widened: +{lin_pct}% carry bytes/lane > "
+            f"{LINEAGE_OVERHEAD_PCT_MAX}% budget — the sent_eid stamp "
+            "must stay u16 (run tests/test_state_layout.py for the "
+            "field name; docs/causality.md)"
+        )
     budget = CARRY_BUDGET_B_PER_LANE[name]
     if row["carry_bytes_per_lane"] > budget:
         errors.append(

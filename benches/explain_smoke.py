@@ -1,0 +1,124 @@
+"""explain-smoke: <60s warm causal-explainability gate for CI.
+
+The r12 lineage plane's whole value proposition is one sentence — "the
+farm can say WHICH chain of deliveries broke the invariant" — so this
+smoke walks the full path on the planted deposed-leader re-stamp bug
+(docs/bugs_found.md #1) and asserts the explanation is the right one:
+
+  * SWEEP: a 48-seed chaotic sweep of the planted config finds >= 2
+    violating seeds (the seed-dense regime campaign dedup collapses);
+  * SLICE: the first witness replays with BatchedSim(lineage=True); its
+    happens-before DAG decodes and VERIFIES (every u16 sent_eid stamp
+    resolves to a real send event; in-jit Lamport clocks == the pure
+    edge recomputation), and the violation's causal slice NAMES the
+    re-stamp delivery chain — the anchor is the APPEND delivery that
+    exposed the corrupted committed prefix, with further APPEND links
+    behind it;
+  * SKELETON: a second witness's slice aligns with the first into a
+    nonempty shared event skeleton containing that APPEND mechanism —
+    identical whichever witness order the fold runs in terms of content
+    hash (seed-sorted, as campaign anatomy does);
+  * BUDGET: the lineage plane's carry cost on this config stays under
+    the 15% bench_smoke ceiling (re-asserted here so the explain gate is
+    self-contained).
+
+Wall times are printed for eyes only. Usage:
+python benches/explain_smoke.py  (or `make explain-smoke`)
+Exit code != 0 on any assertion failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SEEDS = 48
+LINEAGE_OVERHEAD_PCT_MAX = 15.0
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from madsim_tpu import causal
+    from madsim_tpu.tpu.engine import BatchedSim
+    from ttfb import restamp_workload
+
+    wl = restamp_workload()
+
+    # -- sweep: find the witnesses --------------------------------------
+    sim = BatchedSim(wl.spec, wl.config)
+    st = sim.run(jnp.arange(SEEDS, dtype=jnp.uint32), max_steps=20_000)
+    viol = np.nonzero(np.asarray(st.violated))[0]
+    steps = np.asarray(st.violation_step)
+    assert viol.size >= 2, f"planted bug found on only {viol.size} seeds"
+    t_sweep = time.perf_counter() - t0
+
+    # -- slice: explain the first witness -------------------------------
+    t1 = time.perf_counter()
+    wit = [(int(s), int(steps[s])) for s in viol[:2]]
+    slices = []
+    for seed, step in wit:
+        g, sl = causal.explain(
+            wl.spec, wl.config, seed, max_steps=step + 2,
+        )
+        assert g.violation is not None
+        slices.append(sl)
+    anchor = slices[0].chain[-1]
+    assert anchor.kind == "deliver" and anchor.msg_name == "APPEND", (
+        f"anchor must be the re-stamped APPEND delivery, got {anchor}"
+    )
+    labels = [causal.slice_labels(s) for s in slices]
+    appends = [l for l in labels[0] if l.startswith("deliver:APPEND:")]
+    assert len(appends) >= 2, (
+        f"slice must name the re-stamp delivery chain, got {labels[0][-8:]}"
+    )
+    t_slice = time.perf_counter() - t1
+
+    # -- skeleton: align the two witnesses ------------------------------
+    skel = causal.skeleton(labels)
+    assert skel, "two witnesses of one bug class must share a skeleton"
+    assert any(l.startswith("deliver:APPEND:") for l in skel), (
+        f"skeleton must keep the APPEND mechanism, got {skel[-8:]}"
+    )
+
+    # -- budget: lineage carry cost under the ceiling -------------------
+    import roofline as rl
+
+    def carry_per_lane(lineage: bool) -> float:
+        s = BatchedSim(wl.spec, wl.config, lineage=lineage)
+        cb = rl.carry_bytes(s.init(jnp.arange(8, dtype=jnp.uint32)))
+        return (cb["hot_bytes"] + cb["cold_bytes"]) / 8
+
+    base, lin = carry_per_lane(False), carry_per_lane(True)
+    lin_pct = round(100.0 * (lin - base) / base, 2)
+    assert lin_pct <= LINEAGE_OVERHEAD_PCT_MAX, (
+        f"lineage carry +{lin_pct}% > {LINEAGE_OVERHEAD_PCT_MAX}% budget"
+    )
+
+    print(json.dumps({
+        "explain_smoke": "ok",
+        "violating_seeds": int(viol.size),
+        "anchor": str(anchor),
+        "chain_len": len(slices[0].chain),
+        "cone_size": slices[0].cone_size,
+        "depth": slices[0].depth,
+        "skeleton_len": len(skel),
+        "noise": [len(l) - len(skel) for l in labels],
+        "lineage_overhead_pct": lin_pct,
+        "wall_s": {
+            "sweep": round(t_sweep, 1),
+            "explain": round(t_slice, 1),
+            "total": round(time.perf_counter() - t0, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

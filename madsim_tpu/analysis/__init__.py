@@ -65,9 +65,16 @@ ALL_RULES = JAXPR_RULES + LINT_RULES
 # sharded segment for cross-device collective primitives — allowlisted
 # by EXACT primitive name (jaxpr_check.SHARD_COLLECTIVE_ALLOW, empty
 # in-tree), never wholesale.
+# "raft-lineage" traces the causal-lineage carry (BatchedSim(lineage=
+# True), docs/causality.md): all 11 rules over the step that threads
+# Lamport clocks / event ids / pool sent_eid stamps — notably rng-taint
+# (the lineage counters must stay schedule-neutral: no draw may fold
+# them, and the key funnel must not leak into them) and lane
+# independence of the edge-ring bookkeeping (the eid prefix count runs
+# over the NODE axis, never lanes).
 WORKLOADS = (
     "raft", "kv", "paxos", "twopc", "chain", "raft-refill",
-    "raft-refill-sharded",
+    "raft-refill-sharded", "raft-lineage",
 )
 
 

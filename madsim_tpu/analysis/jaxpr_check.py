@@ -161,13 +161,17 @@ def spec_factories() -> Dict[str, object]:
     }
 
 
-def build_verified_sim(name: str, lanes: int = LANES, refill: bool = False):
+def build_verified_sim(
+    name: str, lanes: int = LANES, refill: bool = False,
+    lineage: bool = False,
+):
     """(sim, state, hot, cold, const) — all abstract (ShapeDtypeStructs).
 
     `state` is the eval_shape of the real `_init` (or, with `refill`, of
     the real `init_refill` with a REFILL_ADMISSIONS-deep queue — the
-    continuous-batching carry partition); hot/cold/const the real
-    `split_state` partition. Nothing touches a device."""
+    continuous-batching carry partition; with `lineage`, of the causal-
+    lineage carry); hot/cold/const the real `split_state` partition.
+    Nothing touches a device."""
     from ..nemesis import OCC_CLAUSES, RATE_CLAUSES
     from ..tpu import nemesis as tpun
     from ..tpu.engine import BatchedSim, TriageCtl, split_state
@@ -187,7 +191,7 @@ def build_verified_sim(name: str, lanes: int = LANES, refill: bool = False):
             buggify_delay_rate=0.01,  # straggler side pool in the program
         ),
     )
-    sim = BatchedSim(spec, cfg, triage=True, coverage=True)
+    sim = BatchedSim(spec, cfg, triage=True, coverage=True, lineage=lineage)
     seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
     if refill:
         A = REFILL_ADMISSIONS
@@ -735,6 +739,8 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
     base = name[: -len("-sharded")] if sharded else name
     refill = base.endswith("-refill")
     base = base[: -len("-refill")] if refill else base
+    lineage = base.endswith("-lineage")
+    base = base[: -len("-lineage")] if lineage else base
     if sharded and not refill:
         raise ValueError(
             f"{name!r}: only the refill step has a sharded trace target"
@@ -742,7 +748,7 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
     if log:
         log(f"[analysis] tracing {name} step program (L={lanes}) ...")
     sim, state, hot, cold, const = build_verified_sim(
-        base, lanes=lanes, refill=refill,
+        base, lanes=lanes, refill=refill, lineage=lineage,
     )
     closed_sharded = None
     if sharded:

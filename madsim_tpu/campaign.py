@@ -217,6 +217,100 @@ def coarse_key(spec_name: str, violation_kind: str, genome) -> str:
     ).hexdigest()
 
 
+def bug_anatomy(
+    workload,
+    record: "BugRecord",
+    max_witnesses: int = 4,
+    max_len: Optional[int] = None,
+    log: Optional[Callable[[str], None]] = None,
+    label_cache: Optional[Dict[int, Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Cross-witness bug anatomy: align >= 2 witnesses' causal slices.
+
+    Each witness replays ONCE, single-lane, with the causal-lineage
+    plane on (madsim_tpu/causal.py) under its OWN candidate ctl (the
+    mutant/swarm suppressions it violated under — a full-plan replay may
+    not even reproduce it), producing its violation's minimal causal
+    slice. The slices' canonical label sequences (node ids renamed by
+    first appearance — crash victims and elected leaders are seed-local)
+    fold into the shared event SKELETON: the mechanism every witness
+    exhibits. What each witness has beyond the skeleton is its
+    seed-local noise. This complements ddmin's plan minimization: the
+    shrunk plan says which FAULTS are needed, the skeleton says which
+    EVENT CHAIN they cause. Witnesses replay in seed-sorted order so the
+    skeleton is deterministic; cone-depth/width go to the telemetry
+    histograms (`record_causal`). See docs/causality.md for what the
+    skeleton does and does not prove.
+
+    `label_cache` (seed -> computed row) amortizes refreshes: a witness
+    already replayed on a previous call is reused, so a campaign
+    refreshing the skeleton as witnesses arrive pays ONE replay per
+    witness, not one per (witness, refresh) pair — and the telemetry
+    histograms see each witness exactly once."""
+    from . import causal
+
+    say = log or (lambda msg: None)
+    wits = sorted(
+        record.witnesses, key=lambda w: int(w["seed"])
+    )[: int(max_witnesses)]
+    if not wits:
+        raise ValueError("bug_anatomy needs a record with >= 1 witness")
+    spec, cfg = workload.spec, workload.config
+    rows: List[Dict[str, Any]] = []
+    label_seqs: List[List[str]] = []
+    for w in wits:
+        seed = int(w["seed"])
+        cached = None if label_cache is None else label_cache.get(seed)
+        if cached is not None:
+            label_seqs.append(list(cached["labels"]))
+            rows.append(dict(cached))
+            continue
+        genome = canon_genome(tuple(w["candidate"]))
+        cand = Candidate(
+            seed=genome[0], off=genome[1], occ_off=genome[2],
+            rate_scale=genome[3], horizon_us=genome[4],
+        )
+        _, sl = causal.explain(
+            spec, cfg, seed,
+            ctl=ctl_for([cand], cfg.horizon_us),
+            max_steps=int(workload.max_steps), max_len=max_len,
+        )
+        labels = causal.slice_labels(sl)
+        label_seqs.append(labels)
+        row = {
+            "seed": seed,
+            "chain_len": len(sl.chain),
+            "cone_size": sl.cone_size,
+            "depth": sl.depth,
+            "labels": labels,
+        }
+        rows.append(row)
+        if label_cache is not None:
+            label_cache[seed] = dict(row)
+        if telemetry.enabled():
+            telemetry.record_causal(
+                {"depth": sl.depth, "cone_size": sl.cone_size,
+                 "chain_len": len(sl.chain)},
+                workload=spec.name, signature=record.signature[:12],
+            )
+    skel = causal.skeleton(label_seqs)
+    for row in rows:
+        row["noise"] = len(row.pop("labels")) - len(skel)
+    anatomy = {
+        "skeleton": skel,
+        "skeleton_sha": hashlib.sha256(
+            json.dumps(skel, separators=(",", ":")).encode()
+        ).hexdigest()[:16],
+        "witnesses": rows,
+    }
+    say(
+        f"anatomy {record.signature[:12]}: skeleton {len(skel)} shared "
+        f"events over {len(rows)} witnesses "
+        f"(noise {[r['noise'] for r in rows]})"
+    )
+    return anatomy
+
+
 @dataclasses.dataclass
 class BugRecord:
     """One deduplicated bug class: the signature that keys it, the shrunk
@@ -232,6 +326,12 @@ class BugRecord:
     first_generation: int
     coarse_keys: List[str]
     shrink_error: Optional[str] = None
+    # optional cross-witness bug anatomy (Campaign(anatomy=True) or
+    # bug_anatomy(); docs/causality.md): the shared causal-slice event
+    # skeleton of >= 2 witnesses — the MECHANISM every witness exhibits —
+    # vs each witness's seed-local noise, plus per-witness cone stats.
+    # None on records from older checkpoints / anatomy-off campaigns.
+    anatomy: Optional[Dict[str, Any]] = None
 
     @property
     def witness_seeds(self) -> List[int]:
@@ -500,11 +600,23 @@ class Campaign:
         pipeline: bool = True,
         log: Optional[Callable[[str], None]] = None,
         explorer_kwargs: Optional[Dict[str, Any]] = None,
+        anatomy: bool = False,
+        max_anatomy_witnesses: int = 4,
     ) -> None:
         self.workload = workload
         self.dir = str(dir)
         self.shrink = bool(shrink)
         self.max_shrinks = int(max_shrinks)
+        # cross-witness causal anatomy (docs/causality.md): like shrink /
+        # max_shrinks this is runtime POLICY, not search state — resume
+        # restores it from campaign_params but an explicit arg overrides
+        self.anatomy = bool(anatomy)
+        self.max_anatomy_witnesses = int(max_anatomy_witnesses)
+        # per-record replay cache for the anatomy refresh: signature ->
+        # {seed -> computed slice row}, so each witness replays ONCE per
+        # campaign process however many refreshes its record sees
+        # (in-memory only: a resumed campaign replays on first refresh)
+        self._anatomy_cache: Dict[str, Dict[int, Dict[str, Any]]] = {}
         self.lane_width = int(lane_width)
         self.spec_ref = spec_ref
         self.spec_kwargs = dict(spec_kwargs or {})
@@ -574,6 +686,27 @@ class Campaign:
             if record is None:
                 record = self._new_record(rec, genome, gen)
             record.witnesses.append(witness)
+            if (
+                self.anatomy
+                and 2 <= len(record.witnesses) <= self.max_anatomy_witnesses
+            ):
+                # refresh the cross-witness skeleton as witnesses arrive,
+                # bounded by max_anatomy_witnesses replays per record;
+                # anatomy failures must not break dedup (same contract as
+                # shrink_error)
+                try:
+                    record.anatomy = bug_anatomy(
+                        self.workload, record,
+                        max_witnesses=self.max_anatomy_witnesses,
+                        log=self.say,
+                        label_cache=self._anatomy_cache.setdefault(
+                            record.signature, {}
+                        ),
+                    )
+                except Exception as e:  # noqa: BLE001
+                    record.anatomy = {
+                        "error": f"{type(e).__name__}: {str(e)[:160]}"
+                    }
 
     def _new_record(self, rec, genome, gen: int) -> BugRecord:
         """Resolve a violation whose coarse group is new: shrink its first
@@ -656,6 +789,8 @@ class Campaign:
             "campaign_params": {
                 "shrink": self.shrink,
                 "max_shrinks": self.max_shrinks,
+                "anatomy": self.anatomy,
+                "max_anatomy_witnesses": self.max_anatomy_witnesses,
                 "lane_width": self.lane_width,
                 "spec_ref": self.spec_ref,
                 "spec_kwargs": self.spec_kwargs,
@@ -716,6 +851,10 @@ class Campaign:
             workload_ref=man["workload"],
             shrink=bool(cparams.get("shrink", True)),
             max_shrinks=int(cparams.get("max_shrinks", 8)),
+            anatomy=bool(cparams.get("anatomy", False)),
+            max_anatomy_witnesses=int(
+                cparams.get("max_anatomy_witnesses", 4)
+            ),
             lane_width=int(cparams.get("lane_width", 16)),
             spec_ref=spec_ref,
             spec_kwargs=spec_kwargs,

@@ -122,6 +122,27 @@ class RuntimeMetrics:
             out[f"buggify:{name}"] = out.get(f"buggify:{name}", 0) + n
         return out
 
+    # -- causal lineage (the host half of the device lineage plane) --
+
+    def lineage(self):
+        """The runtime's HostLineage mirror (net/netsim.py): per-node
+        Lamport clocks over the datagram delivery path, runtime-global
+        event ids, and the (send_eid -> deliver_eid) edge list — the
+        host face of `BatchedSim(lineage=True)`'s in-jit plane. OPT-IN
+        like the device plane: call `.enable()` on the returned object
+        BEFORE traffic starts (disabled runs retain nothing). Validate
+        with `causal.check_host_lineage`; None when no NetSim exists."""
+        handle = self._handle
+        if handle is None:
+            return None
+        try:
+            from ..net.netsim import NetSim
+
+            net = handle.simulators.get(NetSim)
+        except ImportError:
+            return None
+        return None if net is None else net.lineage
+
     def chaos_occ_fired(self) -> Dict[str, int]:
         """Per-clause OCCURRENCE fire bitmasks for this run (bit k set when
         window k of the schedule clause applied) — the host half of the

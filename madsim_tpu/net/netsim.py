@@ -97,6 +97,78 @@ class PayloadReceiver:
         self._chan.close()
 
 
+# bound on retained lineage events/edges: a long soak must not grow host
+# memory without bound; overflow is counted, never silent
+MAX_LINEAGE_EDGES = 100_000
+
+
+class HostLineage:
+    """The host runtime's Lamport mirror of the device lineage plane
+    (madsim_tpu/causal.py, docs/causality.md).
+
+    The device engine attributes a send to its emitting handler EVENT;
+    the host runtime has no handler-event notion, so a send is its own
+    Lamport event (the classic process model): `on_send` ticks the
+    node's clock and allocates the next runtime-global event id,
+    `on_deliver` updates `max(local, send event id) + 1` — the SAME
+    sender-value vocabulary as the engine's in-jit update (the message
+    carries its send EVENT's id), so one law checker
+    (`causal.check_host_lineage`) validates both faces. Clocks survive
+    node resets (a Lamport clock is observer metadata, not node state —
+    the device's `lin.lam` likewise survives crash-with-wipe).
+
+    OPT-IN, like the device plane (`BatchedSim(lineage=True)` costs zero
+    when off): call `enable()` BEFORE traffic starts — e.g.
+    `Handle.current().metrics().lineage().enable()` at the top of the
+    root task. Disabled (the default), the delivery path pays two
+    truthiness checks and retains nothing."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.lam: Dict[NodeId, int] = {}
+        self.next_eid = 0
+        # (eid, node, lam-after, kind) rows, eid order; bounded
+        self.events: List[tuple] = []
+        self.edges: List[tuple] = []  # (send_eid, deliver_eid)
+        self.dropped = 0
+
+    def enable(self) -> "HostLineage":
+        self.enabled = True
+        return self
+
+    def on_send(self, node: NodeId) -> int:
+        if not self.enabled:
+            return -1
+        lam = self.lam.get(node, 0) + 1
+        self.lam[node] = lam
+        eid = self.next_eid
+        self.next_eid += 1
+        self._record(eid, node, lam, "send")
+        return eid
+
+    def on_deliver(self, node: NodeId, send_eid: int) -> int:
+        if not self.enabled or send_eid < 0:
+            # send_eid < 0: the message was stamped before enable() —
+            # skip rather than record a half-history edge
+            return -1
+        lam = max(self.lam.get(node, 0), send_eid) + 1
+        self.lam[node] = lam
+        eid = self.next_eid
+        self.next_eid += 1
+        if len(self.edges) < MAX_LINEAGE_EDGES:
+            self.edges.append((send_eid, eid))
+        else:
+            self.dropped += 1
+        self._record(eid, node, lam, "deliver")
+        return eid
+
+    def _record(self, eid: int, node: NodeId, lam: int, kind: str) -> None:
+        if len(self.events) < 2 * MAX_LINEAGE_EDGES:
+            self.events.append((eid, node, lam, kind))
+        else:
+            self.dropped += 1
+
+
 class NetSim(Simulator):
     """Network simulator + chaos API (net/mod.rs:126-284)."""
 
@@ -112,6 +184,8 @@ class NetSim(Simulator):
         # channels owned by each node, closed on reset (the analog of task
         # drop closing connection halves on kill)
         self._node_channels: Dict[NodeId, List[Channel]] = {}
+        # Lamport mirror over the datagram delivery path (docs/causality.md)
+        self.lineage = HostLineage()
 
     @staticmethod
     def current() -> "NetSim":
@@ -256,6 +330,11 @@ class NetSim(Simulator):
         )
         if dup:
             cfg.count_fire("dup")
+        # Lamport mirror (opt-in; -1 when disabled): the send is an event
+        # whether or not any copy survives the link (the device's emitting
+        # handler event likewise exists regardless of drops); duplicates
+        # share it — one cause, two deliveries, the engine's dup semantics
+        send_eid = self.lineage.on_send(node)
         result = self.network.try_send(node, dst, protocol)
         if result is None and not dup:
             return  # dropped, and no copy can survive it
@@ -270,6 +349,8 @@ class NetSim(Simulator):
             src = (src_ip, port)
             if rsp_hook is not None and not rsp_hook(msg):
                 return
+            if dst_node is not None:
+                self.lineage.on_deliver(dst_node, send_eid)
             socket.deliver(src, dst, msg)
 
         def schedule(latency_ns: int, src_ip: str, socket) -> None:

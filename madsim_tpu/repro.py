@@ -80,6 +80,7 @@ def replay_device(
     repeats: int = 2,
     trace: int = 0,
     perfetto: Optional[str] = None,
+    explain: int = 0,
     out=print,
 ) -> Dict[str, Any]:
     """Device replay: the violation must fire at the recorded step/time,
@@ -89,7 +90,13 @@ def replay_device(
     writes the FULL replayed trajectory as a Chrome-trace/Perfetto
     timeline (madsim_tpu.telemetry.write_perfetto) — one track per node,
     deliveries as src→dst flow arrows, chaos windows as slices, the
-    violation as an instant marker."""
+    violation as an instant marker. `explain=N` replays the bundle once
+    more with the causal-lineage plane on (BatchedSim(lineage=True)) and
+    prints the last N links of the violation's minimal causal slice —
+    the chain of deliveries/timer fires the violation transitively
+    depends on (docs/causality.md); when the bundle carries a v3 causal
+    digest, the replayed slice's label sha is cross-checked against it
+    (schema drift fails loudly, like the config hash)."""
     _configure_jax_cache()
     import jax
     import numpy as np
@@ -160,11 +167,32 @@ def replay_device(
                 label=f"{bundle.spec_name} seed {bundle.seed}",
             )
             out(f"perfetto timeline: {perfetto}")
+    rep = {"violated": True, "step": step, "t_us": t_us, "repeats": repeats}
+    if explain > 0:
+        from . import causal
+
+        g, sl = causal.explain(
+            spec, cfg, bundle.seed, ctl=ctl, max_steps=step + 2,
+        )
+        digest = causal.causal_digest(sl)
+        tail = (
+            causal.causal_slice(g, max_len=explain)
+            if len(sl.chain) > explain else sl
+        )
+        out(causal.format_slice(tail))
+        if bundle.causal is not None and (
+            bundle.causal.get("sha") != digest["sha"]
+        ):
+            raise ReplayError(
+                "causal slice diverged from the bundle's recorded digest "
+                f"({digest['sha']} != {bundle.causal.get('sha')}) — the "
+                "lineage plane or the slice semantics drifted"
+            )
+        rep["causal"] = digest
     out(
         f"device replay OK: seed {bundle.seed} violates at step {step}, "
         f"t={t_us}us, bit-identical across {max(1, repeats)} runs"
     )
-    rep = {"violated": True, "step": step, "t_us": t_us, "repeats": repeats}
     if bundle.signature:
         # campaign provenance (bundle schema v2): the dedup signature keys
         # this bug class across seeds/campaigns — docs/campaign.md
@@ -236,19 +264,20 @@ def replay_host(bundle: ReproBundle, out=print) -> Dict[str, Any]:
 
 def replay(
     bundle: ReproBundle, backend: str = "tpu", spec=None, repeats: int = 2,
-    trace: int = 0, perfetto: Optional[str] = None, out=print,
+    trace: int = 0, perfetto: Optional[str] = None, explain: int = 0,
+    out=print,
 ) -> Dict[str, Any]:
     if backend == "tpu":
         return replay_device(
             bundle, spec=spec, repeats=repeats, trace=trace,
-            perfetto=perfetto, out=out,
+            perfetto=perfetto, explain=explain, out=out,
         )
     if backend == "host":
         return replay_host(bundle, out=out)
     if backend == "both":
         rep = replay_device(
             bundle, spec=spec, repeats=repeats, trace=trace,
-            perfetto=perfetto, out=out,
+            perfetto=perfetto, explain=explain, out=out,
         )
         rep.update(replay_host(bundle, out=out))
         return rep
@@ -285,6 +314,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "timeline; with no PATH it lands next to the bundle "
         "(<bundle>.perfetto.json). Device replay only.",
     )
+    p.add_argument(
+        "--explain", nargs="?", const=20, type=int, default=0, metavar="N",
+        help="replay once more with the causal-lineage plane on and print "
+        "the last N links (default 20) of the violation's minimal causal "
+        "slice — the happens-before chain it depends on (docs/causality"
+        ".md). Cross-checks the bundle's v3 causal digest when present. "
+        "Device replay only.",
+    )
     args = p.parse_args(argv)
     bundle = ReproBundle.load(args.bundle)
     if args.spec_ref:
@@ -297,7 +334,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         replay(
             bundle, backend=args.backend, repeats=args.repeats,
-            trace=args.trace, perfetto=perfetto,
+            trace=args.trace, perfetto=perfetto, explain=args.explain,
         )
     except (ReplayError, ValueError) as e:
         print(f"REPLAY FAILED: {e}", file=sys.stderr)

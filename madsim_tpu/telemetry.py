@@ -709,6 +709,32 @@ def record_shrink(result, **labels: Any) -> None:
     )
 
 
+# causal-structure histogram buckets: event counts, not seconds
+CAUSAL_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096)
+
+
+def record_causal(digest: Dict[str, Any], **labels: Any) -> None:
+    """One causal digest (causal.causal_digest) → registry: the
+    causal-depth / cone-width / chain-length distributions of explained
+    violations — the bug-anatomy shape of a campaign at a glance
+    (docs/causality.md)."""
+    reg = _STATE.registry
+    if reg is None:
+        return
+    reg.histogram(
+        "causal_depth", "longest dependency path in the violation cone",
+        buckets=CAUSAL_BUCKETS,
+    ).observe(int(digest.get("depth", 0)), **labels)
+    reg.histogram(
+        "causal_cone_width", "events in the violation's backward cone",
+        buckets=CAUSAL_BUCKETS,
+    ).observe(int(digest.get("cone_size", 0)), **labels)
+    reg.histogram(
+        "causal_chain_len", "events in the minimal causal slice",
+        buckets=CAUSAL_BUCKETS,
+    ).observe(int(digest.get("chain_len", 0)), **labels)
+
+
 def record_slice(line: Dict[str, Any], **labels: Any) -> None:
     """One `campaign serve` slice line → registry."""
     reg = _STATE.registry
@@ -765,7 +791,16 @@ def perfetto_from_events(
         everything else an instant (``ph:"i"``) on its own track — so a
         timeline and a text trace carry the same information;
       * each delivery additionally gets a flow arrow src→dst
-        (``ph:"s"``/``ph:"f"`` pair, one id per delivery);
+        (``ph:"s"``/``ph:"f"`` pair, one id per delivery). With a
+        LINEAGE-enabled trace (BatchedSim(lineage=True): events carry
+        eids and deliveries their send event's eid) the arrow is TRUE
+        causality — it starts at the actual emitting event's timestamp
+        on the source track. Without lineage the arrow falls back to
+        starting at the delivery instant, which carries no send-time
+        information and (worse) any send-side heuristic would pick the
+        wrong origin when a link carries several in-flight messages of
+        the same kind — the regression tests/test_telemetry.py pins the
+        lineage pairing against exactly that case;
       * chaos windows additionally render as duration slices: crash→
         restart on the node's track, split→heal / clog→unclog /
         spike_on→spike_off on dedicated chaos tracks (an unclosed window
@@ -799,6 +834,11 @@ def perfetto_from_events(
 
     t_end = max([e.t_us for e in evs] + [0])
     flow_id = 0
+    # lineage pairing: map each stamped event's eid to the event, so a
+    # delivery's send arrow can anchor at the real emitting event
+    by_eid = {
+        e.eid: e for e in evs if getattr(e, "eid", -1) >= 0
+    }
     # open chaos windows: kind -> (start event, extra)
     down_since: Dict[int, int] = {}  # node -> crash t_us
     open_win: Dict[str, Tuple[int, str]] = {}  # track -> (t_us, name)
@@ -814,17 +854,25 @@ def perfetto_from_events(
     for e in evs:
         if e.kind == "deliver":
             name = e.msg_name or f"kind{e.msg_kind}"
+            args = {
+                "step": e.step, "src": e.src,
+                "payload": list(e.payload or ()),
+            }
+            send = by_eid.get(getattr(e, "sent_eid", -1))
+            if getattr(e, "eid", -1) >= 0:
+                args["eid"] = e.eid
+                args["sent_eid"] = e.sent_eid
             out.append({
                 "ph": "X", "pid": SIM_PID, "tid": e.node, "ts": e.t_us,
-                "dur": 1, "name": name, "cat": "deliver",
-                "args": {
-                    "step": e.step, "src": e.src,
-                    "payload": list(e.payload or ()),
-                },
+                "dur": 1, "name": name, "cat": "deliver", "args": args,
             })
             flow_id += 1
+            # TRUE flow (lineage): the arrow starts at the emitting
+            # event's own timestamp on the source track; legacy traces
+            # (no lineage) fall back to the delivery instant
+            s_ts = send.t_us if send is not None else e.t_us
             out.append({
-                "ph": "s", "pid": SIM_PID, "tid": e.src, "ts": e.t_us,
+                "ph": "s", "pid": SIM_PID, "tid": e.src, "ts": s_ts,
                 "id": flow_id, "name": name, "cat": "msg",
             })
             out.append({

@@ -72,6 +72,7 @@ import jax.numpy as jnp
 
 from . import bitpack, prng
 from .spec import (
+    EID_NONE,
     INF_GUARD,
     INF_US,
     Outbox,
@@ -154,6 +155,27 @@ class Coverage(NamedTuple):
     transitions: Any  # i32 [L] events that changed node state
 
 
+class Lineage(NamedTuple):
+    """Per-lane causal-lineage plane (present iff `BatchedSim(lineage=True)`;
+    docs/causality.md).
+
+    `lam` is a per-node Lamport clock over the lane's global event-id
+    scale: a timer fire ticks `lam[n] += 1`, a delivery updates
+    `lam[n] = max(lam[n], sender) + 1` where `sender` is the delivered
+    message's send-event id (the classic Lamport update with the sent_eid
+    stamp as the sender's value — eids are assigned in step order, so the
+    eid order is itself consistent with happens-before and the clock law
+    `lam(deliver) > lam(send-event owner's clock at send)` holds). `eid`
+    is the lane's global event counter: every delivery/timer-fire gets
+    the next id, assigned in node order within a step. Neither value
+    feeds any draw or any protocol state — lineage is OBSERVE-ONLY, and
+    all non-lineage outputs are bit-identical with lineage on/off (pinned
+    like coverage was in r7; tests/test_causal.py)."""
+
+    lam: Any  # i32 [L,N] per-node Lamport clock (event-id scale)
+    eid: Any  # u32 [L] next event id (== events processed so far)
+
+
 class MsgPool(NamedTuple):
     """In-flight messages: per-destination validity + per-candidate ring.
 
@@ -185,6 +207,15 @@ class MsgPool(NamedTuple):
     deliver: Any  # i32 [L,CK] (offset us)
     kind: Any  # u8 [L,CK] (i32 when msg_kind_names is undeclared)
     payload: Any  # i32 [L,CK,P]
+    # lineage stamp (BatchedSim(lineage=True) only, else None — zero
+    # bytes off): the send event's global eid, stored NARROW per the r8
+    # narrow-field rules — u16 at rest (pool bytes are a top step cost),
+    # widened back to the full u32 eid at delivery by rolling-window
+    # reconstruction against the lane's eid counter (exact while fewer
+    # than 65536 lane events occur during any message's flight — the
+    # same reconstruction idiom as the epoch rebase; the decoder
+    # verifies the bound instead of trusting it, causal.graph_from_trace)
+    sent_eid: Any = None  # u16 [L,CK] | None
 
     @property
     def valid(self):
@@ -205,6 +236,7 @@ class StragPool(NamedTuple):
     dst: Any  # u8 [L,B]
     kind: Any  # u8 [L,B] (i32 when msg_kind_names is undeclared)
     payload: Any  # i32 [L,B,P]
+    sent_eid: Any = None  # u16 [L,B] | None (lineage stamp, see MsgPool)
 
 
 class NemesisState(NamedTuple):
@@ -396,6 +428,15 @@ class TraceRecord(NamedTuple):
     unclog: Any  # bool [L] link unclogged this step
     spike_on: Any  # bool [L] latency spike opened this step
     spike_off: Any  # bool [L]
+    # -- lineage plane (BatchedSim(lineage=True) only, else None): the
+    # device edge ring. Each step's events carry their global event id
+    # and, for deliveries, the RECONSTRUCTED full send eid — so a traced
+    # replay's record stream IS the (send_eid -> deliver_eid) edge list,
+    # with zero extra carry (untraced callers discard the record and XLA
+    # DCEs its construction like the rest of the trace).
+    lam: Any = None  # i32 [L,N] post-step Lamport clocks
+    evt_eid: Any = None  # u32 [L,N] this step's event id (EID_NONE = none)
+    sent_eid: Any = None  # u32 [L,N] delivered msg's send eid (EID_NONE)
 
 
 class SimState(NamedTuple):
@@ -461,6 +502,9 @@ class SimState(NamedTuple):
     nem: Any  # NemesisState | None (None unless a nemesis clause is on)
     ctl: Any  # TriageCtl | None (None unless BatchedSim(triage=True))
     cov: Any  # Coverage | None (None unless BatchedSim(coverage=True))
+    lin: Any  # Lineage | None (None unless BatchedSim(lineage=True)):
+    #           per-node Lamport clocks + the global per-lane event
+    #           counter — hot carry, rewritten every step
     queue: Any  # RefillQueue | None — loop-invariant admission queue
     #           (None unless the state was built by init_refill; see
     #           docs/continuous_batching.md)
@@ -679,6 +723,15 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
         "cold.cov.bitmap": u32,
         "cold.cov.hiwater": (0, ctr_hi, False),
         "cold.cov.transitions": (0, ctr_hi, False),
+        # causal-lineage plane (lineage=True): the eid counter gains one
+        # per processed event, so it shares the diagnostics-counter
+        # invariant (events << 2^31 per admission); Lamport clocks live
+        # on the same event-id scale (max(local, send eid)+1 adds at most
+        # one per event); the pool stamp is the send eid's low 16 bits
+        "hot.lin.lam": (0, ctr_hi, False),
+        "hot.lin.eid": (0, ctr_hi, False),
+        "hot.msgs.sent_eid": (0, (1 << 16) - 1, False),
+        "hot.strag.sent_eid": (0, (1 << 16) - 1, False),
         "const.key0": u32,
         "const.ctl.off": (0, (1 << 31) - 1, False),
         "const.ctl.occ": (0, (1 << 31) - 1, False),
@@ -804,18 +857,26 @@ class BatchedSim:
     def __init__(
         self, spec: ProtocolSpec, config: Optional[SimConfig] = None,
         triage: bool = False, coverage: bool = False,
+        lineage: bool = False,
     ) -> None:
         """`triage=True` threads a per-lane `TriageCtl` through the state:
         the same compiled step program then evaluates shrink candidates
         (clauses / occurrences / rates / horizons switched off per lane)
         as lanes of one dispatch — see madsim_tpu/triage.py. `coverage=True`
         additionally accumulates the per-lane Coverage bitmap + scalars the
-        explorer's novelty search feeds on (madsim_tpu/explore.py). Both
-        off by default: normal sweeps pay nothing for either."""
+        explorer's novelty search feeds on (madsim_tpu/explore.py).
+        `lineage=True` carries the causal-lineage plane — per-node Lamport
+        clocks, the global per-lane event counter, and a u16 `sent_eid`
+        stamp per pool slot — so a traced replay records exact
+        happens-before (send_eid -> deliver_eid) edges for
+        madsim_tpu/causal.py (docs/causality.md). All off by default:
+        normal sweeps pay nothing for any of them, and every non-lineage
+        output is bit-identical with lineage on/off."""
         self.spec = spec
         self.config = config or SimConfig()
         self.triage = bool(triage)
         self.coverage = bool(coverage)
+        self.lineage = bool(lineage)
         cfg = self.config
         N = spec.n_nodes
         # fail loudly at construction, not as shape errors deep inside jit
@@ -1288,6 +1349,10 @@ class BatchedSim:
                 dst=jnp.zeros((L, self._B), jnp.uint8),
                 kind=jnp.zeros((L, self._B), self._kind_dtype),
                 payload=jnp.zeros((L, self._B, spec.payload_width), jnp.int32),
+                sent_eid=(
+                    jnp.zeros((L, self._B), jnp.uint16)
+                    if self.lineage else None
+                ),
             )
         else:
             strag = None
@@ -1331,6 +1396,9 @@ class BatchedSim:
                 deliver=jnp.full((L, CK), INF_US, jnp.int32),
                 kind=jnp.zeros((L, CK), self._kind_dtype),
                 payload=jnp.zeros((L, CK, spec.payload_width), jnp.int32),
+                sent_eid=(
+                    jnp.zeros((L, CK), jnp.uint16) if self.lineage else None
+                ),
             ),
             strag=strag,
             nem=nem,
@@ -1342,6 +1410,13 @@ class BatchedSim:
                     transitions=jnp.zeros((L,), jnp.int32),
                 )
                 if self.coverage else None
+            ),
+            lin=(
+                Lineage(
+                    lam=jnp.zeros((L, N), jnp.int32),
+                    eid=jnp.zeros((L,), jnp.uint32),
+                )
+                if self.lineage else None
             ),
             queue=None,
             refill=None,
@@ -1526,6 +1601,54 @@ class BatchedSim:
             m_kind = jnp.where(strag_win, sm_kind, m_kind)
             m_pay = jnp.where(strag_win[:, :, None], sm_pay, m_pay)
         node_ids = jnp.broadcast_to(narange, (L, N))
+
+        # -- 3b. causal lineage (BatchedSim(lineage=True); docs/causality.md)
+        # Event ids: every delivery/timer-fire gets the lane's next global
+        # id, assigned in node order within the step (the same order the
+        # host-side decoder and the host-runtime mirror use). The delivered
+        # slot's u16 sent_eid stamp widens back to the full u32 send eid by
+        # rolling-window reconstruction against the lane's event counter:
+        # every in-flight message was sent at an earlier step, so its eid
+        # is the largest value <= eid-1 congruent to the stamp mod 2^16 —
+        # exact while < 65536 lane events happen during any flight (the
+        # decoder verifies this, never trusts it). Lamport clocks update
+        # max(local, sender)+1 on delivery with the send eid as the
+        # sender's value, +1 on timer fires. OBSERVE-ONLY: nothing here
+        # feeds a draw, a handler, or any non-lineage output.
+        lin: Optional[Lineage] = state.lin
+        if lin is not None:
+            evt_lin = has_msg | due_t  # [L,N]
+            acc_e = jnp.zeros((L,), jnp.uint32)
+            rank_cols = []
+            for n_i in range(N):  # N is small + static: unrolled prefix
+                rank_cols.append(acc_e)
+                acc_e = acc_e + evt_lin[:, n_i].astype(jnp.uint32)
+            evt_eid_full = lin.eid[:, None] + jnp.stack(rank_cols, axis=1)
+            new_lin_eid = lin.eid + acc_e
+            # delivered slot's stamp (same one-hot extraction as m_kind)
+            m_seid16 = (
+                msgs.sent_eid.astype(jnp.int32)[:, None, :] * pick_ohi
+            ).sum(2)
+            if self._B:
+                sm_seid16 = (
+                    strag.sent_eid.astype(jnp.int32)[:, None, :] * s_pick
+                ).sum(2)
+                m_seid16 = jnp.where(strag_win, sm_seid16, m_seid16)
+            prev_e = (lin.eid - jnp.uint32(1))[:, None]  # eids in flight <= this
+            m_seid = prev_e - (
+                (prev_e - m_seid16.astype(jnp.uint32)) & jnp.uint32(0xFFFF)
+            )  # u32 [L,N] full send eid (garbage where ~has_msg, masked below)
+            new_lam = jnp.where(
+                has_msg,
+                jnp.maximum(lin.lam, m_seid.astype(jnp.int32)) + 1,
+                jnp.where(due_t, lin.lam + 1, lin.lam),
+            )
+            tr_lam = new_lam
+            tr_evt_eid = jnp.where(evt_lin, evt_eid_full, EID_NONE)
+            tr_sent_eid = jnp.where(has_msg, m_seid, EID_NONE)
+        else:
+            evt_eid_full = None
+            tr_lam = tr_evt_eid = tr_sent_eid = None
 
         # -- 4. run handlers + fused state select. The three masks are
         # pairwise DISJOINT: at most one event per node per step (msg vs
@@ -2221,6 +2344,22 @@ class BatchedSim:
         )
         new_kind = put(msgs.kind, cand_kind.astype(self._kind_dtype))
         new_payload = put(msgs.payload, cand_pay)
+        if lin is not None:
+            # lineage stamp: a send carries its emitting EVENT's id — the
+            # candidate's source node is static per position, so this is a
+            # constant-index gather; duplicates share their original's
+            # send event (one cause, two deliveries). Freed slots reset to
+            # 0 like deliver resets to INF_US (canonical at-rest state).
+            cand_seid16 = (
+                evt_eid_full[:, self._src_of_c] & jnp.uint32(0xFFFF)
+            ).astype(jnp.uint16)  # [L,C]
+            new_sent_eid = put(
+                jnp.where(valid.any(1), msgs.sent_eid, jnp.uint16(0)),
+                cand_seid16,
+            )
+        else:
+            cand_seid16 = None
+            new_sent_eid = None
 
         # straggler pack: region c owns K4 slots of the side pool
         if self._B:
@@ -2248,6 +2387,13 @@ class BatchedSim:
                 dst=sput(strag.dst, cand_dst.astype(jnp.uint8)),
                 kind=sput(strag.kind, cand_kind.astype(self._kind_dtype)),
                 payload=sput(strag.payload, cand_pay),
+                sent_eid=(
+                    None if lin is None
+                    else sput(
+                        jnp.where(svalid, strag.sent_eid, jnp.uint16(0)),
+                        cand_seid16,
+                    )
+                ),
             )
         else:
             new_strag = None
@@ -2472,11 +2618,16 @@ class BatchedSim:
                 deliver=new_deliver,
                 kind=new_kind,
                 payload=new_payload,
+                sent_eid=new_sent_eid,
             ),
             strag=new_strag,
             nem=new_nem,
             ctl=state.ctl,
             cov=cov,
+            lin=(
+                None if lin is None
+                else Lineage(lam=new_lam, eid=new_lin_eid)
+            ),
             queue=state.queue,
             refill=state.refill,
         )
@@ -2509,6 +2660,9 @@ class BatchedSim:
             unclog=tr_unclog,
             spike_on=tr_spike_on,
             spike_off=tr_spike_off,
+            lam=tr_lam,
+            evt_eid=tr_evt_eid,
+            sent_eid=tr_sent_eid,
         )
         return new_state, record
 

@@ -49,6 +49,13 @@ import jax.numpy as jnp
 # sentinel for "no timer" / "no event" (int32 microseconds)
 INF_US = jnp.int32(2**31 - 1)
 
+# sentinel for "no event id" in the causal-lineage plane (u32 event ids;
+# see engine.Lineage and docs/causality.md). Real eids stay far below it:
+# one id per processed event, and the engine's documented counter
+# invariant (events << 2^31 per admission, engine.interval_hints) keeps
+# the counter from ever reaching the sentinel.
+EID_NONE = jnp.uint32(0xFFFFFFFF)
+
 # --- unbounded virtual time: per-lane epoch + int32 offsets -----------------
 # The engine keeps every time tensor as an int32 OFFSET from a per-lane
 # epoch base; when a lane's clock offset crosses REBASE_US, every live

@@ -38,6 +38,12 @@ class TraceEvent:
     msg_name: str = ""  # human name for msg_kind, if provided
     payload: Optional[tuple] = None
     detail: str = ""
+    # causal lineage (BatchedSim(lineage=True) traces only; -1 otherwise):
+    # this event's global id, the delivered message's send-event id, and
+    # the acting node's post-event Lamport clock — see madsim_tpu/causal.py
+    eid: int = -1
+    sent_eid: int = -1  # deliver events only
+    lam: int = -1
 
     def __str__(self) -> str:
         t = self.t_us / 1e6
@@ -101,6 +107,13 @@ def extract_trace(
     unclog = np.asarray(recs.unclog)[:, lane]
     spike_on = np.asarray(recs.spike_on)[:, lane]
     spike_off = np.asarray(recs.spike_off)[:, lane]
+    # lineage plane (BatchedSim(lineage=True) traces only)
+    has_lin = recs.evt_eid is not None
+    if has_lin:
+        evt_eid = np.asarray(recs.evt_eid, np.int64)[:, lane]  # [T,N]
+        sent_eid = np.asarray(recs.sent_eid, np.int64)[:, lane]
+        lam = np.asarray(recs.lam, np.int64)[:, lane]
+        EID_NONE = 0xFFFFFFFF
 
     T, N = msg_fired.shape
     events: List[TraceEvent] = []
@@ -134,11 +147,27 @@ def extract_trace(
                             else ""
                         ),
                         payload=tuple(int(x) for x in msg_payload[t, n]),
+                        eid=(
+                            int(evt_eid[t, n])
+                            if has_lin and evt_eid[t, n] != EID_NONE else -1
+                        ),
+                        sent_eid=(
+                            int(sent_eid[t, n])
+                            if has_lin and sent_eid[t, n] != EID_NONE else -1
+                        ),
+                        lam=int(lam[t, n]) if has_lin else -1,
                     )
                 )
             if timer_fired[t, n]:
                 node_events.append(
-                    TraceEvent(step=t, t_us=int(t_evt[t, n]), kind="timer", node=n)
+                    TraceEvent(
+                        step=t, t_us=int(t_evt[t, n]), kind="timer", node=n,
+                        eid=(
+                            int(evt_eid[t, n])
+                            if has_lin and evt_eid[t, n] != EID_NONE else -1
+                        ),
+                        lam=int(lam[t, n]) if has_lin else -1,
+                    )
                 )
         node_events.sort(key=lambda e: e.t_us)
         events.extend(node_events)

@@ -66,9 +66,13 @@ from .nemesis import (
 )
 
 # v2 adds the campaign provenance fields (signature/campaign/generation —
-# see madsim_tpu/campaign.py); v1 bundles read back with those defaulted
-BUNDLE_FORMAT = "madsim-tpu-repro/2"
-BUNDLE_FORMATS_READ = ("madsim-tpu-repro/1", BUNDLE_FORMAT)
+# see madsim_tpu/campaign.py); v3 the optional causal digest (the
+# violation's minimal happens-before slice, madsim_tpu/causal.py). Older
+# bundles read back with the newer fields defaulted — replay is unchanged.
+BUNDLE_FORMAT = "madsim-tpu-repro/3"
+BUNDLE_FORMATS_READ = (
+    "madsim-tpu-repro/1", "madsim-tpu-repro/2", BUNDLE_FORMAT,
+)
 
 # an atom is (clause_name, occurrence k | None); k=None means the whole
 # clause (message-level clauses, skew, wipe, and legacy chaos knobs)
@@ -255,6 +259,12 @@ class ReproBundle:
     signature: Optional[str] = None  # campaign.bug_signature dedup key
     campaign: Optional[str] = None  # producing campaign id
     generation: Optional[int] = None  # explorer generation that surfaced it
+    # -- v3: optional causal digest (causal.causal_digest of the shrunk
+    # candidate's violation slice: canonical labels, cone stats, label
+    # sha). None on bundles shrunk without `causal=True` and on every
+    # v1/v2 bundle read back; `repro --explain` recomputes the slice and
+    # cross-checks the sha when present. --
+    causal: Optional[Dict[str, Any]] = None
 
     # -- serialization --
 
@@ -652,6 +662,7 @@ def shrink_seed(
     base_ctl: Optional[Dict[str, Any]] = None,
     refill: bool = True,
     mesh=None,
+    causal: bool = False,
 ) -> ShrinkResult:
     """Shrink one violating seed of a BatchWorkload into a ReproBundle.
 
@@ -875,6 +886,22 @@ def shrink_seed(
         plan=plan_to_json(shrink_plan(plan, dropped, rate_scale)),
         trace_tail=tail,
     )
+    if causal:
+        # optional causal digest (bundle schema v3): one extra
+        # single-lane LINEAGE-enabled traced replay of the final
+        # candidate — the violation's minimal happens-before slice,
+        # canonicalized so cross-witness anatomy can align it
+        # (docs/causality.md). Separate sim: the lineage plane changes
+        # the carry structure, so the shrink dispatches above never pay
+        # for it.
+        from . import causal as causal_mod
+
+        _, sl = causal_mod.explain(
+            spec, cfg, int(seed),
+            ctl=build_ctl(1, final_h, dropped, occ_off, rate_scale),
+            max_steps=max(final["step"] + 2, 64),
+        )
+        bundle.causal = causal_mod.causal_digest(sl)
     path = None
     if out_dir is not None:
         os.makedirs(out_dir, exist_ok=True)
@@ -902,6 +929,8 @@ def shrink_seed(
         # shrink progress (atoms remaining, dispatch cost) at the host
         # boundary — the sweep/ddmin work above is already complete
         telemetry.record_shrink(result, workload=spec.name, seed=int(seed))
+        if bundle.causal is not None:
+            telemetry.record_causal(bundle.causal, workload=spec.name)
     return result
 
 

@@ -614,6 +614,9 @@ def test_dedup_collapses_seed_dense_planted_bug(tmp_path, planted):
         # pure fresh generations: every violation shares the default-ctl
         # coarse group, which is exactly the seed-dense regime dedup is for
         explorer_kwargs={"fresh_frac": 1.0, "mutant_frac": 0.0},
+        # cross-witness causal anatomy (r12): the record's >= 2 witnesses
+        # align into one shared event skeleton (docs/causality.md)
+        anatomy=True, max_anatomy_witnesses=2,
     )
     for _ in range(4):
         c.run(1)
@@ -634,6 +637,13 @@ def test_dedup_collapses_seed_dense_planted_bug(tmp_path, planted):
     # every witness carries its own coverage digest (per-seed evidence;
     # distinct trajectories => the digests need not coincide)
     assert all(w["cov_digest"] for w in bug.witnesses)
+    # cross-witness anatomy: the shared causal-slice skeleton is present,
+    # nonempty, and identical for every aligned witness by construction
+    # (the per-witness remainder is seed-local noise)
+    assert bug.anatomy and "error" not in bug.anatomy, bug.anatomy
+    assert bug.anatomy["skeleton"], "witnesses must share a skeleton"
+    assert len(bug.anatomy["witnesses"]) == 2
+    assert all(w["noise"] >= 0 for w in bug.anatomy["witnesses"])
     # bundle: stamped with signature + campaign provenance, in both dirs
     assert bug.bundle_path and os.path.exists(bug.bundle_path)
     bundle = triage.ReproBundle.load(bug.bundle_path)
@@ -650,6 +660,10 @@ def test_dedup_collapses_seed_dense_planted_bug(tmp_path, planted):
     assert [b.signature for b in c2.bugs] == [bug.signature]
     assert c2._shrinks_done == 1
     assert c2.bugs[0].witness_seeds == bug.witness_seeds
+    # anatomy (policy + computed skeleton) survives the checkpoint
+    assert c2.anatomy is True
+    assert c2.bugs[0].anatomy["skeleton_sha"] == \
+        bug.anatomy["skeleton_sha"]
     # regression replay: green, and the signature is printed (repro v2)
     printed = []
     rep = campaign.regress(reg, spec=wl.spec, out=printed.append)

@@ -1,0 +1,435 @@
+"""Causal explainability (r12, docs/causality.md): in-jit happens-before
+lineage, violation cone slicing, cross-witness bug anatomy.
+
+The contracts under test:
+
+  * OBSERVE-ONLY — every non-lineage output is bit-identical with
+    lineage on/off, on the donated, refill, and sharded paths (same bar
+    coverage=True met in r7); golden digests live in
+    test_state_layout.py, layout/zero-bytes-off pins too.
+  * EXACT DECODE — the u16 sent_eid stamps reconstruct to real send
+    events (verified, never trusted), and the in-jit Lamport clocks
+    equal the pure edge recomputation (the coverage-mirror discipline).
+  * EXPLANATION — the planted deposed-leader re-stamp bug's causal
+    slice names the re-stamp delivery chain, and >= 2 witnesses of the
+    deduped bug share one event skeleton (seed-local noise aligned out).
+"""
+
+import dataclasses
+import json
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import causal, nemesis
+from madsim_tpu.tpu import make_raft_spec
+from madsim_tpu.tpu import nemesis as tpu_nemesis
+from madsim_tpu.tpu.engine import (
+    BatchedSim,
+    refill_results,
+    refill_results_sharded,
+    summarize,
+)
+from madsim_tpu.tpu.spec import SimConfig
+
+CHAOS_PLAN = nemesis.FaultPlan(
+    name="causal-chaos",
+    clauses=(
+        nemesis.Crash(interval_lo_us=300_000, interval_hi_us=900_000,
+                      down_lo_us=200_000, down_hi_us=600_000),
+        nemesis.Partition(interval_lo_us=400_000, interval_hi_us=1_200_000,
+                          heal_lo_us=300_000, heal_hi_us=900_000),
+        nemesis.MsgLoss(rate=0.05),
+    ),
+)
+
+
+def chaotic_cfg(horizon_us=2_000_000):
+    return tpu_nemesis.compile_plan(
+        CHAOS_PLAN, SimConfig(horizon_us=horizon_us)
+    )
+
+
+def strip_lineage(state):
+    """Drop the lineage plane so the remaining pytree can be compared
+    leaf-for-leaf against a lineage-off state."""
+    msgs = state.msgs._replace(sent_eid=None)
+    strag = state.strag
+    if strag is not None:
+        strag = strag._replace(sent_eid=None)
+    return state._replace(lin=None, msgs=msgs, strag=strag)
+
+
+def assert_trees_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------- on/off bit-identity
+
+
+@pytest.mark.chaos
+def test_lineage_on_off_bit_identity_donated():
+    """The acceptance bar: a chaotic sweep's every non-lineage leaf —
+    summaries, coverage, chaos fires included — is bit-identical with
+    lineage on, on the donated (default) path."""
+    spec, cfg = make_raft_spec(), chaotic_cfg()
+    seeds = jnp.arange(16, dtype=jnp.uint32)
+    off = BatchedSim(spec, cfg, coverage=True).run(seeds, max_steps=1200)
+    on = BatchedSim(spec, cfg, coverage=True, lineage=True).run(
+        seeds, max_steps=1200
+    )
+    assert_trees_equal(off, strip_lineage(on))
+    assert summarize(off) == summarize(strip_lineage(on))
+
+
+@pytest.mark.chaos
+def test_lineage_on_off_bit_identity_refill():
+    """Same bar on the continuously batched path: per-admission rows
+    (violations, steps, fires, occ_fired, coverage bitmaps) unchanged."""
+    spec, cfg = make_raft_spec(), chaotic_cfg(horizon_us=600_000)
+    seeds = np.arange(9, dtype=np.uint32)
+    rows = []
+    for lineage in (False, True):
+        sim = BatchedSim(spec, cfg, coverage=True, lineage=lineage)
+        st = sim.run_refill(seeds, lanes=4, max_steps=4_000)
+        rows.append(refill_results(st))
+    a, b = rows
+    for key in ("violated", "violation_step", "steps", "events", "fires",
+                "occ_fired", "cov_bitmap", "overflow", "dead_drops",
+                "clock", "epoch", "retired"):
+        np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+
+
+@pytest.mark.chaos
+def test_lineage_on_off_bit_identity_sharded():
+    """And on the multi-chip shard_map'd path (virtual mesh)."""
+    spec, cfg = make_raft_spec(), chaotic_cfg(horizon_us=600_000)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("devices",))
+    seeds = np.arange(10, dtype=np.uint32)
+    rows = []
+    for lineage in (False, True):
+        sim = BatchedSim(spec, cfg, lineage=lineage)
+        st = sim.run_refill_sharded(seeds, lanes=3, mesh=mesh,
+                                    max_steps=4_000)
+        rows.append(refill_results_sharded(st, admissions=len(seeds)))
+    a, b = rows
+    for key in ("violated", "violation_step", "steps", "events", "fires",
+                "occ_fired"):
+        np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+
+
+# ------------------------------------------------- decode + verification
+
+
+@pytest.mark.chaos
+def test_graph_decode_and_lamport_mirror():
+    """graph_from_trace VERIFIES the lineage plane: every sent_eid stamp
+    resolves to a real earlier event at the recorded source node (the
+    u16 rolling-window reconstruction, checked not trusted), and the
+    in-jit Lamport clocks equal the pure edge recomputation."""
+    spec, cfg = make_raft_spec(), chaotic_cfg()
+    sim = BatchedSim(spec, cfg, lineage=True)
+    for seed in (0, 7):
+        st, recs = sim.run_traced(seed, max_steps=900)
+        g = causal.graph_from_trace(
+            recs, kind_names=spec.msg_kind_names, n_nodes=spec.n_nodes,
+        )
+        assert len(g.events) > 50
+        assert len(g.msg_pred) > 10  # real message edges decoded
+        # eid counter == events processed; final per-node clocks match
+        # the carried plane
+        assert len(g.events) == int(np.asarray(st.lin.eid)[0])
+        mirror = causal.lamport_mirror(g)
+        final_lam = np.asarray(st.lin.lam)[0]
+        for n in range(spec.n_nodes):
+            node_evts = [e for e in g.events.values() if e.node == n]
+            if node_evts:
+                last = max(node_evts, key=lambda e: e.eid)
+                assert mirror[last.eid] == int(final_lam[n])
+
+
+def test_lineage_covers_two_handler_and_straggler_paths():
+    """The stamp plumbing on the OTHER engine paths: the two-handler
+    (per-candidate-ring) pack and the heavy-tail straggler side pool
+    both carry sent_eid stamps that decode and verify."""
+    spec = make_raft_spec()
+    from madsim_tpu.tpu.spec import replace_handlers
+
+    two = replace_handlers(
+        spec, on_message=spec.on_message, on_timer=spec.on_timer,
+    )
+    assert two.on_event is None  # the per-candidate-ring pack path
+    sim = BatchedSim(two, None, lineage=True)
+    _, recs = sim.run_traced(3, max_steps=400)
+    g = causal.graph_from_trace(recs, kind_names=spec.msg_kind_names,
+                                n_nodes=spec.n_nodes)
+    assert len(g.msg_pred) > 10
+
+    cfg = SimConfig(horizon_us=3_000_000, buggify_delay_rate=0.05,
+                    buggify_delay_lo_us=200_000,
+                    buggify_delay_hi_us=800_000)
+    sim2 = BatchedSim(make_raft_spec(), cfg, lineage=True)
+    assert sim2._B > 0  # straggler side pool in the program
+    _, recs2 = sim2.run_traced(5, max_steps=1200)
+    g2 = causal.graph_from_trace(recs2, kind_names=spec.msg_kind_names,
+                                 n_nodes=spec.n_nodes)
+    assert len(g2.msg_pred) > 10
+
+
+def test_graph_rejects_traces_without_lineage():
+    spec, cfg = make_raft_spec(), chaotic_cfg()
+    sim = BatchedSim(spec, cfg)
+    _, recs = sim.run_traced(0, max_steps=200)
+    with pytest.raises(causal.LineageError, match="lineage"):
+        causal.graph_from_trace(recs)
+
+
+def test_lamport_mirror_detects_desync():
+    """The checker is not vacuous: a tampered Lamport value fails."""
+    spec, cfg = make_raft_spec(), chaotic_cfg()
+    sim = BatchedSim(spec, cfg, lineage=True)
+    _, recs = sim.run_traced(0, max_steps=400)
+    from madsim_tpu.tpu.trace import extract_trace
+
+    events = extract_trace(recs, kind_names=spec.msg_kind_names)
+    stamped = [e for e in events if e.eid >= 0]
+    bad = dataclasses.replace(stamped[len(stamped) // 2],
+                              lam=stamped[len(stamped) // 2].lam + 7)
+    tampered = [
+        bad if e is stamped[len(stamped) // 2] else e for e in events
+    ]
+    with pytest.raises(causal.LineageError, match="Lamport"):
+        causal.graph_from_events(tampered, n_nodes=spec.n_nodes)
+
+
+# ------------------------------------------------- cone + slice + anatomy
+
+
+def restamp_workload():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "benches"))
+    try:
+        from ttfb import restamp_workload as rw
+    finally:
+        sys.path.pop(0)
+    return rw()
+
+
+def _violating_seeds(wl, lanes=48):
+    sim = BatchedSim(wl.spec, wl.config)
+    st = sim.run(jnp.arange(lanes, dtype=jnp.uint32), max_steps=20_000)
+    viol = np.nonzero(np.asarray(st.violated))[0]
+    steps = np.asarray(st.violation_step)
+    assert viol.size >= 2, "planted re-stamp must violate on many seeds"
+    return [(int(s), int(steps[s])) for s in viol]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_slice_names_restamp_delivery_chain():
+    """The acceptance bar: the planted deposed-leader re-stamp config's
+    causal slice contains the re-stamp delivery chain — the anchor is
+    the APPEND delivery that exposed the corrupted committed prefix, and
+    the chain walks back through the APPEND/APPEND_RESP traffic that
+    carried the re-stamped entries."""
+    wl = restamp_workload()
+    seed, step = _violating_seeds(wl)[0]
+    g, sl = causal.explain(wl.spec, wl.config, seed, max_steps=step + 2)
+    assert g.violation is not None
+    anchor = g.events[sl.anchor_eid]
+    assert anchor.step == g.violation.step
+    labels = causal.slice_labels(sl)
+    appends = [l for l in labels if l.startswith("deliver:APPEND:")]
+    assert anchor.kind == "deliver" and anchor.msg_name == "APPEND", (
+        "the violating step's event must be the re-stamped APPEND "
+        f"delivery, got {anchor}"
+    )
+    assert len(appends) >= 2, (
+        f"slice must contain the APPEND delivery chain, got {labels[-10:]}"
+    )
+    # the slice is a chain cut from a (much) larger cone
+    assert sl.cone_size >= len(sl.chain)
+    assert sl.depth >= 1
+    # renderers run over the real slice
+    text = causal.format_slice(causal.causal_slice(g, max_len=10))
+    assert "APPEND" in text and "eid=" in text
+    doc = causal.slice_perfetto(sl)
+    assert any(ev.get("ph") == "s" for ev in doc["traceEvents"])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_cross_witness_skeleton_identical():
+    """The acceptance bar: >= 2 witnesses of the deduped re-stamp bug
+    share one skeleton — nonempty, containing the APPEND mechanism, a
+    subsequence of EVERY witness's slice, and deterministic."""
+    from madsim_tpu.campaign import BugRecord, bug_anatomy
+    from madsim_tpu.explore import Candidate, canon_genome
+
+    wl = restamp_workload()
+    seeds = _violating_seeds(wl)[:2]
+    witnesses = [
+        {
+            "seed": s,
+            "candidate": list(canon_genome(Candidate(seed=s).key())),
+            "dispatch": 0, "origin": "fresh", "cov_digest": None,
+        }
+        for s, _ in seeds
+    ]
+    record = BugRecord(
+        signature="sig-test", spec_name=wl.spec.name,
+        violation_kind="invariant", clause_profile=[], witnesses=witnesses,
+        bundle_path=None, campaign="c-test", first_generation=0,
+        coarse_keys=[],
+    )
+    anatomy = bug_anatomy(wl, record)
+    skel = anatomy["skeleton"]
+    assert skel, "witnesses of one bug class must share a skeleton"
+    assert any(l.startswith("deliver:APPEND:") for l in skel)
+    assert len(anatomy["witnesses"]) == 2
+
+    def is_subseq(small, big):
+        it = iter(big)
+        return all(any(x == y for y in it) for x in small)
+
+    for s, _ in seeds:
+        g, sl = causal.explain(wl.spec, wl.config, s,
+                               max_steps=int(wl.max_steps))
+        assert is_subseq(skel, causal.slice_labels(sl)), (
+            f"skeleton must be a subsequence of witness {s}'s slice"
+        )
+        assert anatomy["witnesses"][0]["noise"] >= 0
+    # deterministic: recomputation yields the identical skeleton
+    again = bug_anatomy(wl, record)
+    assert again["skeleton"] == skel
+    assert again["skeleton_sha"] == anatomy["skeleton_sha"]
+    # BugRecord round-trips the anatomy (and old records read back)
+    record.anatomy = anatomy
+    back = BugRecord.from_dict(json.loads(json.dumps(record.to_dict())))
+    assert back.anatomy["skeleton_sha"] == anatomy["skeleton_sha"]
+    doc = record.to_dict()
+    doc.pop("anatomy")
+    assert BugRecord.from_dict(doc).anatomy is None
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_shrink_causal_digest_and_repro_explain(tmp_path):
+    """Bundle schema v3 end to end: shrink the planted re-stamp with
+    causal=True, get the optional causal digest, round-trip it through
+    save/load, and `repro --explain` (library face) recomputes the slice
+    and cross-checks the digest sha."""
+    from madsim_tpu import repro, triage
+    from madsim_tpu.tpu.batch import BatchWorkload
+
+    wl = restamp_workload()
+    seed, _ = _violating_seeds(wl)[0]
+    sr = triage.shrink_seed(
+        wl, seed, out_dir=str(tmp_path),
+        spec_ref="tests.test_triage:planted_restamp_spec",
+        causal=True,
+    )
+    b = sr.bundle
+    assert b.format == "madsim-tpu-repro/3"
+    assert b.causal is not None
+    assert b.causal["labels"] and b.causal["sha"]
+    assert b.causal["cone_size"] >= b.causal["chain_len"]
+    loaded = triage.ReproBundle.load(sr.bundle_path)
+    assert loaded.causal == b.causal
+    lines = []
+    rep = repro.replay_device(
+        loaded, spec=wl.spec, repeats=1, explain=8, out=lines.append,
+    )
+    assert rep["causal"]["sha"] == b.causal["sha"]
+    assert any("causal slice" in ln for ln in lines)
+    del BatchWorkload
+
+
+def test_bundle_v2_reads_back_without_causal():
+    """Back-compat: a v2 bundle document (no causal field) loads, with
+    the digest defaulted to None — old bundles replay unchanged."""
+    from madsim_tpu.triage import ReproBundle
+
+    doc = {
+        "seed": 5, "spec_ref": None, "spec_kwargs": {}, "spec_name": "x",
+        "n_nodes": 3, "config_toml": "", "config_hash": "h",
+        "violation_kind": "invariant", "violation_step": 10,
+        "violation_t_us": 1000, "dropped_clauses": [], "occ_off": {},
+        "rate_scale": {}, "horizon_us": 100, "max_steps": 10,
+        "plan": {"name": "p", "clauses": []}, "trace_tail": [],
+        "format": "madsim-tpu-repro/2", "signature": "s",
+    }
+    b = ReproBundle.from_json(json.dumps(doc))
+    assert b.causal is None and b.signature == "s"
+    # and an unknown field still fails loudly
+    doc["nonesuch"] = 1
+    with pytest.raises(ValueError, match="unknown bundle fields"):
+        ReproBundle.from_json(json.dumps(doc))
+
+
+# ------------------------------------------------- renderers + telemetry
+
+
+def test_shiviz_log_parses():
+    spec, cfg = make_raft_spec(), chaotic_cfg()
+    sim = BatchedSim(spec, cfg, lineage=True)
+    _, recs = sim.run_traced(0, max_steps=300)
+    g = causal.graph_from_trace(recs, kind_names=spec.msg_kind_names,
+                                n_nodes=spec.n_nodes)
+    log = causal.shiviz_log(g)
+    lines = [ln for ln in log.split("\n") if ln]
+    assert len(lines) == 2 * len(g.events)
+    head = re.compile(r"^(node\d+) (\{.*\})$")
+    vcs = causal.vector_clocks(g)
+    for i in range(0, len(lines), 2):
+        m = head.match(lines[i])
+        assert m, lines[i]
+        json.loads(m.group(2))  # valid vector-clock JSON
+    # vector clocks are monotone along message edges
+    for de, se in g.msg_pred.items():
+        assert all(a >= b for a, b in zip(vcs[de], vcs[se]))
+        assert vcs[de] != vcs[se]
+
+
+def test_record_causal_histograms(tmp_path):
+    import madsim_tpu.telemetry as telemetry
+
+    reg = telemetry.enable(out_dir=str(tmp_path))
+    try:
+        telemetry.record_causal(
+            {"depth": 12, "cone_size": 40, "chain_len": 7},
+            workload="raft",
+        )
+        snap = reg.histogram("causal_depth").snapshot(workload="raft")
+        assert snap and snap["count"] == 1 and snap["sum"] == 12
+        snap = reg.histogram("causal_cone_width").snapshot(workload="raft")
+        assert snap and snap["sum"] == 40
+    finally:
+        telemetry.disable()
+
+
+# ------------------------------------------------------- lint satellite
+
+
+def test_causal_module_passes_entropy_lint_without_pragmas():
+    """causal.py is a pure decoder: the ambient-entropy rule passes with
+    ZERO violations and the module carries no suppression pragma (the
+    bar PR 11 set for telemetry.py)."""
+    from madsim_tpu.analysis.lint import check_entropy_file, repo_root
+
+    root = repo_root()
+    path = os.path.join(root, "madsim_tpu", "causal.py")
+    res = check_entropy_file(path, root)
+    assert res.violations == [], res.violations
+    assert res.checked > 0
+    with open(path) as f:
+        assert "madsim: allow" not in f.read()

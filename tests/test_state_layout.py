@@ -114,6 +114,11 @@ LAYOUT = {
     "msgs.deliver": ("int32", (L, 50)),
     "msgs.kind": ("uint8", (L, 50)),
     "msgs.payload": ("int32", (L, 50, 6)),
+    # causal lineage (r12, docs/causality.md): None outside lineage mode
+    # — lineage-off sweeps pay ZERO bytes (structure untouched; the
+    # lineage-mode dtypes are pinned in LINEAGE_LAYOUT below)
+    "msgs.sent_eid": None,
+    "lin": None,
     "strag": None,
     "nem": None,
     "ctl": None,
@@ -159,6 +164,19 @@ REFILL_LAYOUT = {
     "refill.cov_bitmap": None,  # coverage mode only
     "refill.cov_hiwater": None,
     "refill.cov_transitions": None,
+}
+
+# the causal-lineage additions (r12, BatchedSim(lineage=True);
+# docs/causality.md): per-node Lamport clocks + the global per-lane
+# event counter in the hot carry, and a NARROW u16 send-event stamp per
+# pool slot — the stamp is the plane's dominant cost, and u16 (rolling-
+# window reconstruction against the eid counter) is what keeps the
+# whole plane under the 15% carry budget bench_smoke asserts. Silent
+# widening of any of these re-inflates the carry and fails here by name.
+LINEAGE_LAYOUT = {
+    "lin.lam": ("int32", (L, N)),
+    "lin.eid": ("uint32", (L,)),
+    "msgs.sent_eid": ("uint16", (L, 50)),
 }
 
 
@@ -237,6 +255,69 @@ def test_refill_state_layout_table():
     assert "key0" in part["hot"], "refilled lanes must rewrite key0"
     assert any(n.startswith("refill.") for n in part["cold"])
     assert not any(n.startswith("queue.") for n in part["hot"] + part["cold"])
+
+
+def test_lineage_state_layout_table():
+    """Lineage-mode leaves match their declared narrow dtypes, ride the
+    HOT carry (Lamport clocks rewrite every step; a refilled lane adopts
+    fresh ones), and lineage-OFF states carry exactly zero lineage bytes
+    (the `lin`/`msgs.sent_eid` None rows of LAYOUT pin that half)."""
+    from madsim_tpu.tpu.engine import carry_partition
+
+    sim = BatchedSim(make_raft_spec(), lineage=True)
+    st = sim.init(jnp.arange(L, dtype=jnp.uint32))
+    leaves: dict = {}
+    _walk("", st, leaves)
+    declared = dict(LAYOUT)
+    declared.update(LINEAGE_LAYOUT)
+    undeclared = set(leaves) - set(declared)
+    assert not undeclared, (
+        f"lineage state grew undeclared leaves {sorted(undeclared)} — "
+        "declare them in LINEAGE_LAYOUT"
+    )
+    for name, want in LINEAGE_LAYOUT.items():
+        got = leaves[name]
+        dt, shape = want
+        assert str(got.dtype) == dt, (
+            f"lineage layout regression: {name} is {got.dtype}, declared "
+            f"{dt} — the u16 stamp is what keeps the plane inside the "
+            "15% carry budget (docs/causality.md)"
+        )
+        assert tuple(got.shape) == shape, (
+            f"{name}: shape {tuple(got.shape)} != declared {shape}"
+        )
+    part = carry_partition(st)
+    for name in ("lin.lam", "lin.eid", "msgs.sent_eid"):
+        assert name in part["hot"], f"{name} must ride the hot carry"
+
+
+def _golden_lineage_one(name):
+    """The lineage plane is OBSERVE-ONLY: the canonical golden digests
+    (pinned pre-lineage) are unchanged with lineage=True — same bar
+    coverage=True met in r7."""
+    cfg = tpu_nemesis.compile_plan(
+        CHAOS_PLAN, SimConfig(horizon_us=30_000_000)
+    )
+    sim = BatchedSim(SPECS[name](), cfg, lineage=True)
+    st = sim.run(jnp.arange(16, dtype=jnp.uint32), max_steps=1500,
+                 dispatch_steps=1500)
+    assert canonical_digest(st) == GOLDEN[name], (
+        f"{name}: lineage=True changed the golden trajectory digest — "
+        "the lineage plane fed a draw or a handler (docs/causality.md)"
+    )
+    assert summarize(st)["total_events"] > 0
+
+
+@pytest.mark.chaos
+def test_golden_digest_raft_with_lineage():
+    _golden_lineage_one("raft")
+
+
+@pytest.mark.deep
+@pytest.mark.chaos
+@pytest.mark.parametrize("name", ["paxos", "kv", "twopc", "chain"])
+def test_golden_digest_rest_with_lineage(name):
+    _golden_lineage_one(name)
 
 
 def test_cold_const_split_partition():

@@ -23,6 +23,7 @@ bit-identity/repro/overhead tests, which the tier-1 wall budget keeps out
 of the default `-m 'not slow'` run).
 """
 
+import dataclasses
 import json
 import os
 import threading
@@ -448,6 +449,80 @@ def test_perfetto_timeline_matches_format_trace_event_for_event(
         e["args"]["name"] for e in doc["traceEvents"] if e["ph"] == "M"
     }
     assert {f"node{n}" for n in range(wl.spec.n_nodes)} <= names
+
+
+def test_perfetto_flow_pairing_uses_lineage_edges():
+    """The r12 flow-pairing fix: with TWO same-kind messages in flight on
+    ONE link, only the lineage `sent_eid` edges can draw the right
+    arrows — any (src, dst, kind) matching (and the old fall-back of
+    anchoring at the delivery instant) ties them. The regression: two
+    deliveries node0->node1 of the same kind, sent at t=100 and t=200,
+    delivered OUT OF ORDER (reorder window) at t=1300 and t=1250 — the
+    arrow of the t=1300 delivery must start at t=100, the t=1250 one at
+    t=200."""
+    from madsim_tpu.tpu.trace import TraceEvent
+
+    events = [
+        TraceEvent(step=1, t_us=100, kind="timer", node=0, eid=1, lam=1),
+        TraceEvent(step=2, t_us=200, kind="timer", node=0, eid=2, lam=2),
+        # second send overtakes the first (same src, dst, kind!)
+        TraceEvent(step=5, t_us=1250, kind="deliver", node=1, src=0,
+                   msg_kind=3, msg_name="PING", eid=3, sent_eid=2, lam=4),
+        TraceEvent(step=6, t_us=1300, kind="deliver", node=1, src=0,
+                   msg_kind=3, msg_name="PING", eid=4, sent_eid=1, lam=6),
+    ]
+    doc = telemetry.perfetto_from_events(events, n_nodes=2)
+    starts = {e["id"]: e for e in doc["traceEvents"] if e["ph"] == "s"}
+    ends = {e["id"]: e for e in doc["traceEvents"] if e["ph"] == "f"}
+    assert len(starts) == len(ends) == 2
+    arrow_of = {ends[i]["ts"]: starts[i]["ts"] for i in ends}
+    assert arrow_of == {1250: 200, 1300: 100}, (
+        "flow arrows must follow the sent_eid edges, not delivery order"
+    )
+    # delivery anchors expose the edge for tooltip-level debugging
+    xs = [e for e in doc["traceEvents"]
+          if e["ph"] == "X" and e.get("cat") == "deliver"]
+    assert sorted((x["args"]["eid"], x["args"]["sent_eid"]) for x in xs) \
+        == [(3, 2), (4, 1)]
+    # legacy traces (no lineage) keep the old fallback: arrows anchored
+    # at the delivery instant, never a wrong-origin guess
+    legacy = [dataclasses.replace(e, eid=-1, sent_eid=-1) for e in events]
+    doc2 = telemetry.perfetto_from_events(legacy, n_nodes=2)
+    for s in (e for e in doc2["traceEvents"] if e["ph"] == "s"):
+        assert s["ts"] in (1250, 1300)
+
+
+def test_perfetto_lineage_flow_on_real_trace():
+    """End to end on a real lineage-enabled traced replay: every flow
+    arrow starts at its send event's time on the source track, strictly
+    before (or at) the delivery it feeds."""
+    from madsim_tpu.tpu import make_raft_spec
+    from madsim_tpu.tpu.engine import BatchedSim
+    from madsim_tpu.tpu.trace import extract_trace
+
+    spec = make_raft_spec()
+    sim = BatchedSim(spec, None, lineage=True)
+    _, recs = sim.run_traced(3, max_steps=250)
+    events = extract_trace(recs, kind_names=spec.msg_kind_names)
+    by_eid = {e.eid: e for e in events if e.eid >= 0}
+    doc = telemetry.perfetto_from_events(events, n_nodes=spec.n_nodes)
+    starts = {e["id"]: e for e in doc["traceEvents"] if e["ph"] == "s"}
+    ends = {e["id"]: e for e in doc["traceEvents"] if e["ph"] == "f"}
+    delivers = [e for e in events if e.kind == "deliver"]
+    assert delivers and len(starts) == len(delivers)
+    checked = 0
+    for i, f in ends.items():
+        s = starts[i]
+        assert s["ts"] <= f["ts"]
+        # the arrow's start is a real send event's (track, time)
+        d = next(
+            e for e in delivers
+            if e.t_us == f["ts"] and e.node == f["tid"]
+        )
+        send = by_eid[d.sent_eid]
+        assert (s["tid"], s["ts"]) == (send.node, send.t_us)
+        checked += 1
+    assert checked == len(delivers)
 
 
 @pytest.mark.chaos

@@ -1,12 +1,12 @@
 """Ablation attribution of the step cost: time the full step, then steps
 with one phase neutralized. Deltas rank where the milliseconds go.
 
-Methodology (hard-won on the remote-tunnel TPU):
-  * on-device lax.scan chunks — per-step host dispatch costs ms over the
-    tunnel and drowns the signal;
-  * FRESH SEEDS for every timed rep — the tunnel relay caches identical
-    dispatches, so repeating the same input returns in microseconds;
-  * medians over rounds — the chip is shared and contention is bursty.
+Methodology: the shared measurement discipline (`madsim_tpu.measure`,
+via the benches/measure.py shim) — on-device lax.scan chunks (per-step
+host dispatch costs ms over the tunnel and drowns the signal), fresh
+seeds derived per rep index (the tunnel relay caches identical
+dispatches), exact-program warmup, medians over rounds (the chip is
+shared and contention is bursty).
 
 Usage: PYTHONPATH=... python benches/ablate_step.py [--lanes 32768]
 """
@@ -16,27 +16,19 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
-import time
 
 SCAN = 300
 
 
 def measure(sim, lanes, rounds, warm_steps=200):
-    """Median ms/step over `rounds` fresh-seed reps of a SCAN-step chunk."""
-    import jax
-    import jax.numpy as jnp
+    """Median ms/step over `rounds` fresh-seed reps of a SCAN-step chunk
+    (the shared discipline: measure.time_scan_ms)."""
+    from measure import time_scan_ms
 
-    st0 = sim.run_steps(sim.init(jnp.arange(lanes)), warm_steps)
-    jax.block_until_ready(sim.run_steps(st0, SCAN))  # compile both programs
-    walls = []
-    for r in range(1, rounds + 1):
-        st = sim.run_steps(sim.init(jnp.arange(r * lanes, (r + 1) * lanes)),
-                           warm_steps)
-        jax.block_until_ready(st)
-        t0 = time.perf_counter()
-        jax.block_until_ready(sim.run_steps(st, SCAN))
-        walls.append((time.perf_counter() - t0) / SCAN * 1e3)
-    return sorted(walls)[len(walls) // 2]
+    return time_scan_ms(
+        sim.init, sim.run_steps, lanes, scan=SCAN, warm_steps=warm_steps,
+        rounds=rounds,
+    )
 
 
 def main() -> None:

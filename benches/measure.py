@@ -1,0 +1,14 @@
+"""Shim: the shared measurement discipline lives in `madsim_tpu.measure`
+(fresh-seed reps, exact-program warmup, interleaved-round medians,
+scan-on-device timing) so the package — notably the `madsim_tpu.tune`
+autotuner — can import it without sys.path tricks; the benches import it
+from here by its historical name. One implementation, two doors."""
+
+from madsim_tpu.measure import (  # noqa: F401 - re-exported surface
+    SweepTimer,
+    fresh_seeds,
+    interleaved_medians,
+    median,
+    time_scan_ms,
+    time_sweep,
+)

@@ -14,12 +14,19 @@ Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
          python benches/node_sharding.py
 Findings land in docs/perf_notes.md; the shard_state docstring carries
 the conclusion so users can decide without re-measuring.
+
+Timing goes through the shared discipline (`madsim_tpu.measure`
+via the benches/measure.py shim): fresh seeds per rep, and the warmup
+compiles the EXACT (shape, SCAN) program before the timed region — an
+earlier run of this table warmed with a different step count and timed
+the 60-step program's XLA compile, making every cell compile-dominated
+(the perf_notes §1-D caveat; the discipline is regression-pinned in
+tests/test_tune.py).
 """
 
 from __future__ import annotations
 
 import json
-import time
 
 
 def main() -> None:
@@ -59,20 +66,29 @@ def main() -> None:
         row = {"n_nodes": N, "lanes": lanes}
         for name, (nl, nn) in layouts.items():
             m = mesh2(nl, nn)
-            state = sim.init(jnp.arange(lanes))
-            state = sim.shard_state(
-                state, m, lane_axis="seeds",
-                node_axis="nodes" if nn > 1 else None,
-            )
-            # warmup with the SAME step count: run_steps jits per
-            # (shape, n_steps), so a different warmup count would leave
-            # the timed call's XLA compile inside the timing window
-            state = sim.run_steps(state, SCAN)
-            jax.block_until_ready(state)
-            t0 = time.perf_counter()
-            jax.block_until_ready(sim.run_steps(state, SCAN))
+
+            def init(seeds, m=m, nn=nn):
+                return sim.shard_state(
+                    sim.init(jnp.asarray(seeds)), m, lane_axis="seeds",
+                    node_axis="nodes" if nn > 1 else None,
+                )
+
+            # the shared discipline warms the EXACT (shape, SCAN)
+            # program before timing (run_steps jits per (shape, n_steps);
+            # a different warmup count would leave the timed call's XLA
+            # compile inside the timing window) and derives fresh seeds
+            # per rep. warm_steps=SCAN keeps the table's original timed
+            # window: each rep settles through one SCAN chunk (initial
+            # elections, log fill) and times the SECOND — steady-state
+            # stepping, comparable to the perf_notes §1-D cells
+            from measure import time_scan_ms
+
             row[name + "_step_ms"] = round(
-                (time.perf_counter() - t0) / SCAN * 1e3, 3
+                time_scan_ms(
+                    init, sim.run_steps, lanes, scan=SCAN,
+                    warm_steps=SCAN, rounds=1,
+                ),
+                3,
             )
         print(json.dumps(row), flush=True)
 

@@ -77,11 +77,23 @@ def compile_sweep_step(sim, state):
     hot/cold/const split step, with the (hot, cold) carry donated the way
     `_run`'s while_loop aliases it. Accounting bytes for `_step` on the
     flat SimState would charge the loop-invariant ConstState (key0, ctl,
-    skew_ppm) as per-step output traffic the real loop no longer pays."""
+    skew_ppm) as per-step output traffic the real loop no longer pays.
+
+    Memoized per (sim, state shapes): hlo_hbm_bytes, kernel_rows and
+    mem_bytes_per_step all walk the SAME compiled program, and on a real
+    chip this compile is the dominant roofline cost — it must be paid
+    once per (workload, lane count), not once per accounting view."""
     import jax
 
     from madsim_tpu.tpu.engine import split_state
 
+    key = tuple(
+        (leaf.shape, str(leaf.dtype))
+        for leaf in jax.tree_util.tree_leaves(state)
+    )
+    cache = sim.__dict__.setdefault("_sweep_step_compiled", {})
+    if key in cache:
+        return cache[key]
     hot, cold, const = split_state(state)
 
     def loop_body(h, c, k):
@@ -92,7 +104,90 @@ def compile_sweep_step(sim, state):
         return h2, c2
 
     step = jax.jit(loop_body, donate_argnums=(0, 1))
-    return step.lower(hot, cold, const).compile()
+    cache[key] = step.lower(hot, cold, const).compile()
+    return cache[key]
+
+
+# shapes like s32[32768,5,70] / pred[32768,70]{...}; tuples handled by
+# summing their leaf shapes
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+    "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8,
+}
+# HLO opcodes that are bookkeeping, not kernels (no HBM traffic of their
+# own after buffer assignment)
+_NON_KERNEL_OPS = (
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+)
+
+
+def _shape_bytes(shape_str: str) -> int:
+    import re
+
+    total = 0
+    for m in re.finditer(r"(\w+)\[([\d,]*)\]", shape_str):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _DTYPE_BYTES:
+            continue
+        size = 1
+        if dims:
+            for d in dims.split(","):
+                size *= int(d)
+        total += size * _DTYPE_BYTES[dt]
+    return total
+
+
+def _entry_lines(txt: str) -> list:
+    """The entry computation's instruction lines ("ENTRY %name ... {" to
+    its closing brace), stripped."""
+    entry = []
+    in_entry = False
+    for line in txt.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if line.startswith("}"):
+                break
+            entry.append(line.strip())
+    return entry
+
+
+def _entry_kernels(txt: str) -> list:
+    """(name, opcode, out_bytes, read_bytes) per top-level kernel of the
+    entry computation — the shared parse behind `hlo_hbm_bytes` and
+    `kernel_rows`. After XLA fusion each remaining top-level instruction
+    is one launched kernel: it reads its named operands from HBM and
+    writes its result; fusion-internal values never materialize."""
+    import re
+
+    entry = _entry_lines(txt)
+    # name -> bytes for all top-level results + parameters (operand reads
+    # are charged by name: optimized HLO references operands by name only)
+    name_bytes = {}
+    for line in entry:
+        m = re.match(r"(%?[\w.\-]+) = (\([^)]*\)|[^ ]+) ([\w\-]+)", line)
+        if m:
+            name_bytes[m.group(1).lstrip("%")] = _shape_bytes(m.group(2))
+    kernels = []
+    for line in entry:
+        m = re.match(
+            r"(%?[\w.\-]+) = (\([^)]*\)|[^ ]+) ([\w\-]+)\((.*)\)", line
+        )
+        if not m:
+            continue
+        name, shape_str, opcode, operands = m.groups()
+        if opcode in _NON_KERNEL_OPS:
+            continue
+        read_b = sum(
+            name_bytes.get(op.group(1), 0)
+            for op in re.finditer(r"%([\w.\-]+)", operands)
+        )
+        kernels.append(
+            (name.lstrip("%"), opcode, _shape_bytes(shape_str), read_b)
+        )
+    return kernels
 
 
 def hlo_hbm_bytes(sim, state) -> dict:
@@ -105,94 +200,79 @@ def hlo_hbm_bytes(sim, state) -> dict:
     cost_analysis()['bytes accessed'], which counts every HLO operand as
     if materialized and overcounts several-fold."""
     import collections
-    import re
 
     compiled = compile_sweep_step(sim, state)
-    txt = compiled.as_text()
-    # shapes like s32[32768,5,70] / pred[32768,70]{...}; tuples handled by
-    # summing their leaf shapes.
-    dtype_bytes = {
-        "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
-        "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-        "f64": 8,
-    }
-
-    def shape_bytes(shape_str: str) -> int:
-        total = 0
-        for m in re.finditer(r"(\w+)\[([\d,]*)\]", shape_str):
-            dt, dims = m.group(1), m.group(2)
-            if dt not in dtype_bytes:
-                continue
-            size = 1
-            if dims:
-                for d in dims.split(","):
-                    size *= int(d)
-            total += size * dtype_bytes[dt]
-        return total
-
-    # find the entry computation: "ENTRY %name (...) -> ... {"
-    entry = []
-    in_entry = False
-    for line in txt.splitlines():
-        if line.startswith("ENTRY "):
-            in_entry = True
-            continue
-        if in_entry:
-            if line.startswith("}"):
-                break
-            entry.append(line.strip())
-
-    traffic = 0
+    kernels = _entry_kernels(compiled.as_text())
     by_op = collections.Counter()
-    n_kernels = 0
-    for line in entry:
-        # "%name = <shape> <opcode>(operands...)" — result bytes
-        m = re.match(r"%?[\w.\-]+ = (\([^)]*\)|[^ ]+) ([\w\-]+)\(", line)
-        if not m:
-            continue
-        shape_str, opcode = m.group(1), m.group(2)
-        if opcode in ("parameter", "constant", "get-tuple-element", "tuple",
-                      "bitcast"):
-            continue
-        out_b = shape_bytes(shape_str)
-        # operand reads: parse operand shapes when annotated; optimized HLO
-        # references operands by name only, so charge reads via a second
-        # pass below instead.
-        traffic += out_b
+    for _name, opcode, out_b, _read_b in kernels:
         by_op[opcode] += out_b
-        n_kernels += 1
-
-    # operand reads: every top-level op reads its operands from HBM. Build
-    # name -> bytes for all top-level results + parameters, then charge
-    # each op's named operands.
-    name_bytes = {}
-    for line in entry:
-        m = re.match(r"(%?[\w.\-]+) = (\([^)]*\)|[^ ]+) ([\w\-]+)", line)
-        if m:
-            name_bytes[m.group(1).lstrip("%")] = shape_bytes(m.group(2))
-    read_traffic = 0
-    for line in entry:
-        m = re.match(r"%?[\w.\-]+ = (\([^)]*\)|[^ ]+) ([\w\-]+)\((.*)\)", line)
-        if not m:
-            continue
-        opcode = m.group(2)
-        if opcode in ("parameter", "constant", "get-tuple-element", "tuple",
-                      "bitcast"):
-            continue
-        for op in re.finditer(r"%([\w.\-]+)", m.group(3)):
-            read_traffic += name_bytes.get(op.group(1), 0)
+    traffic = sum(k[2] for k in kernels)
+    read_traffic = sum(k[3] for k in kernels)
 
     mem = compiled.memory_analysis()
     return {
         "hbm_write_bytes": traffic,
         "hbm_read_bytes": read_traffic,
         "hbm_model_bytes": traffic + read_traffic,
-        "n_top_level_kernels": n_kernels,
+        "n_top_level_kernels": len(kernels),
         "top_write_ops": dict(by_op.most_common(8)),
         "temp_bytes": getattr(mem, "temp_size_in_bytes", None),
         "arg_bytes": getattr(mem, "argument_size_in_bytes", None),
         "out_bytes": getattr(mem, "output_size_in_bytes", None),
     }
+
+
+def kernel_rows(sim, state, top: int = 12) -> list:
+    """PER-FUSED-KERNEL HBM attribution (r13; the BENCH `kernel_rows`
+    key): the sweep-step program's top-level kernels ranked by modeled
+    HBM bytes (result written + operands read — the `hlo_hbm_bytes`
+    traffic model, per kernel), each with its estimated share of step
+    TIME. The step is bandwidth-bound (docs/perf_notes.md), so a
+    kernel's byte share IS its time share to first order — this is the
+    steering table a perf round (or the autotuner's future knob
+    proposals) reads to know which fusion to attack next. Kernels below
+    the top `top` fold into one "(other)" row so the table stays
+    readable; shares always sum to ~100."""
+    compiled = compile_sweep_step(sim, state)
+    kernels = _entry_kernels(compiled.as_text())
+    total = sum(out_b + read_b for _n, _o, out_b, read_b in kernels) or 1
+    ranked = sorted(
+        kernels, key=lambda k: k[2] + k[3], reverse=True
+    )
+    rows = []
+    for name, opcode, out_b, read_b in ranked[: max(0, int(top))]:
+        rows.append({
+            "kernel": name,
+            "op": opcode,
+            "write_bytes": out_b,
+            "read_bytes": read_b,
+            "bytes": out_b + read_b,
+            "time_share_pct": round((out_b + read_b) / total * 100, 2),
+        })
+    rest = ranked[max(0, int(top)):]
+    if rest:
+        out_b = sum(k[2] for k in rest)
+        read_b = sum(k[3] for k in rest)
+        rows.append({
+            "kernel": f"(other x{len(rest)})",
+            "op": "(other)",
+            "write_bytes": out_b,
+            "read_bytes": read_b,
+            "bytes": out_b + read_b,
+            "time_share_pct": round((out_b + read_b) / total * 100, 2),
+        })
+    return rows
+
+
+def workload_kernel_rows(sim, lanes: int, top: int = 12) -> list:
+    """`kernel_rows` for a workload at a lane count. The attribution is
+    a walk of the COMPILED step's HLO text, which depends on state
+    shapes only — never on values — so a fresh init suffices (no settle
+    steps), and the compile itself is shared with the roofline rows via
+    the compile_sweep_step memo."""
+    import jax.numpy as jnp
+
+    return kernel_rows(sim, sim.init(jnp.arange(lanes)), top=top)
 
 
 def state_bytes(state) -> int:
@@ -344,7 +424,8 @@ def workload_roofline_row(sim, lanes: int, bw_gbs: float, scan: int = 300,
         "carry_floor_ms": round(floor_ms, 3),
     }
     if timed:
-        ms = time_step_ms(sim, state, scan, lanes=lanes)
+        ms = time_step_ms(sim, state, scan, lanes=lanes,
+                          warm_steps=warm_steps)
         row.update({
             "step_ms": round(ms, 3),
             "achieved_gbs": round(
@@ -380,39 +461,20 @@ def per_workload_roofline(lanes: int = 32768, scan: int = 300,
 
 def _spread_mix_sim(virtual_secs: float):
     """The 10x-horizon-spread workload mix's sim (shared by
-    refill_occupancy and mesh_scaling): raft under a crash+loss plan."""
-    from madsim_tpu import nemesis as nem
-    from madsim_tpu.tpu import make_raft_spec
-    from madsim_tpu.tpu import nemesis as tn
-    from madsim_tpu.tpu.engine import BatchedSim
-    from madsim_tpu.tpu.spec import SimConfig
+    refill_occupancy and mesh_scaling): raft under a crash+loss plan.
+    ONE definition lives in madsim_tpu.tune — the r13 tuner measures the
+    same mix these tables report on, so the two can never drift onto
+    different workloads."""
+    from madsim_tpu.tune import spread_mix_sim
 
-    horizon = int(virtual_secs * 1e6)
-    plan = nem.FaultPlan(name="refill-occ", clauses=(
-        nem.Crash(interval_lo_us=horizon // 6, interval_hi_us=horizon // 2,
-                  down_lo_us=horizon // 8, down_hi_us=horizon // 3),
-        nem.MsgLoss(rate=0.05),
-    ))
-    cfg = tn.compile_plan(plan, SimConfig(horizon_us=horizon))
-    return BatchedSim(make_raft_spec(), cfg, triage=True), horizon
+    return spread_mix_sim(virtual_secs)
 
 
 def _spread_ctl_rows(h):
     """Per-admission TriageCtl rows for a horizon column `h` (int64 us)."""
-    import numpy as np
+    from madsim_tpu.tune import spread_ctl_from_h
 
-    import jax.numpy as jnp
-    from madsim_tpu.tpu.engine import TriageCtl
-    from madsim_tpu.tpu.spec import REBASE_US
-
-    n = len(h)
-    return TriageCtl(
-        off=jnp.zeros((n,), jnp.int32),
-        occ=jnp.zeros((n, 4), jnp.int32),
-        rate_scale=jnp.ones((n, 3), jnp.float32),
-        h_epoch=jnp.asarray((h // REBASE_US).astype(np.int32)),
-        h_off=jnp.asarray((h % REBASE_US).astype(np.int32)),
-    )
+    return spread_ctl_from_h(h)
 
 
 def mesh_scaling(
@@ -582,23 +644,22 @@ def step_cost(sim, state):
     }
 
 
-def time_step_ms(sim, state, scan: int, reps: int = 3, lanes: int = 0) -> float:
-    """Median per-step ms over `reps` fresh-seed scan chunks (the bench
-    methodology: fresh seeds defeat the tunnel relay's dispatch cache)."""
-    import jax
-    import jax.numpy as jnp
+def time_step_ms(sim, state, scan: int, reps: int = 3, lanes: int = 0,
+                 warm_steps: int = 200) -> float:
+    """Median per-step ms over `reps` fresh-seed scan chunks, through the
+    shared measurement discipline (madsim_tpu.measure.time_scan_ms:
+    fresh seeds per rep, the EXACT (shape, scan) program warmed before
+    timing). `state` is accepted for caller symmetry; the discipline
+    rebuilds its own settled states from the rep index, settled
+    `warm_steps` deep — the SAME depth the caller's accounting state
+    used, so timing and bytes accounting describe one regime."""
+    del state  # the discipline derives every rep's state from its index
+    from madsim_tpu.measure import time_scan_ms
 
-    jax.block_until_ready(sim.run_steps(state, scan))
-    walls = []
-    for r in range(1, reps + 1):
-        st = sim.run_steps(
-            sim.init(jnp.arange(r * lanes, (r + 1) * lanes)), 200
-        )
-        jax.block_until_ready(st)
-        t0 = time.perf_counter()
-        jax.block_until_ready(sim.run_steps(st, scan))
-        walls.append((time.perf_counter() - t0) / scan * 1e3)
-    return sorted(walls)[len(walls) // 2]
+    return time_scan_ms(
+        sim.init, sim.run_steps, lanes, scan=scan, warm_steps=warm_steps,
+        rounds=reps,
+    )
 
 
 def roofline(lanes: int = 32768, scan: int = 300, variants: bool = True) -> dict:
@@ -735,9 +796,23 @@ def main() -> None:
         help="emit the continuous-batching lane-occupancy row (refill vs "
         "chunked on a 10x horizon-spread mix) instead of the deep dive",
     )
+    parser.add_argument(
+        "--kernels", action="store_true",
+        help="emit the per-fused-kernel HBM attribution of the headline "
+        "raft step (bytes + estimated time share per kernel) instead of "
+        "the deep dive",
+    )
     args = parser.parse_args()
     if args.occupancy:
         print(json.dumps(refill_occupancy()), flush=True)
+        return
+    if args.kernels:
+        sims = workload_sims(args.lanes)
+        sim, lanes, _steps = sims["raft"]
+        print(
+            json.dumps({"kernel_rows": workload_kernel_rows(sim, lanes)}),
+            flush=True,
+        )
         return
     if args.per_workload:
         print(json.dumps(per_workload_roofline(args.lanes, args.scan)),

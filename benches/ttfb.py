@@ -171,9 +171,9 @@ PLANTED = {
 
 
 def measure_ttfb(
-    workload, chunk: int = 1024, max_seeds: int = 8192,
+    workload, chunk: "int | None" = None, max_seeds: int = 8192,
     shrink: bool = True, out_dir: "str | None" = None,
-    lane_width: int = 16, refill: int = 0,
+    lane_width: int = 16, refill: int = 0, tuning=None,
 ) -> dict:
     """Sweep seeds in chunks from a COLD runtime until the first violation,
     then shrink it to a ReproBundle. The chunk loop is double-buffered like
@@ -199,6 +199,31 @@ def measure_ttfb(
     from madsim_tpu.tpu.batch import pipelined
     from madsim_tpu.tpu.engine import BatchedSim, refill_results
     from madsim_tpu.tpu.spec import REBASE_US
+
+    if tuning is not None and chunk is None:
+        # Tier-A, CHUNK ONLY (docs/tuning.md): ttfb's headline is defined
+        # as a chunked-vs-refill A/B, so a tuned entry may resize the
+        # chunk (where the caller kept the default) but must never flip
+        # which path a row measures — tuned refill_lanes is deliberately
+        # NOT applied here. An explicit chunk skips the lookup entirely:
+        # the cache could not affect the sweep, so a bad entry must not
+        # be able to abort it either.
+        from madsim_tpu import tune as _tune
+        from madsim_tpu.tpu.spec import SimConfig
+
+        # resolve at the SWEEP scale (max_seeds), matching run_batch's
+        # seeds_arr.size convention — the lane bucket is the scale of
+        # the whole sweep, not of one chunk. config normalized like
+        # every other consumer: None hashes as the default SimConfig()
+        # the engine would run, so all entry points compute one key.
+        tn = _tune.resolve_tuning(
+            tuning, workload.spec.name, workload.config or SimConfig(),
+            max_seeds,
+        )
+        if tn.get("chunk") and chunk is None:
+            chunk = int(tn["chunk"])
+    if chunk is None:
+        chunk = 1024
 
     t0 = time.perf_counter()
     sim = BatchedSim(workload.spec, workload.config)
@@ -312,14 +337,16 @@ def measure_ttfb(
     return out
 
 
-def ttfb_all(chunk: int = 1024, max_seeds: int = 8192,
+def ttfb_all(chunk: "int | None" = None, max_seeds: int = 8192,
              shrink: bool = True, host_baseline: bool = True,
-             host_deadline_s: float = 180.0, refill: int = 64) -> dict:
+             host_deadline_s: float = 180.0, refill: int = 64,
+             tuning=None) -> dict:
     rows = {}
     for name, (factory, host_fn) in PLANTED.items():
         try:
             row = measure_ttfb(
-                factory(), chunk=chunk, max_seeds=max_seeds, shrink=shrink
+                factory(), chunk=chunk, max_seeds=max_seeds, shrink=shrink,
+                tuning=tuning,
             )
         except Exception as e:  # noqa: BLE001 - one bad config must not
             # hide the other's number
@@ -327,11 +354,14 @@ def ttfb_all(chunk: int = 1024, max_seeds: int = 8192,
         if refill:
             # the continuously batched sweep of the same config (cold
             # runtime again): must identify the SAME violation (seed /
-            # step / virtual time); only wall-clock may move
+            # step / virtual time); only wall-clock may move. Same
+            # `tuning` as the chunked leg — measure_ttfb applies chunk
+            # only, so both legs run the same chunk size and the A/B
+            # isolates the refill-vs-chunked effect.
             try:
                 r2 = measure_ttfb(
                     factory(), chunk=chunk, max_seeds=max_seeds,
-                    shrink=False, refill=refill,
+                    shrink=False, refill=refill, tuning=tuning,
                 )
                 row["refill"] = {
                     k: r2.get(k) for k in (
@@ -374,7 +404,11 @@ def ttfb_all(chunk: int = 1024, max_seeds: int = 8192,
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--chunk", type=int, default=1024)
+    parser.add_argument(
+        "--chunk", type=int, default=None,
+        help="seeds per dispatch (default 1024; omit to let a tuned "
+        "cache entry resize it when tuning is wired through)",
+    )
     parser.add_argument("--max-seeds", type=int, default=8192)
     parser.add_argument("--no-shrink", action="store_true")
     parser.add_argument("--no-host", action="store_true")
@@ -384,12 +418,19 @@ def main() -> None:
         help="also sweep each config continuously batched over LANES "
         "lanes (0 disables)",
     )
+    parser.add_argument(
+        "--tuning", default=None, metavar="AUTO|PATH",
+        help="consult the tuned-config cache ('auto') or a saved entry "
+        "for the sweep chunk — chunk only, applied to BOTH A/B legs "
+        "(docs/tuning.md); default: the hand-pinned 1024",
+    )
     args = parser.parse_args()
     print(
         json.dumps(ttfb_all(
             args.chunk, args.max_seeds, shrink=not args.no_shrink,
             host_baseline=not args.no_host,
             host_deadline_s=args.host_deadline, refill=args.refill,
+            tuning=args.tuning,
         )),
         flush=True,
     )

@@ -1,0 +1,145 @@
+"""tune-smoke: <60 s CPU gate for the measured autotuner (ISSUE 15).
+
+Three structural assertions, no wall-clock thresholds (wall times print
+for eyes only):
+
+  * NEVER A REGRESSION: one Tier-A coordinate pass on the 10x
+    horizon-spread mix (the continuous-batching headline workload) must
+    return an entry whose tuned seeds/s >= the hand-pinned default's —
+    guaranteed by the tuner's final A/B guard, which falls back to the
+    defaults whenever no candidate beats them; the smoke asserts the
+    invariant held and that the entry round-trips through the
+    `madsim-tpu-tuned/1` cache.
+  * TIER-A BIT-IDENTITY: running the same admissions under the TUNED
+    dispatch knobs and under the defaults yields bit-identical
+    per-admission rows (violations, steps, violation steps) — the
+    contract that lets `tuning="auto"` apply anywhere, even
+    mid-campaign.
+  * TIER-B GATE: a planted drop-inducing pool config (slot budget
+    squeezed until the acceptance sweep overflows) is REJECTED by
+    `tier_b_gate`, while its clean twin passes — a trajectory-affecting
+    knob never reaches the cache without the zero-drop proof.
+
+Usage: python benches/tune_smoke.py  (or `make tune-smoke`)
+Exit code != 0 on any assertion failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LANES = 8
+WAVES = 8
+VIRTUAL_SECS = 0.5
+MAX_STEPS = 30_000
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    import numpy as np
+
+    from madsim_tpu import tune
+    from madsim_tpu.tpu.engine import refill_results
+
+    failures = []
+    with tempfile.TemporaryDirectory() as cache_dir:
+        # -- one Tier-A coordinate pass on the spread mix ------------------
+        entry = tune.tune_spread_mix(
+            lanes=LANES, waves=WAVES, virtual_secs=VIRTUAL_SECS,
+            max_steps=MAX_STEPS, cache_dir=cache_dir, save=True,
+        )
+        if entry.tuned_seeds_per_sec < entry.baseline_seeds_per_sec:
+            failures.append(
+                f"tuner returned a config slower than the hand-pinned "
+                f"default ({entry.tuned_seeds_per_sec} < "
+                f"{entry.baseline_seeds_per_sec} seeds/s) — the A/B guard "
+                "must fall back, never regress"
+            )
+        sim, horizon = tune.spread_mix_sim(VIRTUAL_SECS)
+        again = tune.load_tuned(
+            "spread-mix", sim.config, LANES, dir=cache_dir
+        )
+        if again is None or again != entry:
+            failures.append("tuned-cache round-trip did not reproduce the entry")
+
+        # -- Tier-A bit-identity: tuned vs default dispatch knobs ----------
+        A = LANES * WAVES
+        ctl = tune.spread_ctl_rows(horizon, A)
+        seeds = np.arange(A, dtype=np.uint32)
+        from madsim_tpu.tpu.engine import DEFAULT_DISPATCH_STEPS
+
+        default = {"refill_lanes": LANES,
+                   "dispatch_steps": DEFAULT_DISPATCH_STEPS}
+        tuned = {**default, **entry.dispatch}
+        rows = {}
+        for tag, knobs in (("default", default), ("tuned", tuned)):
+            t1 = time.perf_counter()
+            st = sim.run_refill(
+                seeds, lanes=int(knobs["refill_lanes"]),
+                max_steps=MAX_STEPS,
+                dispatch_steps=int(knobs["dispatch_steps"]), ctl=ctl,
+            )
+            res = refill_results(st)
+            rows[tag] = {
+                "violated": np.asarray(res["violated"]),
+                "steps": np.asarray(res["steps"]),
+                "violation_step": np.asarray(res["violation_step"]),
+                "wall_ms": round((time.perf_counter() - t1) * 1e3, 1),
+            }
+        for k in ("violated", "steps", "violation_step"):
+            if not np.array_equal(rows["default"][k], rows["tuned"][k]):
+                failures.append(
+                    f"Tier-A bit-identity broken: per-admission {k} rows "
+                    "differ between tuned and default dispatch knobs"
+                )
+
+    # -- Tier-B gate: planted dropping config vs its clean twin ------------
+    from madsim_tpu.tpu import raft_workload
+
+    wl = dataclasses.replace(
+        raft_workload(virtual_secs=VIRTUAL_SECS), host_repro=None
+    )
+    clean = tune.tier_b_gate(wl, wl.config, seeds=48, certify=False)
+    if not clean["ok"]:
+        failures.append(
+            f"Tier-B gate rejected the clean twin: {clean['reasons']}"
+        )
+    planted = dataclasses.replace(
+        wl.config, msg_capacity=8, msg_depth_msg=None
+    )
+    bad = tune.tier_b_gate(wl, planted, seeds=48, certify=False)
+    if bad["ok"]:
+        failures.append(
+            "Tier-B gate ACCEPTED the planted drop-inducing pool config "
+            "(msg_capacity=8) — the overflow check is dead"
+        )
+
+    out = {
+        "entry": entry.to_doc(),
+        "bit_identity": {
+            "admissions": A,
+            "default_wall_ms": rows["default"]["wall_ms"],
+            "tuned_wall_ms": rows["tuned"]["wall_ms"],
+        },
+        "tier_b_gate": {
+            "clean_ok": clean["ok"],
+            "planted_rejected": not bad["ok"],
+            "planted_reasons": bad["reasons"][:2],
+        },
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "failures": failures,
+    }
+    print(json.dumps(out), flush=True)
+    if failures:
+        raise SystemExit("TUNE-SMOKE RED: " + "; ".join(failures))
+
+
+if __name__ == "__main__":
+    main()

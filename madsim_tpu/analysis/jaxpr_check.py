@@ -765,6 +765,24 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
         closed_sharded = jax.make_jaxpr(
             lambda st: sim._sharded_segment(mesh, 8)(st)
         )(stacked)
+    trace = _finish_trace(
+        sim, state, hot, cold, const, name=name, lanes=lanes,
+        refill=refill, sharded=sharded, closed_sharded=closed_sharded,
+    )
+    _TRACE_CACHE[key] = trace
+    return trace
+
+
+def _finish_trace(
+    sim, state, hot, cold, const, name: str, lanes: int,
+    refill: bool = False, sharded: bool = False, closed_sharded=None,
+) -> WorkloadTrace:
+    """The shared trace-construction tail (abstract jaxprs + leaf-name
+    registries) over an already-built sim/state partition — split out of
+    get_trace so `trace_sim` can certify ARBITRARY (spec, config) pairs,
+    not just the in-tree workload registry."""
+    from ..tpu.engine import named_leaves
+
     closed = jax.make_jaxpr(sim._step_split)(hot, cold, const)
     out_template = jax.eval_shape(sim._step_split, hot, cold, const)
     seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
@@ -776,7 +794,7 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
         + [n for n, _ in named_leaves(c2, "cold")]
         + [n for n, _ in named_leaves(rec, "rec")]
     )
-    trace = WorkloadTrace(
+    return WorkloadTrace(
         name=name, lanes=lanes, sim=sim, state=state,
         hot=hot, cold=cold, const=const,
         closed_step=closed, out_template=out_template,
@@ -793,8 +811,23 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
         sharded=sharded,
         closed_sharded=closed_sharded,
     )
-    _TRACE_CACHE[key] = trace
-    return trace
+
+
+def trace_sim(sim, name: str = "custom", lanes: int = LANES) -> WorkloadTrace:
+    """A WorkloadTrace over an ARBITRARY BatchedSim (uncached, abstract —
+    ShapeDtypeStructs only, no compile, no device).
+
+    The autotuner's Tier-B gate re-runs the range certifier on every
+    TUNED config through this before it is cached
+    (madsim_tpu/tune.py, docs/tuning.md): the in-tree `get_trace`
+    registry pins the shipped configs, but a tuned pool layout is a new
+    program and must re-earn its range certificate."""
+    from ..tpu.engine import split_state
+
+    seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
+    state = jax.eval_shape(sim._init, seeds)
+    hot, cold, const = split_state(state)
+    return _finish_trace(sim, state, hot, cold, const, name=name, lanes=lanes)
 
 
 def verify_workload(

@@ -597,14 +597,34 @@ class Campaign:
         spec_kwargs: Optional[Dict[str, Any]] = None,
         regression_dir: Optional[str] = None,
         sim=None,
-        pipeline: bool = True,
+        pipeline: Optional[bool] = None,
         log: Optional[Callable[[str], None]] = None,
         explorer_kwargs: Optional[Dict[str, Any]] = None,
         anatomy: bool = False,
         max_anatomy_witnesses: int = 4,
+        tuning: Any = None,
     ) -> None:
         self.workload = workload
         self.dir = str(dir)
+        # measured tuning (madsim_tpu/tune.py, docs/tuning.md): resolved
+        # ONCE at construction — "auto" consults the device's tuned-config
+        # cache here and never again, and the RESOLVED Tier-A dict is what
+        # the checkpoint persists, so kill/resume replays the exact same
+        # dispatch shape without re-tuning (and `check_resume_conflicts`
+        # loudly rejects a resume under a different tuned cache). Tier-B
+        # knobs never enter here: they are part of the workload's
+        # SimConfig, guarded by the resume config-hash check.
+        self.tuning: Optional[Dict[str, Any]] = None
+        if tuning is not None:
+            from . import tune as _tune
+
+            from .tpu.spec import SimConfig
+
+            resolved = _tune.resolve_tuning(
+                tuning, workload.spec.name,
+                workload.config or SimConfig(), int(lanes),
+            )
+            self.tuning = resolved or None
         self.shrink = bool(shrink)
         self.max_shrinks = int(max_shrinks)
         # cross-witness causal anatomy (docs/causality.md): like shrink /
@@ -621,9 +641,14 @@ class Campaign:
         self.spec_ref = spec_ref
         self.spec_kwargs = dict(spec_kwargs or {})
         self.say = log or (lambda msg: None)
+        # pipeline rides the Explorer's None sentinel so a tuned value can
+        # land when the caller omitted it; explorer_params persists the
+        # APPLIED ex.pipeline, so resume replays the real dispatch shape
+        # explicitly (an explicit arg wins over the tuned dict there)
         self.ex = Explorer(
             workload, meta_seed=meta_seed, lanes=lanes, chunk=chunk,
             shrink_violations=False, pipeline=pipeline, sim=sim, log=log,
+            tuning=self.tuning,
             **(explorer_kwargs or {}),
         )
         self.campaign_id = campaign_id or default_campaign_id(self.ex)
@@ -800,6 +825,11 @@ class Campaign:
             },
             "seen_violations": self._seen_violations,
             "shrinks_done": self._shrinks_done,
+            # the RESOLVED Tier-A tuning this campaign runs under (None =
+            # hand-pinned defaults): resume replays it verbatim — never
+            # re-tunes — and a resume under a different tuned cache is a
+            # loud check_resume_conflicts reject
+            "tuning": self.tuning,
             "kind": "campaign",
         }
         return save_checkpoint(
@@ -815,6 +845,7 @@ class Campaign:
         sim=None,
         regression_dir: Optional[str] = None,
         log: Optional[Callable[[str], None]] = None,
+        tuning: Any = None,
     ) -> "Campaign":
         """Rebuild a campaign from its checkpoint: same workload (rebuilt
         from the manifest for named workloads, else passed in), same
@@ -842,6 +873,28 @@ class Campaign:
                 "name": man["workload"]["name"],
                 "virtual_secs": man["workload"].get("virtual_secs", 2.0),
             }
+        # the checkpoint's RESOLVED tuning is authoritative: resume never
+        # re-tunes ("auto" was resolved once, at campaign creation). An
+        # explicitly passed tuning= must resolve to the SAME dict — a
+        # different tuned cache would silently change the dispatch shape
+        # mid-campaign (the r10 silently-dropped-mesh bug class).
+        man_tuning = man.get("tuning") or None
+        if tuning is not None:
+            from . import tune as _tune
+            from .tpu.spec import SimConfig
+
+            resolved = _tune.resolve_tuning(
+                tuning, workload.spec.name,
+                workload.config or SimConfig(), int(params["lanes"]),
+            ) or None
+            if resolved != man_tuning:
+                raise ValueError(
+                    f"resume tuning {resolved} conflicts with the "
+                    f"checkpoint's persisted tuning {man_tuning} — a "
+                    "resumed campaign replays the tuning it was created "
+                    "under; omit tuning= (the checkpoint's applies), or "
+                    "start a fresh campaign to re-tune"
+                )
         c = cls(
             workload, dir,
             meta_seed=int(params["meta_seed"]),
@@ -862,6 +915,7 @@ class Campaign:
             sim=sim,
             pipeline=bool(params.get("pipeline", True)),
             log=log,
+            tuning=man_tuning,
             explorer_kwargs={
                 k: params[k] for k in
                 ("fresh_frac", "mutant_frac", "top_k", "swarm_group")
@@ -1221,6 +1275,20 @@ def check_resume_conflicts(manifest: Dict[str, Any],
         conflicts.append(
             f"storm {given['storm']} != checkpoint {ref.get('storm')}"
         )
+    if "tuning" in given:
+        # Tier-A tuned knobs are explicit config (docs/tuning.md): the
+        # checkpoint persists the RESOLVED tuning it was created under,
+        # and a request pinning a different tuned dict (a different
+        # tuned cache, a re-tuned device) is the silently-forked-search
+        # mistake no fingerprint catches — reject loudly. (Tier-B tuned
+        # knobs live in the SimConfig and are caught by the resume
+        # config-hash check.)
+        want = given["tuning"] or None
+        have = manifest.get("tuning") or None
+        if want != have:
+            conflicts.append(
+                f"tuning {want} != checkpoint tuning {have}"
+            )
     if conflicts:
         raise ValueError(
             "request conflicts with the existing checkpoint: "
@@ -1228,7 +1296,9 @@ def check_resume_conflicts(manifest: Dict[str, Any],
         )
 
 
-def _explicit_request_params(request: Dict[str, Any]) -> Dict[str, Any]:
+def _explicit_request_params(
+    request: Dict[str, Any], manifest: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
     """The knobs a service request explicitly pins (chunk 0/null means
     'default', like the CLI flag, so it never counts as explicit)."""
     given = {
@@ -1238,6 +1308,27 @@ def _explicit_request_params(request: Dict[str, Any]) -> Dict[str, Any]:
     }
     if request.get("chunk"):
         given["chunk"] = request["chunk"]
+    if "tuning" in request:
+        # a request pinning tuning (a resolved Tier-A dict, or null for
+        # "defaults") must match what the checkpoint persisted. String
+        # forms ("auto", a cache path) resolve FIRST, against the
+        # checkpoint's own workload and lane scale — exactly what
+        # Campaign() resolved at creation — so the conflict check always
+        # compares resolved dicts: a serve restart with "tuning": "auto"
+        # resumes cleanly while the tuned cache is unchanged, and
+        # rejects loudly when the cache has been re-tuned since.
+        given["tuning"] = request["tuning"]
+        ref = (manifest or {}).get("workload") or {}
+        if isinstance(given["tuning"], str) and ref.get("kind") == "named":
+            from . import tune as _tune
+            from .tpu.spec import SimConfig
+
+            wl = build_workload(ref)
+            given["tuning"] = _tune.resolve_tuning(
+                given["tuning"], wl.spec.name,
+                wl.config or SimConfig(),
+                int((manifest or {}).get("params", {}).get("lanes", 256)),
+            ) or None
     return given
 
 
@@ -1249,7 +1340,7 @@ def _default_factory(request: Dict[str, Any], campaign_dir: str,
     if os.path.exists(os.path.join(campaign_dir, MANIFEST)):
         with open(os.path.join(campaign_dir, MANIFEST)) as f:
             man = json.load(f)
-        check_resume_conflicts(man, _explicit_request_params(request))
+        check_resume_conflicts(man, _explicit_request_params(request, man))
         c = Campaign.resume(
             campaign_dir, regression_dir=regression_dir, log=log
         )
@@ -1274,6 +1365,7 @@ def _default_factory(request: Dict[str, Any], campaign_dir: str,
         spec_kwargs={"name": name, "virtual_secs": virtual_secs},
         regression_dir=regression_dir,
         log=log,
+        tuning=request.get("tuning"),
     )
 
 

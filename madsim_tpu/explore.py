@@ -488,19 +488,47 @@ class Explorer:
         shrink_violations: bool = True,
         max_shrinks: Optional[int] = None,
         shrink_kwargs: Optional[Dict[str, Any]] = None,
-        pipeline: bool = True,
+        pipeline: Optional[bool] = None,
         refill: bool = True,
         refill_lanes: Optional[int] = None,
+        dispatch_steps: Optional[int] = None,
         sim=None,
         log: Optional[Callable[[str], None]] = None,
+        tuning: Any = None,
     ) -> None:
-        from .tpu.engine import BatchedSim
+        from .tpu.engine import DEFAULT_DISPATCH_STEPS, BatchedSim
         from .tpu.spec import SimConfig
 
         self.workload = workload
         self.cfg = workload.config or SimConfig()
         self.meta_seed = int(meta_seed)
         self.lanes = int(lanes)
+        if tuning is not None:
+            # Tier-A dispatch knobs from the tuned-config cache
+            # (docs/tuning.md): chunk width, refill lane width, segment
+            # length and pipelining, applied only where the caller kept
+            # the defaults. All are dispatch-shape knobs outside the
+            # search identity — corpus contents, curves and fingerprints
+            # are bit-identical across them (the pipeline/refill
+            # determinism tests) — but `chunk` IS recorded in
+            # explorer_params, so campaigns persist the applied value and
+            # `check_resume_conflicts` rejects a resume under a different
+            # tuned cache instead of silently forking. A cached `devices`
+            # knob is NOT consumed: the explorer's device topology is the
+            # Federation's island structure, not a per-sweep mesh.
+            from . import tune as _tune
+
+            tn = _tune.resolve_tuning(
+                tuning, workload.spec.name, self.cfg, self.lanes
+            )
+            if chunk is None and tn.get("chunk"):
+                chunk = min(int(tn["chunk"]), self.lanes)
+            if refill_lanes is None and tn.get("refill_lanes"):
+                refill_lanes = int(tn["refill_lanes"])
+            if dispatch_steps is None and tn.get("dispatch_steps"):
+                dispatch_steps = int(tn["dispatch_steps"])
+            if pipeline is None and "pipeline" in tn:
+                pipeline = bool(tn["pipeline"])
         self.chunk = int(chunk) if chunk else self.lanes
         self.fresh_frac = float(fresh_frac)
         self.mutant_frac = float(mutant_frac)
@@ -515,7 +543,14 @@ class Explorer:
         self.max_shrinks = None if max_shrinks is None else int(max_shrinks)
         self._shrinks_done = 0
         self.shrink_kwargs = dict(shrink_kwargs or {})
-        self.pipeline = bool(pipeline)
+        self.pipeline = True if pipeline is None else bool(pipeline)
+        # engine segment length for every generation dispatch; a tuned
+        # value lands above only when the caller omitted it, like every
+        # other Tier-A knob
+        self.dispatch_steps = (
+            DEFAULT_DISPATCH_STEPS if dispatch_steps is None
+            else int(dispatch_steps)
+        )
         # continuous batching (r9): a generation's candidates become
         # ADMISSIONS of one refill sweep over `refill_lanes` device lanes
         # (default: the chunk width) — lanes whose candidates finish
@@ -786,6 +821,7 @@ class Explorer:
                     seeds,
                     lanes=min(self.refill_lanes or self.chunk, len(pop)),
                     max_steps=self.workload.max_steps,
+                    dispatch_steps=self.dispatch_steps,
                     ctl=self._ctl_for(pop),
                 )
             with telemetry.span("decode", site="explore", gen=gen):
@@ -803,6 +839,7 @@ class Explorer:
                 with telemetry.span("dispatch", site="explore", gen=gen):
                     st = self.sim.run(
                         seeds, max_steps=self.workload.max_steps,
+                        dispatch_steps=self.dispatch_steps,
                         ctl=self._ctl_for(part),
                     )
                 return part, st

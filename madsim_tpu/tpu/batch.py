@@ -40,7 +40,8 @@ import jax
 import numpy as np
 
 from .. import telemetry
-from .engine import BatchedSim, SimState, summarize
+from .engine import (BatchedSim, DEFAULT_DISPATCH_STEPS, SimState,
+                     summarize)
 from .spec import ProtocolSpec, SimConfig
 
 # lanes per device dispatch: bounds peak memory for huge sweeps
@@ -315,15 +316,18 @@ def run_batch(
     workload: BatchWorkload,
     repro_on_host: bool = True,
     max_host_repros: int = 4,
-    chunk: int = DEFAULT_CHUNK,
+    chunk: Optional[int] = None,
     max_traces: int = 2,
     mesh: Any = "auto",
     check_determinism: bool = False,
     shrink_on_violation: bool = False,
     shrink_kwargs: Optional[Dict[str, Any]] = None,
-    pipeline: bool = True,
+    pipeline: Optional[bool] = None,
     coverage: bool = False,
-    refill: int = 0,
+    refill: Optional[int] = None,
+    dispatch_steps: Optional[int] = None,
+    sim: Optional[BatchedSim] = None,
+    tuning: Any = None,
 ) -> BatchResult:
     """Fuzz every seed as one TPU batch; re-run violating seeds on the host.
 
@@ -365,6 +369,22 @@ def run_batch(
     `LaneCoverage` and the summary a `coverage_bits` union count. Off by
     default — the bitmap costs a few percent of step time.
 
+    `tuning` consults the measured tuned-config cache (madsim_tpu/tune.py,
+    docs/tuning.md): pass ``"auto"`` to look up this device's entry for
+    (workload, config, lane count) and apply its TIER-A dispatch knobs —
+    chunk, segment length, pipeline, refill lane width, mesh device
+    count. Tier A is result-invariant by the engine's bit-identity
+    contract, so a tuned sweep's per-seed rows equal the default sweep's
+    bit-for-bit (tests/test_tune.py); a cache miss runs the hand-pinned
+    defaults. Explicit arguments win over tuned values — including an
+    explicit ``refill=0``, which pins the chunked path (and its per-lane
+    summary schema) whatever the cache holds; Tier-B (config)
+    knobs are never applied here — they fold into the SimConfig at
+    config-creation time only. `dispatch_steps` overrides the engine
+    segment length (None = the engine default); `sim` passes a pre-built
+    BatchedSim so repeated sweeps (the tuner's trials, bench A/B loops)
+    amortize the compile instead of re-jitting per call.
+
     `refill=<lanes>` runs the sweep CONTINUOUSLY BATCHED over that many
     device lanes PER DEVICE (docs/continuous_batching.md +
     docs/multichip.md): a lane that finishes — violates or reaches its
@@ -389,13 +409,74 @@ def run_batch(
     seeds_arr = np.asarray(list(seeds), dtype=np.uint32)
     if seeds_arr.ndim != 1 or seeds_arr.size == 0:
         raise ValueError("seeds must be a non-empty 1-D sequence")
+    if tuning is not None:
+        # Tier-A dispatch knobs from the tuned-config cache. Application
+        # rule: a tuned value lands only where the caller OMITTED the
+        # parameter (None sentinels) — an explicitly passed argument
+        # always wins, even one equal to the default — and every knob
+        # applied here is result-invariant (bit-identity matrix in
+        # tests/test_tune.py), so this is a pure throughput decision,
+        # never a behavioral one.
+        from .. import tune as _tune
+
+        tn = _tune.resolve_tuning(
+            tuning, workload.spec.name, workload.config or SimConfig(),
+            seeds_arr.size,
+        )
+        if "chunk" in tn and chunk is None:
+            chunk = int(tn["chunk"])
+        if "pipeline" in tn and pipeline is None:
+            pipeline = bool(tn["pipeline"])
+        if "dispatch_steps" in tn and dispatch_steps is None:
+            dispatch_steps = int(tn["dispatch_steps"])
+        if (
+            "refill_lanes" in tn and refill is None
+            and workload.lane_check is None
+        ):
+            refill = int(tn["refill_lanes"])
+        if "devices" in tn and mesh == "auto":
+            # cached=True: an entry recorded on a bigger host (more
+            # visible devices) degrades to the production default mesh
+            # instead of killing the sweep — a cache can only ever be a
+            # throughput upgrade, never a crash
+            mesh = _tune._mesh_for(tn["devices"], cached=True)
+    if chunk is None:
+        chunk = DEFAULT_CHUNK
+    if pipeline is None:
+        pipeline = True
+    if refill is None:
+        refill = 0
     if refill and workload.lane_check is not None:
         raise ValueError(
             "run_batch(refill=...) keeps no per-admission node state, so "
             "lane_check deep oracles cannot run — use the chunked path "
             "(refill=0) or strip the workload's lane_check"
         )
-    sim = BatchedSim(workload.spec, workload.config, coverage=coverage)
+    if sim is None:
+        sim = BatchedSim(workload.spec, workload.config, coverage=coverage)
+    elif bool(sim.coverage) != bool(coverage):
+        raise ValueError(
+            f"run_batch(coverage={coverage}) with a pre-built sim whose "
+            f"coverage={sim.coverage} — build the sim to match"
+        )
+    elif sim.spec is not workload.spec or sim.config.hash() != (
+        workload.config or SimConfig()
+    ).hash():
+        # a sim built for another (spec, config) would fuzz a DIFFERENT
+        # program while summaries, violation rows and host repro are all
+        # attributed to `workload` — the host replay would silently
+        # disagree with the device verdicts. Loud, like every other
+        # identity mismatch in this tree.
+        raise ValueError(
+            "run_batch(sim=...) was built for a different (spec, config) "
+            f"than the workload: sim runs {sim.spec.name!r} "
+            f"cfg={sim.config.hash()[:12]} but the workload is "
+            f"{workload.spec.name!r} "
+            f"cfg={(workload.config or SimConfig()).hash()[:12]} — "
+            "pre-built sims amortize compiles for the SAME program only"
+        )
+    if dispatch_steps is None:
+        dispatch_steps = DEFAULT_DISPATCH_STEPS
     if refill:
         return _run_batch_refill(
             seeds_arr, workload, sim, int(refill), chunk=chunk,
@@ -404,7 +485,7 @@ def run_batch(
             check_determinism=check_determinism,
             repro_on_host=repro_on_host, max_host_repros=max_host_repros,
             max_traces=max_traces, shrink_on_violation=shrink_on_violation,
-            shrink_kwargs=shrink_kwargs,
+            shrink_kwargs=shrink_kwargs, dispatch_steps=dispatch_steps,
         )
     mesh = resolve_mesh(mesh)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
@@ -436,9 +517,15 @@ def run_batch(
         else:
             part_in = part
         with telemetry.span("dispatch", site="run_batch", off=off):
-            st = sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+            st = sim.run(
+                part_in, max_steps=workload.max_steps,
+                dispatch_steps=dispatch_steps, mesh=mesh,
+            )
             rerun = (
-                sim.run(part_in, max_steps=workload.max_steps, mesh=mesh)
+                sim.run(
+                    part_in, max_steps=workload.max_steps,
+                    dispatch_steps=dispatch_steps, mesh=mesh,
+                )
                 if check_determinism else None
             )
         return off, part.size, pad, st, rerun
@@ -646,6 +733,7 @@ def _run_batch_refill(
     max_traces: int,
     shrink_on_violation: bool,
     shrink_kwargs: Optional[Dict[str, Any]],
+    dispatch_steps: int = DEFAULT_DISPATCH_STEPS,
 ) -> BatchResult:
     """run_batch's continuously batched sweep: each `chunk` of seeds is
     one device-resident queue SEGMENT run by engine.run_refill over
@@ -679,9 +767,11 @@ def _run_batch_refill(
             return sim.run_refill_sharded(
                 part, lanes=lanes, mesh=mesh,
                 max_steps=workload.max_steps,
+                dispatch_steps=dispatch_steps,
             )
         return sim.run_refill(
-            part, lanes=lanes, max_steps=workload.max_steps
+            part, lanes=lanes, max_steps=workload.max_steps,
+            dispatch_steps=dispatch_steps,
         )
 
     def dispatch(off: int):

@@ -136,6 +136,13 @@ COV_SALT = 0x5EEDC0DE  # base key of the event-class hash chain
 # silent mirror break that desyncs every recorded cov_digest downstream.
 COV_FIELDS = ("node", "src", "kind", "bucket")
 
+# the sweep segment length: how many steps one device dispatch covers.
+# ONE definition — run_batch, the autotuner's default assignment and the
+# smoke gates all reference it, so re-tuning the engine default can
+# never leave a caller pinned to a stale copy (it is also a Tier-A knob:
+# madsim_tpu/tune.py searches it per device).
+DEFAULT_DISPATCH_STEPS = 10_000
+
 
 class Coverage(NamedTuple):
     """Per-lane coverage accumulators (present iff BatchedSim(coverage=True)).
@@ -2894,7 +2901,7 @@ class BatchedSim:
 
     def run_refill(
         self, seeds, lanes: int, max_steps: int = 100_000,
-        dispatch_steps: int = 10_000, ctl=None,
+        dispatch_steps: int = DEFAULT_DISPATCH_STEPS, ctl=None,
         total_steps: Optional[int] = None,
     ) -> SimState:
         """Run ALL `seeds` as admissions of a continuously batched sweep
@@ -3043,7 +3050,7 @@ class BatchedSim:
 
     def run_state_sharded(
         self, state: SimState, mesh: jax.sharding.Mesh, max_steps: int,
-        dispatch_steps: int = 10_000,
+        dispatch_steps: int = DEFAULT_DISPATCH_STEPS,
     ) -> SimState:
         """run_state's segment loop over the shard_map'd segment program:
         same speculative early-stop (the all-done reduction over the
@@ -3057,7 +3064,7 @@ class BatchedSim:
 
     def run_refill_sharded(
         self, seeds, lanes: int, mesh: jax.sharding.Mesh,
-        max_steps: int = 100_000, dispatch_steps: int = 10_000, ctl=None,
+        max_steps: int = 100_000, dispatch_steps: int = DEFAULT_DISPATCH_STEPS, ctl=None,
         total_steps: Optional[int] = None,
     ) -> SimState:
         """The multi-chip continuously batched sweep: ALL `seeds` run as
@@ -3115,7 +3122,7 @@ class BatchedSim:
         return merge_state(h, c, const)
 
     def run(
-        self, seeds, max_steps: int = 100_000, dispatch_steps: int = 10_000,
+        self, seeds, max_steps: int = 100_000, dispatch_steps: int = DEFAULT_DISPATCH_STEPS,
         mesh: Optional[jax.sharding.Mesh] = None, ctl=None,
     ) -> SimState:
         """Run lanes until every lane is done (or max_steps).
@@ -3163,7 +3170,7 @@ class BatchedSim:
         return self.run_state(state, max_steps, dispatch_steps)
 
     def run_state(
-        self, state: SimState, max_steps: int, dispatch_steps: int = 10_000,
+        self, state: SimState, max_steps: int, dispatch_steps: int = DEFAULT_DISPATCH_STEPS,
         segment=None,
     ) -> SimState:
         """run()'s chunked segment loop on a PRE-BUILT state (the shared

@@ -654,7 +654,7 @@ def shrink_seed(
     spec_ref: Optional[str] = None,
     spec_kwargs: Optional[Dict[str, Any]] = None,
     slack_us: int = 2_000,
-    lane_width: int = 16,
+    lane_width: Optional[int] = None,
     rate_steps: Sequence[float] = (0.5, 0.25),
     trace_tail: int = 40,
     sim=None,
@@ -663,6 +663,7 @@ def shrink_seed(
     refill: bool = True,
     mesh=None,
     causal: bool = False,
+    tuning: Any = None,
 ) -> ShrinkResult:
     """Shrink one violating seed of a BatchWorkload into a ReproBundle.
 
@@ -699,6 +700,24 @@ def shrink_seed(
     say = log or (lambda msg: None)
     spec = workload.spec
     cfg = workload.config or SimConfig()
+    if tuning is not None:
+        # Tier-A only (docs/tuning.md): the tuned refill lane width sizes
+        # the ddmin evaluator's generation dispatches. Result-invariant —
+        # a shrink's verdicts (and hence its bundle) are bit-identical at
+        # any lane_width, which the triage width-matrix tests already pin.
+        # The lookup is at the DDMIN scale (lane_width's bucket, l16 by
+        # default), deliberately not the 32k sweep bucket `make tune`
+        # populates: knobs do not transfer across scale (that is why lane
+        # buckets exist), so a hit requires a tuner run at ddmin scale
+        # (e.g. `python -m madsim_tpu.tune --lanes 16`); a miss runs the
+        # hand-pinned default width.
+        from . import tune as _tune
+
+        tn = _tune.resolve_tuning(tuning, spec.name, cfg, lane_width or 16)
+        if tn.get("refill_lanes") and lane_width is None:
+            lane_width = int(tn["refill_lanes"])
+    if lane_width is None:
+        lane_width = 16
     if sim is None:
         sim = BatchedSim(spec, cfg, triage=True)
     elif not sim.triage:

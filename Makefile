@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -46,6 +46,10 @@ telemetry-smoke: ## telemetry observe-only contract: on/off bit-identity (finger
 explain-smoke:   ## causal explainability end to end: the <60s-warm bench gate (planted raft re-stamp -> lineage slice names the re-stamp APPEND delivery chain -> cross-witness skeleton; lineage carry <= 15% budget), then the WHOLE causal suite incl. the slow-marked shrink/anatomy tests the tier-1 wall budget keeps out
 	$(PY) benches/explain_smoke.py
 	$(PY) -m pytest tests/test_causal.py -q -m "not deep"
+
+oracle-smoke:    ## <60s CPU: the differential oracle both ways — a small raft chaos sweep replays schedule-matched on the host twin with zero divergences, then the planted reorder off-by-one fires, localizes to the reorder-window draw, and ddmin-shrinks to the reorder clause (never vacuously green), then the oracle suite
+	$(PY) benches/oracle_smoke.py
+	$(PY) -m pytest tests/test_oracle.py -q
 
 tune:            ## measured autotune over every workload's throughput knobs; winners cached per (device_kind, workload, config, lane bucket) and consumed via tuning="auto" (docs/tuning.md)
 	$(PY) -m madsim_tpu.tune --workload all --virtual-secs 10 --lanes 32768

@@ -41,6 +41,20 @@ def _repo_root_on_path() -> None:
 _repo_root_on_path()
 
 
+def _restamp_plan():
+    """The restamp config's FaultPlan — shared verbatim by the device leg
+    (compile_plan) and the schedule-matched host leg (NemesisDriver), so
+    both backends execute the SAME per-seed fault stream."""
+    from madsim_tpu.nemesis import Crash, FaultPlan, Partition
+
+    return FaultPlan(name="ttfb-restamp", clauses=(
+        Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=300_000, down_hi_us=1_000_000),
+        Partition(interval_lo_us=300_000, interval_hi_us=1_200_000,
+                  heal_lo_us=400_000, heal_hi_us=1_500_000),
+    ))
+
+
 def restamp_workload():
     """The deposed-leader re-stamp bug (docs/bugs_found.md #1, the round-2
     trophy: a deposed leader re-stamps its stale log tail with the newly
@@ -49,7 +63,6 @@ def restamp_workload():
     shrinker real occurrence atoms to drop."""
     import jax.numpy as jnp
 
-    from madsim_tpu.nemesis import Crash, FaultPlan, Partition
     from madsim_tpu.tpu import SimConfig, make_raft_spec, raft_workload
     from madsim_tpu.tpu import nemesis as tn
     from madsim_tpu.tpu import raft as raft_mod
@@ -65,14 +78,8 @@ def restamp_workload():
         log_term = jnp.where(deposed & in_log, state.term, state.log_term)
         return state._replace(log_term=log_term), out, timer
 
-    plan = FaultPlan(name="ttfb-restamp", clauses=(
-        Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
-              down_lo_us=300_000, down_hi_us=1_000_000),
-        Partition(interval_lo_us=300_000, interval_hi_us=1_200_000,
-                  heal_lo_us=400_000, heal_hi_us=1_500_000),
-    ))
     cfg = tn.compile_plan(
-        plan, SimConfig(horizon_us=5_000_000, loss_rate=0.0)
+        _restamp_plan(), SimConfig(horizon_us=5_000_000, loss_rate=0.0)
     )
     wl = raft_workload(spec=replace_handlers(spec, on_message=buggy_on_message))
     return dataclasses.replace(wl, config=cfg, host_repro=None)
@@ -97,36 +104,58 @@ def chain_straggler_workload():
     )
 
 
-def _host_raft_restamp(seed: int) -> bool:
+def _host_raft_restamp(seed: int, schedule_matched: bool = True) -> bool:
     """One host-runtime seed of the same planted bug class (the host
     twin's `buggy=True` is the deposed-leader re-stamp injection) —
     True when the seed violates.
 
-    Matched to the device config where the host API allows it (horizon
-    5 s, client_rate 0.8, loss 0.0, crash + partition chaos on); the
-    crash/partition WINDOWS are the host fuzzer's built-in distributions,
-    not the device FaultPlan's — see the `vs_host` caveat in ttfb_all."""
+    Schedule-matched by default: the host consumes the SAME compiled
+    per-seed `_restamp_plan()` stream through `NemesisDriver` that the
+    device executes (docs/oracle.md), so the A/B is controlled — horizon
+    5 s, client_rate 0.8, loss 0.0, identical crash/partition windows.
+    `schedule_matched=False` restores the legacy host-native chaos
+    distributions (indicative only)."""
     from madsim_tpu.workloads import raft_host
 
+    plan = _restamp_plan() if schedule_matched else None
     try:
         raft_host.fuzz_one_seed(
-            seed, virtual_secs=5.0, loss_rate=0.0, chaos=True, buggy=True,
-            client_rate=0.8, partitions=True,
+            seed, virtual_secs=5.0, loss_rate=0.0,
+            chaos=not schedule_matched, buggy=True, client_rate=0.8,
+            partitions=not schedule_matched, plan=plan,
         )
         return False
     except raft_host.InvariantViolation:
         return True
 
 
-def _host_chain_straggler(seed: int) -> bool:
-    """Matched where the host API allows (horizon 8 s, loss 0.1, straggler
-    tails + crash chaos on); the tail distribution is the host fuzzer's,
-    not the device buggify knobs' — see the `vs_host` caveat in ttfb_all."""
+def _straggler_plan():
+    """chain_workload's legacy crash knobs as a FaultPlan: identical
+    interval/down distributions, but compiled to the pure per-seed
+    schedule so the host leg drives them through NemesisDriver."""
+    from madsim_tpu.nemesis import Crash, FaultPlan
+
+    return FaultPlan(name="ttfb-straggler", clauses=(
+        Crash(interval_lo_us=400_000, interval_hi_us=2_000_000,
+              down_lo_us=200_000, down_hi_us=1_000_000),
+    ))
+
+
+def _host_chain_straggler(seed: int, schedule_matched: bool = True) -> bool:
+    """Schedule-matched by default: crash windows come from the compiled
+    `_straggler_plan()` stream (horizon 8 s, loss 0.1). The buggify
+    straggler TAIL stays host-native in both modes — it is a runtime
+    knob, not a FaultPlan clause, so it has no pure-schedule face (the
+    remaining uncontrolled surface; docs/oracle.md documents the
+    boundary). `schedule_matched=False` restores the legacy host-native
+    crash task as well."""
     from madsim_tpu.workloads import chain_host
 
+    plan = _straggler_plan() if schedule_matched else None
     try:
         chain_host.fuzz_one_seed(
-            seed, virtual_secs=8.0, chaos=True, tails=True, buggy=True,
+            seed, virtual_secs=8.0, chaos=not schedule_matched, tails=True,
+            buggy=True, plan=plan,
         )
         return False
     except chain_host.InvariantViolation:
@@ -340,7 +369,7 @@ def measure_ttfb(
 def ttfb_all(chunk: "int | None" = None, max_seeds: int = 8192,
              shrink: bool = True, host_baseline: bool = True,
              host_deadline_s: float = 180.0, refill: int = 64,
-             tuning=None) -> dict:
+             tuning=None, host_schedule_matched: bool = True) -> dict:
     rows = {}
     for name, (factory, host_fn) in PLANTED.items():
         try:
@@ -377,24 +406,25 @@ def ttfb_all(chunk: "int | None" = None, max_seeds: int = 8192,
                 }
         if host_baseline and host_fn is not None:
             try:
-                host = measure_host_ttfb(host_fn, deadline_s=host_deadline_s)
+                host = measure_host_ttfb(
+                    lambda s: host_fn(
+                        s, schedule_matched=host_schedule_matched
+                    ),
+                    deadline_s=host_deadline_s,
+                )
+                host["schedule_matched"] = host_schedule_matched
                 row["host"] = host
                 dev = row.get("wall_to_first_violation_s")
                 if dev and host.get("wall_to_first_violation_s"):
+                    # a controlled A/B by default: the host leg consumes
+                    # the SAME compiled per-seed FaultPlan stream through
+                    # NemesisDriver that the device executes, verified
+                    # draw-for-draw by the standing differential oracle
+                    # (madsim_tpu/oracle.py, docs/oracle.md). The legacy
+                    # host-native distributions (indicative only) are
+                    # behind --host-legacy / host_schedule_matched=False.
                     row["vs_host"] = round(
                         host["wall_to_first_violation_s"] / dev, 2
-                    )
-                    # honesty: the host sweep plants the SAME bug but rolls
-                    # its fuzzer's built-in fault windows, not the device
-                    # FaultPlan's schedule, so per-seed bug density differs
-                    # between the two experiments. The ratio mixes hardware
-                    # speed with fault-schedule luck; treat it as
-                    # indicative, not a controlled A/B. (A schedule-exact
-                    # comparator needs NemesisDriver wired through the
-                    # host workloads' restart scaffolding — future work.)
-                    row["vs_host_note"] = (
-                        "same planted bug, host-native fault distribution; "
-                        "indicative, not schedule-matched"
                     )
             except Exception as e:  # noqa: BLE001
                 row["host"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
@@ -414,6 +444,13 @@ def main() -> None:
     parser.add_argument("--no-host", action="store_true")
     parser.add_argument("--host-deadline", type=float, default=180.0)
     parser.add_argument(
+        "--host-legacy", action="store_true",
+        help="host leg rolls its legacy host-native fault distributions "
+        "instead of the schedule-matched compiled FaultPlan stream "
+        "(indicative only — the default is a controlled A/B, "
+        "docs/oracle.md)",
+    )
+    parser.add_argument(
         "--refill", type=int, default=64, metavar="LANES",
         help="also sweep each config continuously batched over LANES "
         "lanes (0 disables)",
@@ -431,6 +468,7 @@ def main() -> None:
             host_baseline=not args.no_host,
             host_deadline_s=args.host_deadline, refill=args.refill,
             tuning=args.tuning,
+            host_schedule_matched=not args.host_legacy,
         )),
         flush=True,
     )

@@ -419,11 +419,18 @@ def check_mirror(
     event_kinds: Optional[Dict[str, Tuple[str, ...]]] = None,
     driver_source: Optional[str] = None,
     root: Optional[str] = None,
+    host_coin_methods: Optional[Dict[str, Tuple[str, ...]]] = None,
+    net_source: Optional[str] = None,
+    oracle_source: Optional[str] = None,
 ) -> RuleResult:
-    """Every clause exists on all three faces (schedule/host/device).
+    """Every clause exists on all four faces: the pure schedule, the
+    device tensor program, the host driver, and the oracle comparator's
+    input (HOST_COIN_METHODS — the draw methods the net layer calls and
+    madsim_tpu/oracle.py recomputes).
 
     Parameters exist for fixture injection; by default the real
-    registries, driver source, and compile_plan are checked."""
+    registries, driver source, net/oracle sources, and compile_plan are
+    checked."""
     from .. import nemesis as nem
 
     res = RuleResult("mirror")
@@ -624,6 +631,76 @@ def check_mirror(
                     "plan.skew_ppm",
                     "ClockSkew plan assigns zero ppm everywhere for seed 3",
                 )
+
+    # (f) oracle-comparator face: every message clause's host draws are
+    # schedule-matched. Each MESSAGE_CLAUSES clause must map to
+    # HOST_COIN_METHODS; each listed method must exist on ScheduleCoins
+    # AND be called somewhere in the host net layer (ast.Attribute — a
+    # clause whose draws never route through ScheduleCoins falls back to
+    # the ambient rng and the oracle cannot verify it); and oracle.py
+    # must consume the registry itself, so a new clause added to three
+    # faces but not the comparator still fails `make lint`.
+    coin_methods = (
+        nem.HOST_COIN_METHODS if host_coin_methods is None
+        else host_coin_methods
+    )
+    net_src = net_source
+    if net_src is None:
+        netsim_src, _ = _read(
+            os.path.join(root, "madsim_tpu", "net", "netsim.py")
+        )
+        network_src, _ = _read(
+            os.path.join(root, "madsim_tpu", "net", "network.py")
+        )
+        net_src = netsim_src + "\n" + network_src
+    net_attrs = {
+        node.attr
+        for node in ast.walk(ast.parse(net_src))
+        if isinstance(node, ast.Attribute)
+    }
+    res.checked += 1
+    for name in sorted(message_clauses):
+        methods = coin_methods.get(name)
+        if not methods:
+            res.add(
+                "HOST_COIN_METHODS",
+                f"message clause {name!r} has no ScheduleCoins draw methods "
+                "registered — its host draws are not schedule-matched and "
+                "the oracle comparator cannot verify them",
+            )
+            continue
+        for m in methods:
+            if not callable(getattr(nem.ScheduleCoins, m, None)):
+                res.add(
+                    "ScheduleCoins",
+                    f"registered draw method {m!r} (clause {name!r}) does "
+                    "not exist on ScheduleCoins",
+                )
+            if m not in net_attrs:
+                res.add(
+                    "net layer",
+                    f"ScheduleCoins.{m} (clause {name!r}) is never called "
+                    "from net/netsim.py or net/network.py — the host draw "
+                    "falls back to the ambient rng, unverifiable by the "
+                    "oracle",
+                )
+    stray = sorted(set(coin_methods) - set(message_clauses))
+    if stray:
+        res.add(
+            "HOST_COIN_METHODS",
+            f"entries {stray} name no MESSAGE_CLAUSES clause — the "
+            "comparator would verify draws no clause produces",
+        )
+    res.checked += 1
+    orc_src = oracle_source
+    if orc_src is None:
+        orc_src, _ = _read(os.path.join(root, "madsim_tpu", "oracle.py"))
+    if "HOST_COIN_METHODS" not in orc_src:
+        res.add(
+            "oracle.py",
+            "the comparator never reads nemesis.HOST_COIN_METHODS — new "
+            "message clauses would ship without an oracle face",
+        )
     return res
 
 

@@ -1397,6 +1397,9 @@ def serve(
     factory: Optional[Callable[..., Any]] = None,
     sleep: Callable[[float], None] = time.sleep,
     devices: Optional[Sequence[Any]] = None,
+    oracle: bool = True,
+    oracle_sample_rate: float = 0.25,
+    oracle_per_round: int = 2,
 ) -> Dict[str, Any]:
     """The fuzz-farm front end: watch `<dir>/queue/` for request files,
     time-slice the DEVICE FLEET between active campaigns round-robin
@@ -1426,6 +1429,15 @@ def serve(
 
     `max_rounds` / `idle_rounds` bound the loop for tests and cron-style
     runs; the default (None/None) serves forever.
+
+    The differential oracle (docs/oracle.md) runs as a background tenant
+    unless `oracle=False`: after each round's device slices it replays a
+    sampled subset of the new generations' lanes schedule-matched on the
+    host twin (`oracle_sample_rate` thins, `oracle_per_round` caps —
+    saturation degrades gracefully into a counted skip) and folds any
+    divergence into the owning campaign's BugRecords with
+    `violation_kind="divergence"`. Its cursors persist in
+    `<dir>/oracle.json`, so kill/restart resumes without re-checking.
     """
     if int(slice_generations) < 1:
         raise ValueError(
@@ -1443,6 +1455,15 @@ def serve(
     for d in (queue_dir, active_dir, done_dir, campaigns_dir):
         os.makedirs(d, exist_ok=True)
     build = factory or _default_factory
+
+    tenant = None
+    if oracle:
+        from . import oracle as _oracle
+
+        tenant = _oracle.OracleTenant(
+            sample_rate=oracle_sample_rate, per_round=oracle_per_round,
+            state_path=os.path.join(dir, "oracle.json"), log=log,
+        )
 
     # crash recovery: requests that were in flight when a previous service
     # died are requeued — their campaigns resume from checkpoint, and
@@ -1649,6 +1670,8 @@ def serve(
                 for d in range(len(devs))
             ],
         }
+        if tenant is not None:
+            status["oracle"] = tenant.status()
         telemetry.write_status(os.path.join(dir, STATUS), status)
         telemetry.write_farm_textfile(
             os.path.join(dir, METRICS_TEXTFILE), status
@@ -1718,6 +1741,18 @@ def serve(
                 ) as f:
                     f.write(json.dumps(line) + "\n")
                 progressed = True
+                if tenant is not None:
+                    # the idle-CPU oracle lane: replay a sampled subset
+                    # of this slice's lanes schedule-matched on the host
+                    # twin. observe() never raises; a divergence lands a
+                    # BugRecord on the campaign, so re-checkpoint to make
+                    # it durable at this slice boundary.
+                    obs = tenant.observe(cid, campaign)
+                    if obs.get("diverged"):
+                        try:
+                            campaign.checkpoint()
+                        except Exception:  # noqa: BLE001 - next slice's
+                            pass  # checkpoint persists the record anyway
                 if job["remaining"] <= 0:
                     os.replace(
                         job["active_path"],
@@ -1741,6 +1776,8 @@ def serve(
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
+        if tenant is not None:
+            tenant.save()
         write_status_surfaces()
     return {
         "rounds": rounds, "completed": completed, "pending": sorted(jobs),
@@ -1868,6 +1905,9 @@ def _cmd_serve(args) -> int:
         max_rounds=args.max_rounds, idle_rounds=args.idle_rounds,
         log=lambda m: print(m, flush=True) if args.verbose else None,
         devices=devices,
+        oracle=not args.no_oracle,
+        oracle_sample_rate=args.oracle_sample_rate,
+        oracle_per_round=args.oracle_per_round,
     )
     return 0
 
@@ -1931,6 +1971,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "(concurrent per-device slice lanes; requests may pin a device "
         "subset with \"devices\": [i, ...]) — default: single device, "
         "the r6 behavior",
+    )
+    s.add_argument(
+        "--no-oracle", action="store_true",
+        help="disable the background differential-oracle tenant "
+        "(docs/oracle.md)",
+    )
+    s.add_argument(
+        "--oracle-sample-rate", type=float, default=0.25,
+        help="fraction of each generation's lanes the oracle replays "
+        "schedule-matched on the host twin",
+    )
+    s.add_argument(
+        "--oracle-per-round", type=int, default=2,
+        help="max host replays per serve round (saturation beyond this "
+        "degrades to a counted skip)",
     )
     s.add_argument("--verbose", action="store_true")
     s.set_defaults(fn=_cmd_serve)

@@ -237,11 +237,12 @@ def check_host_lineage(lineage) -> int:
     max(local, send event id) + 1, every edge points backward in eid
     order to a real send event. Returns the number of edges checked.
 
-    This is the host face of the three-face lineage twin. Unlike the
-    chaos-stream twins, host and device EDGES are not compared
-    event-for-event: the two backends roll their own network latencies
-    (the documented `vs_host_note` caveat — schedule-matched host replay
-    is ROADMAP item 5), so the trajectories differ by design. What IS
+    This is the host face of the lineage twin. Host and device EDGES are
+    not compared event-for-event: the two backends roll their own
+    network latencies, so trajectories differ by design even under the
+    schedule-matched replay the differential oracle performs
+    (`madsim_tpu/oracle.py`, docs/oracle.md — the oracle compares the
+    schedule stream, coin draws, skew, and this law instead). What IS
     shared — and checked by this one function plus `check_lamport` — is
     the lineage LAW both faces implement with the same sender-value
     vocabulary (the message carries its send event's id)."""
@@ -278,6 +279,82 @@ def check_host_lineage(lineage) -> int:
         lam[node] = lam_after
         by_eid[eid] = (node, kind)
     return checked
+
+
+def host_causal_slice(lineage, anchor_eid: int, max_len: int = 16) -> List[tuple]:
+    """The host-lineage analog of `causal_slice`: the minimal explanation
+    chain ending at `anchor_eid`, walked over the HostLineage mirror —
+    each delivery followed back through its (send_eid -> deliver_eid)
+    edge, each other event through program order on its node. Rows are
+    the mirror's `(eid, node, lam, kind)` tuples, ascending eid. The
+    differential oracle uses this to name the first divergent delivery
+    when a schedule-matched host replay diverges (docs/oracle.md)."""
+    by_eid: Dict[int, tuple] = {
+        row[0]: row for row in lineage.events
+    }
+    if not by_eid:
+        return []
+    send_of: Dict[int, int] = {de: se for se, de in lineage.edges}
+    prev_on_node: Dict[int, int] = {}
+    last: Dict[int, int] = {}
+    for eid, node, _lam, _kind in lineage.events:
+        if node in last:
+            prev_on_node[eid] = last[node]
+        last[node] = eid
+    cur: Optional[int] = (
+        anchor_eid if anchor_eid in by_eid else max(by_eid)
+    )
+    chain: List[tuple] = []
+    while cur is not None and len(chain) < max_len:
+        row = by_eid[cur]
+        chain.append(row)
+        if row[3] == "deliver" and send_of.get(cur) in by_eid:
+            cur = send_of[cur]
+        else:
+            cur = prev_on_node.get(cur)
+    chain.reverse()
+    return chain
+
+
+def host_slice_labels(chain: Sequence[tuple], canonical: bool = True) -> List[str]:
+    """`slice_labels` for a host slice: seed-independent label sequence
+    with nodes renamed by order of first appearance."""
+    rename: Dict[int, int] = {}
+
+    def nm(node: int) -> str:
+        if not canonical:
+            return f"n{node}"
+        if node not in rename:
+            rename[node] = len(rename)
+        return f"N{rename[node]}"
+
+    return [f"{kind}:{nm(node)}" for _eid, node, _lam, kind in chain]
+
+
+def format_host_slice(chain: Sequence[tuple]) -> str:
+    """Human rendering of a host slice, one line per event."""
+    return "\n".join(
+        f"  eid={eid:<7d} node{node:<3d} lam={lam:<7d} {kind}"
+        for eid, node, lam, kind in chain
+    )
+
+
+def host_slice_digest(chain: Sequence[tuple]) -> Dict[str, Any]:
+    """`causal_digest`'s shape for a host slice — the JSON-portable form
+    a divergence ReproBundle carries in its v3 `causal` field (no schema
+    bump: same keys, host-lineage provenance)."""
+    labels = host_slice_labels(chain)
+    return {
+        "labels": labels,
+        "chain_len": len(chain),
+        "cone_size": len(chain),
+        "depth": len(chain),
+        "chaos_events": 0,
+        "anchor_eid": chain[-1][0] if chain else -1,
+        "sha": hashlib.sha256(
+            json.dumps(labels, separators=(",", ":")).encode()
+        ).hexdigest()[:16],
+    }
 
 
 # --------------------------------------------------------------------------

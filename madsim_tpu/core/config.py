@@ -53,6 +53,9 @@ class NetConfig:
     # NOT declarative config (excluded from to_toml/hash)
     spike_extra_latency: float = field(default=0.0, compare=False)
     nemesis_fires: dict = field(default_factory=dict, compare=False)
+    # schedule-matched coin provider (nemesis.ScheduleCoins), installed
+    # by NemesisDriver.install; None = ambient GlobalRng rolls
+    coins: object = field(default=None, compare=False)
 
     def __post_init__(self) -> None:
         self.validate()

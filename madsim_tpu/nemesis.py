@@ -27,11 +27,18 @@ agreement possible at all:
     stream either backend will execute. Jepsen calls this a nemesis
     schedule; FoundationDB calls the ingredients buggify knobs.
   * MESSAGE-level clauses (loss, duplication, bounded reordering) flip a
-    coin per message. Message streams differ across backends by design
-    (the determinism contract is per-backend, SURVEY.md §7), so these
-    match in *rate* — statistically comparable fire counts for the same
-    traffic, counted identically (the clause's own coin, not ambient
-    loss) — never event-for-event.
+    coin per message. Message *streams* differ across backends by design
+    (the determinism contract is per-backend, SURVEY.md §7) — backends
+    roll their own traffic and latencies — but every host coin VALUE is
+    schedule-matched: `ScheduleCoins` draws it from the same murmur3
+    chain as the device (`coin32`/`randint32` at the shared NET_SITE_*
+    sites, per-site monotone draw index), so each applied draw is a pure
+    function of (seed, site, index) that the differential oracle
+    (`madsim_tpu/oracle.py`) recomputes and verifies draw-for-draw.
+    Which indices get consumed depends on traffic; what each draw is
+    worth does not. Fire counts stay statistically comparable across
+    backends and are counted identically (the clause's own coin, not
+    ambient loss).
 
 Every clause firing is counted (`FIRE_KINDS`): per-fault-kind fire counts
 surface in `BatchResult.summary` (device) and `RuntimeMetrics.chaos_fires`
@@ -122,8 +129,10 @@ NEM_SITE_SPIKE_IV = 231
 NEM_SITE_SPIKE_DUR = 232
 NEM_SITE_SKEW = 241          # per-node skew ppm; index = node
 
-# per-message coin sites on the engine's per-step net_key stream
-# (backend-local; the host uses its GlobalRng instead)
+# per-message coin sites. The engine draws them on its per-step net_key
+# stream; the host draws them on the per-seed base key via ScheduleCoins
+# (same sites, per-site monotone index) so every host draw VALUE is a
+# pure function of (seed, site, index) the oracle can recompute.
 NET_SITE_DUP = 5
 NET_SITE_REORDER = 6
 NET_SITE_REORDER_EXTRA = 7
@@ -272,13 +281,15 @@ _CLAUSE_TYPES: Tuple[type, ...] = (
 # --------------------------------------------------------------------------
 # enumerable mirror registries (the analysis verifier's ground truth)
 # --------------------------------------------------------------------------
-# Every fault clause lives on THREE faces — the pure schedule
-# (plan_schedule), the host driver (NemesisDriver._apply / install), and
-# the device engine (compile_plan -> nem_* knobs) — and the static
-# verifier (madsim_tpu/analysis, rule `mirror`) cross-checks completeness
-# against these tables instead of sampling it with twin tests. A new
-# clause MUST be added here; the mirror rule fails on any face it cannot
-# find.
+# Every fault clause lives on FOUR faces — the pure schedule
+# (plan_schedule), the host driver (NemesisDriver._apply / install plus
+# the ScheduleCoins message draws), the device engine (compile_plan ->
+# nem_* knobs), and the oracle comparator (madsim_tpu/oracle.py, which
+# consumes these registries to recompute every host draw) — and the
+# static verifier (madsim_tpu/analysis, rule `mirror`) cross-checks
+# completeness against these tables instead of sampling it with twin
+# tests. A new clause MUST be added here; the mirror rule fails on any
+# face it cannot find.
 
 # schedule-level clauses: occurrence-indexed event windows. Keys are the
 # shared clause names (OCC_CLAUSES rows, TriageCtl atoms, SimConfig
@@ -287,10 +298,29 @@ SCHEDULE_CLAUSES: Dict[str, type] = {
     "crash": Crash, "partition": Partition, "clog": LinkClog,
     "spike": LatencySpike,
 }
-# message-level clauses: per-message coins (rate-matched across backends,
-# never event-matched). Keys are RATE_CLAUSES rows / `nem_<name>_rate`.
+# message-level clauses: per-message coins. Streams are per-backend but
+# every host draw VALUE is schedule-matched (pure in (seed, site, index)
+# via ScheduleCoins). Keys are RATE_CLAUSES rows / `nem_<name>_rate`.
 MESSAGE_CLAUSES: Dict[str, type] = {
     "loss": MsgLoss, "dup": Duplicate, "reorder": Reorder,
+}
+# message clause -> the ScheduleCoins methods the host net layer calls
+# for it (the fourth face's input contract: the oracle comparator
+# iterates THIS table to verify every logged draw, and the mirror lint
+# proves each method exists on ScheduleCoins AND is called from the
+# net/ sources — a clause landing without schedule-matched host
+# consumption fails `make lint`).
+HOST_COIN_METHODS: Dict[str, Tuple[str, ...]] = {
+    "loss": ("loss",),
+    "dup": ("dup",),
+    "reorder": ("reorder", "reorder_extra"),
+}
+# ScheduleCoins method -> murmur3 draw site (shared with tpu/engine.py)
+COIN_SITE: Dict[str, int] = {
+    "loss": NET_SITE_NEM_LOSS,
+    "dup": NET_SITE_DUP,
+    "reorder": NET_SITE_REORDER,
+    "reorder_extra": NET_SITE_REORDER_EXTRA,
 }
 # assignment clauses: applied once at t=0 per (seed, node), no windows
 ASSIGN_CLAUSES: Dict[str, type] = {"skew": ClockSkew}
@@ -618,6 +648,118 @@ def filter_schedule(
 
 
 # --------------------------------------------------------------------------
+# schedule-matched message coins (the host half of the fourth face)
+# --------------------------------------------------------------------------
+
+# bound on the retained draw log: a long soak must not grow host memory
+# without bound; overflow is counted, never silent (the oracle verifies
+# the retained prefix and reports the drop count)
+MAX_COIN_DRAWS = 200_000
+
+# test-only divergence plant (the oracle's never-vacuously-green lever):
+# set MADSIM_TPU_ORACLE_PLANT=reorder_window_off_by_one to skew the
+# host's reorder-window draw span by one — a deliberate host/device
+# semantic divergence the differential oracle must catch.
+PLANT_ENV = "MADSIM_TPU_ORACLE_PLANT"
+PLANT_REORDER_OFF_BY_ONE = "reorder_window_off_by_one"
+
+
+class ScheduleCoins:
+    """Host message-level draws as pure functions of (seed, site, index).
+
+    The device engine rolls loss/dup/reorder per candidate message from
+    its hash chain; the host historically rolled them from the ambient
+    `GlobalRng`, which made the two backends comparable only in *rate*.
+    This provider replaces the host's ambient rolls with the same murmur3
+    chain (`coin32`/`randint32` on `key_from_seed(seed)`) at the shared
+    `NET_SITE_*` sites, one monotone draw index per site — so every draw
+    the host applies is recomputable from the seed alone, and the
+    differential oracle (`madsim_tpu/oracle.py`) verifies the applied
+    stream draw-for-draw. WHICH indices get consumed still depends on
+    traffic (streams are per-backend by design); what each draw is worth
+    does not.
+
+    Installed by `NemesisDriver.install()` onto the live `NetConfig`
+    (`cfg.coins`); `NetSim.send` / `Network.test_link` consult it and
+    fall back to the GlobalRng when absent (plans without a driver).
+    Each draw is logged as `(site, index, value, t_ns, eid_hint)` —
+    virtual time and the most recent host-lineage event id at draw time
+    — which is what lets a divergence report anchor the first divergent
+    draw to a delivery in the lineage DAG."""
+
+    def __init__(self, seed: int, plant: Optional[str] = None) -> None:
+        import os
+
+        self.seed = seed
+        self.key = key_from_seed(seed)
+        self.plant = (
+            os.environ.get(PLANT_ENV, "") if plant is None else plant
+        )
+        self._index: Dict[int, int] = {}
+        self.draws: List[Tuple[int, int, int, int, int]] = []
+        self.dropped = 0
+        self._time = None
+        self._lineage = None
+
+    def bind(self, time=None, lineage=None) -> "ScheduleCoins":
+        """Attach clock + lineage so draws carry (t_ns, eid) anchors."""
+        self._time = time
+        self._lineage = lineage
+        return self
+
+    def _next_index(self, site: int) -> int:
+        idx = self._index.get(site, 0)
+        self._index[site] = idx + 1
+        return idx
+
+    def _log(self, site: int, index: int, value: int) -> None:
+        if len(self.draws) >= MAX_COIN_DRAWS:
+            self.dropped += 1
+            return
+        t_ns = self._time.now_ns() if self._time is not None else -1
+        eid = (
+            self._lineage.next_eid - 1
+            if self._lineage is not None and self._lineage.enabled
+            else -1
+        )
+        self.draws.append((site, index, value, t_ns, eid))
+
+    def _coin(self, site: int, rate: float) -> bool:
+        idx = self._next_index(site)
+        hit = coin32(self.key, site, rate, index=idx)
+        self._log(site, idx, int(hit))
+        return hit
+
+    # -- clause-named draw methods (HOST_COIN_METHODS is the contract) --
+
+    def loss(self, rate: float) -> bool:
+        """MsgLoss extra-loss coin (NET_SITE_NEM_LOSS)."""
+        return self._coin(NET_SITE_NEM_LOSS, rate)
+
+    def dup(self, rate: float) -> bool:
+        """Duplicate coin (NET_SITE_DUP)."""
+        return self._coin(NET_SITE_DUP, rate)
+
+    def reorder(self, rate: float) -> bool:
+        """Reorder coin (NET_SITE_REORDER)."""
+        return self._coin(NET_SITE_REORDER, rate)
+
+    def reorder_extra(self, span_ns: int) -> int:
+        """Extra reorder delay in [0, span_ns) ns (NET_SITE_REORDER_EXTRA)."""
+        idx = self._next_index(NET_SITE_REORDER_EXTRA)
+        span = max(int(span_ns), 1)
+        if self.plant == PLANT_REORDER_OFF_BY_ONE:
+            # deliberate off-by-one in the host's reorder window: the
+            # draw modulus shifts by one, so the applied value diverges
+            # from the pure recomputation at the true span — the planted
+            # semantic skew the oracle self-test must catch
+            span += 1
+        v = randint32(self.key, NET_SITE_REORDER_EXTRA, 0, span, index=idx)
+        self._log(NET_SITE_REORDER_EXTRA, idx, v)
+        return v
+
+
+# --------------------------------------------------------------------------
 # host driver
 # --------------------------------------------------------------------------
 
@@ -627,8 +769,11 @@ class NemesisDriver:
 
     Schedule-level clauses apply through `Handle` (kill/restart) and
     `NetSim` (partition / clog_link / latency-spike windows); message-level
-    clauses are pushed into `NetConfig` so `NetSim.send` rolls them per
-    message from the global RNG. Applied events are recorded in
+    clauses are pushed into `NetConfig` together with a `ScheduleCoins`
+    provider so `NetSim.send` / `Network.test_link` draw them from the
+    same murmur3 chain as the device — every applied coin is a pure
+    function of (seed, site, index), logged on `self.coins.draws` for
+    the differential oracle. Applied events are recorded in
     `self.applied` (the host half of a twin comparison) and counted in
     `self.fired` per FIRE_KINDS.
 
@@ -655,11 +800,17 @@ class NemesisDriver:
         seed: Optional[int] = None,
         on_wipe: Optional[Callable[[int], None]] = None,
         occ_off: Optional[Dict[str, int]] = None,
+        on_crash: Optional[Callable[[int], None]] = None,
     ) -> None:
         self.plan = plan
         self.handle = handle
         self.node_ids = list(node_ids)
         self.on_wipe = on_wipe
+        # on_crash(protocol_node_index) runs before the kill, letting a
+        # workload mark the victim dead for its invariant monitors (the
+        # restart side needs no hook: nodes built with `.init(...)`
+        # respawn through their init closure)
+        self.on_crash = on_crash
         self.seed = handle.seed if seed is None else seed
         self.occ_off = dict(occ_off or {})
         # occ_off replays a SHRUNK plan (triage.py repro bundles): masked
@@ -670,6 +821,10 @@ class NemesisDriver:
             self.occ_off,
         )
         self.applied: List[NemesisEvent] = []
+        # schedule-matched message coins (installed onto the net config
+        # when the plan has message clauses; always present so twin
+        # tests can assert an empty draw log on schedule-only plans)
+        self.coins = ScheduleCoins(self.seed)
         self.fired: Dict[str, int] = {}
         # clause -> occurrence bitmask: bit k set when the OPEN half of
         # window k applied (the host face of the engine's per-lane
@@ -707,6 +862,13 @@ class NemesisDriver:
             or self.plan.get(Reorder)
         ):
             net.update_config(self.plan.to_net_config(net.network.config))
+            # schedule-matched coins: the net layer draws loss/dup/
+            # reorder from the per-seed murmur3 chain instead of the
+            # ambient GlobalRng (the fourth-face contract the oracle
+            # verifies draw-for-draw)
+            net.network.config.coins = self.coins.bind(
+                time=self.handle.time, lineage=net.lineage
+            )
         skew = self.plan.skew_ppm(self.seed, len(self.node_ids))
         if any(skew):
             # integer ppm straight through (r8): vtime.skew_delay_ns
@@ -743,6 +905,8 @@ class NemesisDriver:
                 1 << min(ev.k, 31)
             )
         if ev.kind == "crash":
+            if self.on_crash is not None:
+                self.on_crash(ev.node)
             self.handle.kill(self.node_ids[ev.node])
             self._count("crash")
             if ev.wipe:

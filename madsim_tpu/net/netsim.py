@@ -324,9 +324,13 @@ class NetSim(Simulator):
         cfg = self.network.config
         # the dup coin flips BEFORE the original's loss roll (mirroring the
         # engine, which coins every candidate): the copy's fate — its own
-        # loss roll, its own latency — is independent of the original's
-        dup = cfg.packet_duplicate_rate > 0.0 and self.rng.gen_bool(
-            cfg.packet_duplicate_rate
+        # loss roll, its own latency — is independent of the original's.
+        # With a NemesisDriver installed the coin is schedule-matched
+        # (ScheduleCoins: pure in (seed, site, index)); otherwise ambient.
+        dup = cfg.packet_duplicate_rate > 0.0 and (
+            cfg.coins.dup(cfg.packet_duplicate_rate)
+            if cfg.coins is not None
+            else self.rng.gen_bool(cfg.packet_duplicate_rate)
         )
         if dup:
             cfg.count_fire("dup")
@@ -355,10 +359,18 @@ class NetSim(Simulator):
 
         def schedule(latency_ns: int, src_ip: str, socket) -> None:
             if cfg.packet_reorder_rate > 0.0 and cfg.packet_reorder_window > 0.0:
-                if self.rng.gen_bool(cfg.packet_reorder_rate):
+                hit = (
+                    cfg.coins.reorder(cfg.packet_reorder_rate)
+                    if cfg.coins is not None
+                    else self.rng.gen_bool(cfg.packet_reorder_rate)
+                )
+                if hit:
                     cfg.count_fire("reorder")
-                    latency_ns += self.rng.randrange(
-                        0, max(round(cfg.packet_reorder_window * 1e9), 1)
+                    span_ns = max(round(cfg.packet_reorder_window * 1e9), 1)
+                    latency_ns += (
+                        cfg.coins.reorder_extra(span_ns)
+                        if cfg.coins is not None
+                        else self.rng.randrange(0, span_ns)
                     )
             # absolute-deadline timers: network latency is wire time, never
             # subject to the sender's nemesis clock skew (vtime.sleep-side)

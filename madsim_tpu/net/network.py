@@ -187,11 +187,16 @@ class Network:
             self.config.packet_loss_rate
         ):
             return None
-        if self.config.packet_extra_loss_rate > 0.0 and self.rng.gen_bool(
-            self.config.packet_extra_loss_rate
-        ):
-            self.config.count_fire("loss")
-            return None
+        if self.config.packet_extra_loss_rate > 0.0:
+            # schedule-matched when a NemesisDriver installed ScheduleCoins
+            hit = (
+                self.config.coins.loss(self.config.packet_extra_loss_rate)
+                if self.config.coins is not None
+                else self.rng.gen_bool(self.config.packet_extra_loss_rate)
+            )
+            if hit:
+                self.config.count_fire("loss")
+                return None
         self.stat.msg_count += 1
         lo = round(self.config.send_latency_min * 1e9)
         hi = round(self.config.send_latency_max * 1e9)

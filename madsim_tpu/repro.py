@@ -19,6 +19,16 @@ Host replay (`--backend host`) drives the bundle's SHRUNK FaultPlan through
 a fresh host runtime's NemesisDriver (idle nodes; the schedule needs no
 traffic) and asserts the applied fault stream equals the occurrence-filtered
 pure schedule — the twin invariant, surviving the shrink.
+
+Divergence bundles (`violation_kind == "divergence"`, written by
+madsim_tpu/oracle.py) are inherently differential, so EVERY backend choice
+routes to the oracle replay: the shrunk plan re-runs schedule-matched on
+the host twin `--repeats` times, each run must reproduce the SAME first
+divergent event bit-identically (same site/index/applied/expected, same
+state digest), and the bundle's v3 `causal` digest is cross-checked
+against the replayed host slice. A reproduced divergence prints the
+readable first-divergent-event report and the CLI exits NON-ZERO — the
+two backends still disagree, which is a live bug, not a clean replay.
 """
 
 from __future__ import annotations
@@ -262,11 +272,85 @@ def replay_host(bundle: ReproBundle, out=print) -> Dict[str, Any]:
     return {"events": len(want)}
 
 
+def replay_divergence(
+    bundle: ReproBundle, repeats: int = 2, out=print,
+) -> Dict[str, Any]:
+    """Replay a host/device divergence bundle (madsim_tpu/oracle.py):
+    re-run the shrunk plan schedule-matched on the host twin `repeats`
+    times and assert the SAME first divergent event reproduces
+    bit-identically every time. Raises ReplayError when the lane no
+    longer diverges (stale bundle / fixed tree) or when repeats disagree
+    (the replay itself is nondeterministic — a worse bug). Returns a
+    report with `diverged=True`; callers treat that as a failing exit,
+    because a reproduced divergence means the backends still disagree."""
+    from . import oracle
+
+    plan = bundle.shrunk_plan()
+    horizon_us = int(bundle.horizon_us)
+    n = int(bundle.n_nodes)
+    loss_rate = 0.1
+    if bundle.config_toml:
+        loss_rate = float(getattr(bundle.config(), "loss_rate", 0.1))
+    repeats = max(1, repeats)
+    reps = [
+        oracle.check_seed(
+            bundle.spec_name, plan, bundle.seed, horizon_us, n_nodes=n,
+            loss_rate=loss_rate, occ_off=bundle.occ_off, repeats=1,
+        )
+        for _ in range(repeats)
+    ]
+    for i, rep in enumerate(reps, start=1):
+        if not rep.diverged:
+            raise ReplayError(
+                f"replay {i}: seed {bundle.seed} did NOT diverge under the "
+                "bundle's shrunk plan — stale bundle, or the host/device "
+                "skew it recorded has been fixed"
+            )
+
+    def ident(r):
+        d = r.first
+        return (d.kind, d.site, d.index, d.applied, d.expected, d.eid,
+                r.digest, len(r.divergences))
+
+    first = reps[0]
+    for i, rep in enumerate(reps[1:], start=2):
+        if ident(rep) != ident(first):
+            raise ReplayError(
+                "divergence replay is not bit-deterministic: replay "
+                f"{i} reproduced {ident(rep)} but replay 1 gave "
+                f"{ident(first)}"
+            )
+    d = first.first
+    if bundle.causal is not None and d.slice_digest is not None and (
+        bundle.causal.get("sha") != d.slice_digest.get("sha")
+    ):
+        raise ReplayError(
+            "host causal slice diverged from the bundle's recorded digest "
+            f"({d.slice_digest.get('sha')} != {bundle.causal.get('sha')}) — "
+            "the lineage plane or the slice semantics drifted"
+        )
+    out(first.render())
+    out(
+        f"divergence reproduced bit-identically across {repeats} "
+        "schedule-matched host replays — the backends still disagree"
+    )
+    return {
+        "diverged": True,
+        "repeats": repeats,
+        "first": d.to_dict(),
+        "digest": first.digest,
+    }
+
+
 def replay(
     bundle: ReproBundle, backend: str = "tpu", spec=None, repeats: int = 2,
     trace: int = 0, perfetto: Optional[str] = None, explain: int = 0,
     out=print,
 ) -> Dict[str, Any]:
+    if bundle.violation_kind == "divergence":
+        # differential by construction: there is no single-backend replay
+        # of a host-vs-device divergence, so tpu/host/both all route here
+        return replay_divergence(bundle, repeats=repeats, out=out)
     if backend == "tpu":
         return replay_device(
             bundle, spec=spec, repeats=repeats, trace=trace,
@@ -332,12 +416,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         root, _ = os.path.splitext(args.bundle)
         perfetto = f"{root}.perfetto.json"
     try:
-        replay(
+        rep = replay(
             bundle, backend=args.backend, repeats=args.repeats,
             trace=args.trace, perfetto=perfetto, explain=args.explain,
         )
     except (ReplayError, ValueError) as e:
         print(f"REPLAY FAILED: {e}", file=sys.stderr)
+        return 1
+    if rep.get("diverged"):
+        # the divergence reproduced — that's a live host-vs-device bug,
+        # so the CLI fails even though the replay itself succeeded
         return 1
     return 0
 

@@ -755,6 +755,28 @@ def record_slice(line: Dict[str, Any], **labels: Any) -> None:
     )
 
 
+def record_oracle(status: Dict[str, Any], **labels: Any) -> None:
+    """One differential-oracle tenant status (oracle.OracleTenant.status)
+    → registry: lanes replayed, divergences found, sampling pressure
+    (docs/oracle.md)."""
+    reg = _STATE.registry
+    if reg is None:
+        return
+    reg.gauge("oracle_seeds_checked", "lanes replayed schedule-matched") \
+        .set(int(status.get("seeds_checked", 0)), **labels)
+    reg.gauge("oracle_divergences", "host/schedule divergences found") \
+        .set(int(status.get("divergences", 0)), **labels)
+    reg.gauge("oracle_draws_checked", "coin draws verified draw-for-draw") \
+        .set(int(status.get("draws_checked", 0)), **labels)
+    reg.gauge(
+        "oracle_skipped_saturated",
+        "sampled lanes dropped by the per-round budget",
+    ).set(int(status.get("skipped_saturated", 0)), **labels)
+    reg.gauge("oracle_sample_rate", "oracle lane-sampling rate").set(
+        float(status.get("sample_rate", 0.0)), **labels
+    )
+
+
 # --------------------------------------------------------------------------
 # Perfetto / Chrome-trace timelines
 # --------------------------------------------------------------------------

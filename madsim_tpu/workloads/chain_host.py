@@ -186,7 +186,15 @@ def check_invariants(nodes: List[ChainNode]) -> dict:
 
 
 async def _fuzz_body(
-    n_nodes: int, virtual_secs: float, chaos: bool, tails: bool, buggy: bool
+    n_nodes: int,
+    virtual_secs: float,
+    chaos: bool,
+    tails: bool,
+    buggy: bool,
+    plan=None,
+    occ_off=None,
+    seed=None,
+    lineage: bool = False,
 ) -> dict:
     handle = ms.Handle.current()
     from madsim_tpu.net import NetSim
@@ -194,12 +202,46 @@ async def _fuzz_body(
     if tails:
         ms.buggify.enable()  # arms NetSim's 1-5 s straggler tail
     addrs = [f"10.0.5.{i + 1}:7300" for i in range(n_nodes)]
-    cns = [ChainNode(i, n_nodes, addrs, buggy=buggy) for i in range(n_nodes)]
+    cns: list = [None] * n_nodes
+
+    def make_node(i: int) -> ChainNode:
+        """Fresh node; durable store/version counter/history carried over
+        from the previous incarnation unless wiped."""
+        old = cns[i]
+        fresh = ChainNode(i, n_nodes, addrs, buggy=buggy)
+        if old is not None:
+            fresh.store = dict(old.store)
+            fresh.vnext = dict(old.vnext)
+            fresh.history = old.history
+        cns[i] = fresh
+        return fresh
+
     nodes = []
-    for i in range(n_nodes):
-        node = handle.create_node().name(f"ch-{i}").ip(f"10.0.5.{i + 1}").build()
-        node.spawn(cns[i].run())
-        nodes.append(node)
+    if plan is not None:
+        # schedule-matched mode: crash/restart come from the compiled
+        # FaultPlan stream; `.init(...)` closures let NemesisDriver's
+        # handle.restart respawn the protocol node with the same
+        # durable-state carry the host-native chaos_task performs
+        def make_init(i: int):
+            def _init():
+                return make_node(i).run()
+
+            return _init
+
+        for i in range(n_nodes):
+            node = (
+                handle.create_node()
+                .name(f"ch-{i}")
+                .ip(f"10.0.5.{i + 1}")
+                .init(make_init(i))
+                .build()
+            )
+            nodes.append(node)
+    else:
+        for i in range(n_nodes):
+            node = handle.create_node().name(f"ch-{i}").ip(f"10.0.5.{i + 1}").build()
+            node.spawn(make_node(i).run())
+            nodes.append(node)
 
     async def chaos_task() -> None:
         while True:
@@ -217,8 +259,30 @@ async def _fuzz_body(
             handle.restart(nodes[victim].id)
             nodes[victim].spawn(fresh.run())
 
-    if chaos:
+    if chaos and plan is None:
         ms.spawn(chaos_task())
+
+    driver = None
+    if plan is not None:
+        from madsim_tpu import nemesis as nem
+
+        net = ms.plugin.simulator(NetSim)
+        if lineage:
+            net.lineage.enable()
+
+        def on_wipe(i: int) -> None:
+            cns[i] = None  # next incarnation starts from init state
+
+        driver = nem.NemesisDriver(
+            plan,
+            handle,
+            node_ids=[n.id for n in nodes],
+            horizon_us=int(virtual_secs * 1e6),
+            seed=seed,
+            on_wipe=on_wipe,
+            occ_off=occ_off,
+        )
+        driver.install()
 
     t = ms.time.current()
     end = t.elapsed() + virtual_secs
@@ -229,6 +293,25 @@ async def _fuzz_body(
     stats["committed_max_ver"] = max(
         (v for _k, (_x, v) in cns[-1].store.items()), default=0
     )
+    if driver is not None:
+        net = ms.plugin.simulator(NetSim)
+        stats["nemesis"] = {
+            "applied": list(driver.applied),
+            "occ_fired": dict(driver.occ_fired),
+            "node_skew": dict(getattr(handle.time, "node_skew", {}) or {}),
+            "node_ids": [n.id for n in nodes],
+            "coins": driver.coins,
+            "fires": driver.fire_counts(),
+            "lineage": net.lineage if lineage else None,
+            "state": [
+                (
+                    tuple(sorted(cn.store.items())),
+                    tuple(sorted(cn.vnext.items())),
+                    len(cn.history),
+                )
+                for cn in cns
+            ],
+        }
     # no buggify.disable() needed: the flag is per-Runtime handle state
     # and dies with this runtime when block_on returns
     return stats
@@ -242,11 +325,22 @@ def fuzz_one_seed(
     chaos: bool = True,
     tails: bool = False,
     buggy: bool = False,
+    plan=None,
+    occ_off=None,
+    lineage: bool = False,
 ) -> dict:
-    """One complete fuzzed execution, verified by the same oracle."""
+    """One complete fuzzed execution, verified by the same oracle.
+
+    With `plan=` (a `nemesis.FaultPlan`), chaos comes from the compiled
+    per-seed schedule via `NemesisDriver` (the schedule-matched mode the
+    differential oracle replays); the returned dict then carries a
+    `"nemesis"` artifact bundle."""
     cfg = ms.Config()
     cfg.net.packet_loss_rate = loss_rate
     rt = ms.Runtime(seed=seed, config=cfg)
     return rt.block_on(
-        _fuzz_body(n_nodes, virtual_secs, chaos, tails, buggy)
+        _fuzz_body(
+            n_nodes, virtual_secs, chaos, tails, buggy,
+            plan=plan, occ_off=occ_off, seed=seed, lineage=lineage,
+        )
     )

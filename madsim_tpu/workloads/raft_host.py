@@ -262,23 +262,62 @@ async def _fuzz_body(
     buggy: bool,
     client_rate: float,
     partitions: bool = False,
+    plan=None,
+    occ_off=None,
+    seed=None,
+    lineage: bool = False,
 ) -> dict:
     handle = ms.Handle.current()
     from madsim_tpu.net import NetSim
 
     addrs = [f"10.0.1.{i + 1}:6000" for i in range(n_nodes)]
-    rafts = [
-        RaftNode(i, n_nodes, addrs, buggy=buggy, client_rate=client_rate)
-        for i in range(n_nodes)
-    ]
-    nodes = []
-    for i in range(n_nodes):
-        node = handle.create_node().name(f"raft-{i}").ip(f"10.0.1.{i + 1}").build()
-        node.spawn(rafts[i].run())
-        nodes.append(node)
+    rafts: list = [None] * n_nodes
 
     first_committed: dict = {}  # index -> (term, cmd) first observed committed
     dead: set = set()  # node ids currently killed (state frozen mid-crash)
+
+    def make_node(i: int) -> RaftNode:
+        """Fresh node object; durable state (term/vote/log/next_cmd) is
+        carried over from the previous incarnation unless it was wiped."""
+        old = rafts[i]
+        fresh = RaftNode(i, n_nodes, addrs, buggy=buggy, client_rate=client_rate)
+        if old is not None:
+            fresh.term, fresh.voted_for = old.term, old.voted_for
+            fresh.log = list(old.log)
+            fresh.next_cmd = old.next_cmd
+        rafts[i] = fresh
+        return fresh
+
+    nodes = []
+    if plan is not None:
+        # schedule-matched mode: crash/restart come from the compiled
+        # FaultPlan stream (NemesisDriver), so nodes are built with
+        # `.init(...)` closures — `handle.restart` respawns the protocol
+        # node through the same durable-state carry the host-native
+        # chaos_task below performs
+        def make_init(i: int):
+            def _init():
+                dead.discard(i)
+                return make_node(i).run()
+
+            return _init
+
+        for i in range(n_nodes):
+            node = (
+                handle.create_node()
+                .name(f"raft-{i}")
+                .ip(f"10.0.1.{i + 1}")
+                .init(make_init(i))
+                .build()
+            )
+            nodes.append(node)
+    else:
+        for i in range(n_nodes):
+            node = (
+                handle.create_node().name(f"raft-{i}").ip(f"10.0.1.{i + 1}").build()
+            )
+            node.spawn(make_node(i).run())
+            nodes.append(node)
 
     def check_invariants() -> None:
         # election safety (a killed node's state is frozen; still applies)
@@ -352,7 +391,7 @@ async def _fuzz_body(
             handle.restart(nodes[victim].id)
             nodes[victim].spawn(fresh.run())
 
-    if chaos:
+    if chaos and plan is None:
         ms.spawn(chaos_task())
 
     async def partition_task() -> None:
@@ -369,19 +408,64 @@ async def _fuzz_body(
             await ms.time.sleep(0.5 + ms.rand() * 1.5)
             net.heal_partition(group_a, group_b)
 
-    if partitions:
+    if partitions and plan is None:
         ms.spawn(partition_task())
+
+    driver = None
+    if plan is not None:
+        from madsim_tpu import nemesis as nem
+
+        net = ms.plugin.simulator(NetSim)
+        if lineage:
+            net.lineage.enable()
+
+        def on_wipe(i: int) -> None:
+            # crash-with-wipe: the next incarnation starts from init
+            # state (durable state gone), like the device's wipe path
+            rafts[i] = None
+
+        driver = nem.NemesisDriver(
+            plan,
+            handle,
+            node_ids=[n.id for n in nodes],
+            horizon_us=int(virtual_secs * 1e6),
+            seed=seed,
+            on_wipe=on_wipe,
+            occ_off=occ_off,
+            on_crash=dead.add,
+        )
+        driver.install()
 
     t = ms.time.current()
     end = t.elapsed() + virtual_secs
     while t.elapsed() < end:
         await ms.time.sleep(0.01)
         check_invariants()
-    return {
+    stats = {
         "events": ms.plugin.simulator(NetSim).stat().msg_count,
         "commits": [r.commit for r in rafts],
         "max_term": max(r.term for r in rafts),
     }
+    if driver is not None:
+        # the comparator surfaces (madsim_tpu/oracle.py): the applied
+        # schedule stream, occurrence masks, skew assignment, coin draw
+        # log, fire counts, lineage mirror, and a canonical durable-state
+        # snapshot for digesting
+        net = ms.plugin.simulator(NetSim)
+        stats["nemesis"] = {
+            "applied": list(driver.applied),
+            "occ_fired": dict(driver.occ_fired),
+            "node_skew": dict(getattr(handle.time, "node_skew", {}) or {}),
+            "node_ids": [n.id for n in nodes],
+            "coins": driver.coins,
+            "fires": driver.fire_counts(),
+            "lineage": net.lineage if lineage else None,
+            "state": [
+                (r.term, r.voted_for, tuple(r.log), r.commit, r.next_cmd)
+                for r in rafts
+            ],
+        }
+    return stats
 
 
 def fuzz_one_seed(
@@ -393,11 +477,24 @@ def fuzz_one_seed(
     buggy: bool = False,
     client_rate: float = 0.5,
     partitions: bool = False,
+    plan=None,
+    occ_off=None,
+    lineage: bool = False,
 ) -> dict:
-    """One complete fuzzed execution (the unit the reference runs per thread)."""
+    """One complete fuzzed execution (the unit the reference runs per thread).
+
+    With `plan=` (a `nemesis.FaultPlan`), chaos comes from the compiled
+    per-seed schedule via `NemesisDriver` instead of the host-native
+    chaos/partition tasks — the schedule-matched mode the differential
+    oracle (`madsim_tpu/oracle.py`) replays; the returned dict carries a
+    `"nemesis"` artifact bundle (applied stream, coin draws, skew, state
+    snapshot, optional lineage when `lineage=True`)."""
     cfg = ms.Config()
     cfg.net.packet_loss_rate = loss_rate
     rt = ms.Runtime(seed=seed, config=cfg)
     return rt.block_on(
-        _fuzz_body(n_nodes, virtual_secs, chaos, buggy, client_rate, partitions)
+        _fuzz_body(
+            n_nodes, virtual_secs, chaos, buggy, client_rate, partitions,
+            plan=plan, occ_off=occ_off, seed=seed, lineage=lineage,
+        )
     )

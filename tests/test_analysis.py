@@ -422,6 +422,53 @@ def test_mirror_rule_ignores_docstring_prose():
                for v in res.violations), [v.render() for v in res.violations]
 
 
+def test_mirror_rule_fires_on_clause_without_host_coin_methods():
+    """Face (f): a message clause with no HOST_COIN_METHODS entry is a
+    FaultPlan clause whose host draws the oracle cannot verify."""
+    from madsim_tpu import nemesis as nem
+
+    partial = {
+        k: v for k, v in nem.HOST_COIN_METHODS.items() if k != "reorder"
+    }
+    res = lint.check_mirror(host_coin_methods=partial)
+    assert not res.ok
+    assert any(
+        "reorder" in v.detail and "not schedule-matched" in v.detail
+        for v in res.violations
+    ), [v.render() for v in res.violations]
+
+
+def test_mirror_rule_fires_when_net_layer_never_draws():
+    """Face (f): a registered draw method the net layer never calls means
+    that clause's host face fell back to the ambient rng."""
+    res = lint.check_mirror(net_source="x = 1\n")
+    assert not res.ok
+    assert any(
+        "never called" in v.detail and "ambient rng" in v.detail
+        for v in res.violations
+    ), [v.render() for v in res.violations]
+
+
+def test_mirror_rule_fires_when_oracle_ignores_the_registry():
+    """Face (f): oracle.py must consume HOST_COIN_METHODS itself, or a
+    new clause could ship on three faces without a comparator."""
+    res = lint.check_mirror(oracle_source="pass\n")
+    assert not res.ok
+    assert any(
+        "HOST_COIN_METHODS" in v.detail for v in res.violations
+    ), [v.render() for v in res.violations]
+
+
+def test_mirror_rule_fires_on_stray_host_coin_entry():
+    from madsim_tpu import nemesis as nem
+
+    stray = dict(nem.HOST_COIN_METHODS)
+    stray["jitter"] = ("loss",)
+    res = lint.check_mirror(host_coin_methods=stray)
+    assert not res.ok
+    assert any("jitter" in v.detail for v in res.violations)
+
+
 def test_mirror_rule_passes_shipped_registries():
     res = lint.check_mirror()
     assert res.ok, [v.render() for v in res.violations]

@@ -280,9 +280,9 @@ def test_lineage_three_face_twin_on_chaotic_raft_plan():
 
     Unlike the chaos-STREAM twins above, device and host edges are not
     compared event-for-event: the backends roll their own network
-    latencies (the documented vs_host_note caveat; schedule-matched host
-    replay is ROADMAP item 5), so the trajectories — and therefore the
-    delivery sets — differ by design. What all three faces share, and
+    latencies (schedule-matched host replay and its divergence oracle
+    live in madsim_tpu/oracle.py), so the trajectories — and therefore
+    the delivery sets — differ by design. What all three faces share, and
     what this test pins, is the lineage law with one sender-value
     vocabulary: a message carries its send EVENT's id, and delivery
     updates max(local, sender) + 1."""

@@ -1,0 +1,359 @@
+"""The differential oracle (madsim_tpu/oracle.py): schedule-matched host
+replay as a standing bug detector.
+
+Three pillars, none vacuous:
+
+  * the host NemesisDriver consumes the compiled per-seed schedule
+    VERBATIM — all eight clauses, pure schedule == host-applied stream,
+    including the integer-ppm skew truncation and every logged
+    loss/dup/reorder coin draw recomputed from the murmur3 chain;
+  * the divergence-injection self-test plants a real host/device
+    semantic skew (nemesis.PLANT_REORDER_OFF_BY_ONE: an off-by-one in
+    the host's reorder-window span) and proves the oracle fires,
+    shrinks through ddmin to the reorder clause alone, dedups two
+    witnesses into ONE BugRecord, and names the first divergent
+    delivery via the host causal slice — while the SAME lane without
+    the plant stays green with a non-trivial draw count;
+  * the serve tenant's cursors and counters survive kill/restart
+    through oracle.json (torn files degrade to a reset, never a crash).
+"""
+
+import json
+import os
+import types
+
+import pytest
+
+from madsim_tpu import nemesis as nem
+from madsim_tpu import oracle, triage
+
+# --------------------------------------------------------------------------
+# plans
+# --------------------------------------------------------------------------
+
+# all eight clauses, intervals tightened so every schedule-level clause
+# fires inside the 3 s horizon
+PLAN8 = nem.FaultPlan(name="oracle-all8", clauses=(
+    nem.Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=200_000, down_hi_us=800_000),
+    nem.Partition(interval_lo_us=500_000, interval_hi_us=1_800_000,
+                  heal_lo_us=300_000, heal_hi_us=1_000_000),
+    nem.LinkClog(interval_lo_us=600_000, interval_hi_us=2_000_000,
+                 heal_lo_us=300_000, heal_hi_us=1_000_000),
+    nem.LatencySpike(interval_lo_us=500_000, interval_hi_us=2_000_000,
+                     duration_lo_us=200_000, duration_hi_us=800_000,
+                     extra_us=80_000),
+    nem.MsgLoss(rate=0.05),
+    nem.Duplicate(rate=0.05),
+    nem.Reorder(rate=0.15, window_us=40_000),
+    nem.ClockSkew(max_ppm=30_000),
+))
+HOR8 = 3_000_000
+
+# the plant-test plan: small atom universe so ddmin stays cheap, with
+# enough reorder traffic that the off-by-one must surface
+PLAN_PLANT = nem.FaultPlan(name="oracle-plant", clauses=(
+    nem.Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=200_000, down_hi_us=800_000),
+    nem.MsgLoss(rate=0.05),
+    nem.Reorder(rate=0.2, window_us=40_000),
+))
+HOR_PLANT = 2_000_000
+
+N, SEED = 5, 7
+
+
+def _run_twin(plan, seed, horizon_us):
+    run = oracle._raft_twin(seed, plan, None, N, horizon_us / 1e6, 0.1)
+    return run["nemesis"]
+
+
+# --------------------------------------------------------------------------
+# tentpole: pure schedule == host-applied stream, all eight clauses
+# --------------------------------------------------------------------------
+
+
+def test_host_applies_compiled_schedule_verbatim_all_eight_clauses():
+    sched = PLAN8.schedule(SEED, HOR8, N)
+    kinds = {ev.kind for ev in sched}
+    # every schedule-level clause fired (skew stamps at t=0)
+    assert {"crash", "split", "clog", "spike_on", "skew"} <= kinds
+
+    art = _run_twin(PLAN8, SEED, HOR8)
+    expected = [ev for ev in sched if ev.kind != "skew"]
+    # verbatim: same events, same order, same fields (NemesisEvent eq)
+    assert list(art["applied"]) == expected
+
+    # skew face: integer-ppm truncation, zero-ppm nodes omitted
+    want_skew = {
+        art["node_ids"][i]: ppm
+        for i, ppm in enumerate(PLAN8.skew_ppm(SEED, N))
+        if ppm != 0
+    }
+    assert art["node_skew"] == want_skew
+    assert all(isinstance(v, int) for v in art["node_skew"].values())
+    assert want_skew, "ClockSkew clause drew all-zero ppm — vacuous"
+
+
+def test_every_coin_draw_matches_the_pure_chain():
+    art = _run_twin(PLAN8, SEED, HOR8)
+    coins = art["coins"]
+    assert coins.dropped == 0
+    sites_seen = {s for s, *_ in coins.draws}
+    # all four message-level draw sites consumed traffic
+    assert {
+        nem.NET_SITE_NEM_LOSS, nem.NET_SITE_DUP, nem.NET_SITE_REORDER,
+        nem.NET_SITE_REORDER_EXTRA,
+    } <= sites_seen
+
+    key = nem.key_from_seed(SEED)
+    reorder = PLAN8.get(nem.Reorder)
+    span = max(round(reorder.window_us / 1e6 * 1e9), 1)
+    rate = {
+        nem.NET_SITE_NEM_LOSS: PLAN8.get(nem.MsgLoss).rate,
+        nem.NET_SITE_DUP: PLAN8.get(nem.Duplicate).rate,
+        nem.NET_SITE_REORDER: reorder.rate,
+    }
+    for site, index, value, _t, _eid in coins.draws:
+        if site == nem.NET_SITE_REORDER_EXTRA:
+            assert value == nem.randint32(key, site, 0, span, index=index)
+        else:
+            assert value == int(nem.coin32(key, site, rate[site], index=index))
+
+
+def test_check_seed_clean_tree_matches():
+    rep = oracle.check_seed("raft5", PLAN8, SEED, HOR8, n_nodes=N,
+                            loss_rate=0.1, repeats=2)
+    assert not rep.diverged, rep.render()
+    # never vacuously green: the lane exercised all surfaces
+    assert rep.schedule_events > 0
+    assert rep.draws > 100
+    assert rep.skew_nodes > 0
+    assert rep.lineage_edges > 0
+    assert rep.digest
+    assert rep.render().endswith("MATCH")
+
+
+def test_check_seed_unknown_spec_raises():
+    with pytest.raises(ValueError, match="no host twin"):
+        oracle.check_seed("twopc5", PLAN_PLANT, 0, HOR_PLANT)
+
+
+# --------------------------------------------------------------------------
+# satellite: divergence injection — the oracle is never vacuously green
+# --------------------------------------------------------------------------
+
+
+def test_planted_skew_fires_and_names_first_divergent_delivery(monkeypatch):
+    # the SAME lane is green without the plant...
+    clean = oracle.check_seed("raft5", PLAN_PLANT, 3, HOR_PLANT, n_nodes=N,
+                              repeats=1)
+    assert not clean.diverged, clean.render()
+    assert clean.draws > 0
+
+    # ...and fires with it
+    monkeypatch.setenv(nem.PLANT_ENV, nem.PLANT_REORDER_OFF_BY_ONE)
+    rep = oracle.check_seed("raft5", PLAN_PLANT, 3, HOR_PLANT, n_nodes=N,
+                            repeats=1)
+    assert rep.diverged
+    first = rep.first
+    assert first.kind == "coin"
+    assert first.site == "reorder_extra"
+    assert first.applied != first.expected
+    # the headline names the first divergent event, anchored into the
+    # host lineage DAG
+    assert first.eid >= 0
+    assert first.slice_text, "divergence not anchored to a delivery"
+    assert first.slice_digest is not None
+    text = rep.render()
+    assert "first divergent event" in text
+    assert "causal slice" in text
+
+
+def test_planted_skew_shrinks_to_the_reorder_clause(monkeypatch, tmp_path):
+    monkeypatch.setenv(nem.PLANT_ENV, nem.PLANT_REORDER_OFF_BY_ONE)
+    sr = oracle.shrink_divergence(
+        "raft5", PLAN_PLANT, 3, HOR_PLANT, n_nodes=N,
+        out_dir=str(tmp_path),
+    )
+    # 1-minimal: the off-by-one lives in the reorder window, so ddmin
+    # must keep exactly that clause
+    assert sr.kept_atoms == [("reorder", None)]
+    b = sr.bundle
+    assert b.violation_kind == "divergence"
+    assert b.causal is not None and b.causal.get("sha")
+    assert any("first divergent event" in ln for ln in b.trace_tail)
+    # round-trips through the v3 bundle format unchanged
+    loaded = triage.ReproBundle.load(sr.bundle_path)
+    assert loaded.violation_kind == "divergence"
+    assert loaded.plan == b.plan
+
+
+def test_no_divergence_means_not_reproducible():
+    with pytest.raises(triage.NotReproducible):
+        oracle.shrink_divergence("raft5", PLAN_PLANT, 3, HOR_PLANT, n_nodes=N)
+
+
+def test_divergence_bugs_dedup_to_one_record(monkeypatch, tmp_path):
+    monkeypatch.setenv(nem.PLANT_ENV, nem.PLANT_REORDER_OFF_BY_ONE)
+    camp = types.SimpleNamespace(
+        bugs=[], _by_sig={}, bundles_dir=str(tmp_path),
+        campaign_id="oracle-test", generation=2,
+        spec_ref=None, spec_kwargs={},
+    )
+    seeds = []
+    for s in range(3, 10):
+        rep = oracle.check_seed("raft5", PLAN_PLANT, s, HOR_PLANT,
+                                n_nodes=N, repeats=1)
+        if rep.diverged:
+            seeds.append((s, rep))
+        if len(seeds) == 2:
+            break
+    assert len(seeds) == 2, "plant did not fire on two lanes"
+
+    rec1 = oracle.divergence_bug(camp, seeds[0][1], PLAN_PLANT, HOR_PLANT, N)
+    rec2 = oracle.divergence_bug(camp, seeds[1][1], PLAN_PLANT, HOR_PLANT, N)
+    # both witnesses shrink to the same clause profile -> ONE BugRecord
+    assert rec1 is rec2
+    assert len(camp.bugs) == 1
+    assert rec1.violation_kind == "divergence"
+    assert len(rec1.witnesses) == 2
+    assert all(w["origin"] == "oracle" for w in rec1.witnesses)
+    assert rec1.shrink_error is None
+    assert rec1.bundle_path and os.path.exists(rec1.bundle_path)
+    b = triage.ReproBundle.load(rec1.bundle_path)
+    assert b.violation_kind == "divergence"
+    assert b.signature == rec1.signature
+
+
+# --------------------------------------------------------------------------
+# satellite: repro --backend both on a divergence bundle
+# --------------------------------------------------------------------------
+
+
+def _plant_bundle(tmp_path):
+    sr = oracle.shrink_divergence(
+        "raft5", PLAN_PLANT, 3, HOR_PLANT, n_nodes=N,
+        out_dir=str(tmp_path),
+    )
+    return sr.bundle_path
+
+
+def test_repro_both_reproduces_divergence_and_exits_nonzero(
+    monkeypatch, tmp_path, capsys,
+):
+    from madsim_tpu import repro
+
+    monkeypatch.setenv(nem.PLANT_ENV, nem.PLANT_REORDER_OFF_BY_ONE)
+    path = _plant_bundle(tmp_path)
+    # a reproduced divergence is a LIVE bug: readable report, non-zero exit
+    rc = repro.main([path, "--backend", "both"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "first divergent event" in out
+    assert "reorder_extra" in out
+    assert "bit-identically across 2 schedule-matched host replays" in out
+
+
+def test_repro_divergence_replay_is_differential_on_every_backend(
+    monkeypatch, tmp_path,
+):
+    from madsim_tpu import repro
+
+    monkeypatch.setenv(nem.PLANT_ENV, nem.PLANT_REORDER_OFF_BY_ONE)
+    bundle = triage.ReproBundle.load(_plant_bundle(tmp_path))
+    # tpu/host/both all route to the oracle replay — a divergence has no
+    # single-backend reproduction
+    for backend in ("tpu", "host", "both"):
+        rep = repro.replay(bundle, backend=backend, out=lambda s: None)
+        assert rep["diverged"]
+        assert rep["repeats"] == 2
+        assert rep["first"]["site"] == "reorder_extra"
+
+
+def test_repro_divergence_stale_bundle_fails_loudly(
+    monkeypatch, tmp_path, capsys,
+):
+    from madsim_tpu import repro
+
+    monkeypatch.setenv(nem.PLANT_ENV, nem.PLANT_REORDER_OFF_BY_ONE)
+    path = _plant_bundle(tmp_path)
+    # the skew the bundle recorded is "fixed" (plant removed): the lane
+    # no longer diverges and the replay must say so, not pass vacuously
+    monkeypatch.delenv(nem.PLANT_ENV)
+    rc = repro.main([path, "--backend", "both"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "did NOT diverge" in err
+
+
+# --------------------------------------------------------------------------
+# satellite: the serve tenant resumes across kill/restart
+# --------------------------------------------------------------------------
+
+
+def test_tenant_state_survives_kill_restart(tmp_path):
+    path = str(tmp_path / "oracle.json")
+    t1 = oracle.OracleTenant(state_path=path)
+    t1.cursor = {"c1": 5, "c2": 2}
+    t1.seeds_checked = 7
+    t1.divergences = 1
+    t1.skipped_saturated = 3
+    t1.save()
+
+    t2 = oracle.OracleTenant(state_path=path)
+    assert t2.cursor == {"c1": 5, "c2": 2}
+    assert t2.seeds_checked == 7
+    assert t2.divergences == 1
+    assert t2.skipped_saturated == 3
+
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["format"] == "madsim-tpu-oracle/1"
+
+
+def test_tenant_tolerates_torn_state_file(tmp_path):
+    path = str(tmp_path / "oracle.json")
+    with open(path, "w") as f:
+        f.write('{"format": "madsim-tpu-ora')  # killed mid-write
+    t = oracle.OracleTenant(state_path=path)
+    assert t.cursor == {}
+    assert t.seeds_checked == 0
+
+
+def test_tenant_skips_specs_without_twin():
+    t = oracle.OracleTenant()
+    camp = types.SimpleNamespace(spec_name="twopc5")
+    out = t.observe("c1", camp)
+    assert out == {"campaign": "c1", "checked": 0, "diverged": 0,
+                   "skipped": 1}
+    assert t.skipped_no_twin == 1
+
+
+def _stub_corpus_campaign(gen, entries):
+    ex = types.SimpleNamespace(corpus=[
+        types.SimpleNamespace(
+            cand=types.SimpleNamespace(seed=s),
+            dispatch=d,
+        )
+        for s, d in entries
+    ])
+    return types.SimpleNamespace(generation=gen, ex=ex)
+
+
+def test_tenant_sampling_is_deterministic_and_cursor_advances():
+    entries = [(s, g) for g in range(3) for s in range(g * 10, g * 10 + 6)]
+    a = oracle.OracleTenant(sample_rate=0.5)
+    b = oracle.OracleTenant(sample_rate=0.5)
+    camp = _stub_corpus_campaign(3, entries)
+    sa = a._sampled("c", camp)
+    sb = b._sampled("c", camp)
+    # pure in (seed, generation): two services agree on the lane set
+    assert sa == sb
+    assert 0 < len(sa) < len(entries)
+    # the cursor consumed generations [0, 3) — same round resamples nothing
+    assert a._sampled("c", camp) == []
+    # new generations only: entries below the cursor never re-sample
+    camp2 = _stub_corpus_campaign(4, entries + [(99, 3)])
+    again = a._sampled("c", camp2)
+    assert all(s == 99 for s in again)

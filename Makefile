@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke reconfig-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -50,6 +50,10 @@ explain-smoke:   ## causal explainability end to end: the <60s-warm bench gate (
 oracle-smoke:    ## <60s CPU: the differential oracle both ways — a small raft chaos sweep replays schedule-matched on the host twin with zero divergences, then the planted reorder off-by-one fires, localizes to the reorder-window draw, and ddmin-shrinks to the reorder clause (never vacuously green), then the oracle suite
 	$(PY) benches/oracle_smoke.py
 	$(PY) -m pytest tests/test_oracle.py -q
+
+reconfig-smoke:  ## <60s CPU: membership as a fault axis end to end — the planted kafka-family stale-ISR bug under a reconfig-ONLY plan is found by the explorer, ddmin-shrinks to reconfig occurrence atoms, campaign-dedups to ONE BugRecord, and the cross-witness anatomy names the rejoined replica's FETCH delivery; then the isr/lease spec suites
+	$(PY) benches/reconfig_smoke.py
+	$(PY) -m pytest tests/test_tpu_isr.py tests/test_tpu_lease.py -q -m "not slow"
 
 tune:            ## measured autotune over every workload's throughput knobs; winners cached per (device_kind, workload, config, lane bucket) and consumed via tuning="auto" (docs/tuning.md)
 	$(PY) -m madsim_tpu.tune --workload all --virtual-secs 10 --lanes 32768

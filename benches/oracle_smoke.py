@@ -52,6 +52,8 @@ def main() -> None:
         nem.Duplicate(rate=0.05),
         nem.Reorder(rate=0.15, window_us=40_000),
         nem.ClockSkew(max_ppm=30_000),
+        nem.Reconfig(interval_lo_us=600_000, interval_hi_us=1_600_000,
+                     down_lo_us=300_000, down_hi_us=900_000),
     ))
 
     # -- MATCH: the shipped tree replays schedule-matched, zero drift ----

@@ -1,0 +1,168 @@
+"""reconfig-smoke: <60s membership-axis gate for CI.
+
+The r17 reconfig clause's pitch is that dynamic membership is a fault
+AXIS, not scenery: a bug class REACHABLE ONLY through remove/join churn
+must flow through the whole farm — explorer, ddmin, campaign dedup,
+causal anatomy — and come out the other side named. This smoke walks
+that path on the planted kafka-family ISR bug (a wipe-joined replica
+re-enters the ISR without catch-up, `make_isr_spec(buggy_stale_isr=
+True)`) under a reconfig-ONLY plan — no crash clauses, loss pinned low —
+so the shrunk minimal plan can only ever blame the membership axis:
+
+  * FIND: one explorer generation over the planted config surfaces the
+    bug on multiple fresh seeds (the bug is seed-dense under churn, the
+    regime campaign dedup exists for);
+  * SHRINK: the campaign ddmin-shrinks the first witness and the kept
+    minimal plan names `reconfig` occurrence atoms (crash cannot appear:
+    the plan has none to keep);
+  * DEDUP: every further violating seed attaches as a witness of ONE
+    BugRecord — one bug class, one record, a saved ReproBundle;
+  * ANATOMY: the r12 cross-witness skeleton names the reconfig delivery
+    mechanism — the FETCH delivery from the rejoined replica that the
+    stale-ISR leader admits without catch-up;
+  * CONTROL: the correct spec stays silent under the exact same churn.
+
+Wall times are printed for eyes only. Usage:
+python benches/reconfig_smoke.py  (or `make reconfig-smoke`)
+Exit code != 0 on any assertion failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LANES = 32
+VIRTUAL_SECS = 6.0
+
+
+def reconfig_only_workload(buggy: bool = True):
+    """The planted ISR config with membership churn as the ONLY schedule
+    clause (loss stays as low message noise). `isr_workload` proper runs
+    crash + reconfig together; this bench isolates the axis so ddmin's
+    verdict is unambiguous."""
+    from madsim_tpu.tpu.batch import BatchWorkload
+    from madsim_tpu.tpu.isr import make_isr_spec
+    from madsim_tpu.tpu.spec import SimConfig, pool_kw_for
+
+    spec = make_isr_spec(5, buggy_stale_isr=buggy)
+    cfg = SimConfig(
+        horizon_us=int(VIRTUAL_SECS * 1e6),
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+        loss_rate=0.05,
+        nem_reconfig_interval_lo_us=600_000,
+        nem_reconfig_interval_hi_us=1_800_000,
+        # down windows above repl_timeout_us so eviction precedes rejoin
+        nem_reconfig_down_lo_us=300_000,
+        nem_reconfig_down_hi_us=900_000,
+    )
+    return BatchWorkload(spec=spec, config=cfg)
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from madsim_tpu import campaign
+    from madsim_tpu.tpu.engine import BatchedSim
+
+    wl = reconfig_only_workload(buggy=True)
+    sim = BatchedSim(wl.spec, wl.config, triage=True, coverage=True)
+    root = tempfile.mkdtemp(prefix="reconfig_smoke_")
+    try:
+        # -- find + shrink + dedup: one campaign generation -------------
+        camp = campaign.Campaign(
+            wl, os.path.join(root, "c"), meta_seed=0, lanes=LANES,
+            shrink=True, max_shrinks=2, sim=sim,
+            anatomy=True, max_anatomy_witnesses=2,
+        )
+        rep = camp.run(1)
+        t_campaign = time.perf_counter() - t0
+        n_viol = len(camp.ex.violations)
+        assert n_viol >= 2, (
+            f"planted ISR bug found on only {n_viol} candidates — "
+            "membership churn is not reaching the stale-ISR admission"
+        )
+
+        # -- dedup: one bug class, ONE record ---------------------------
+        assert len(camp.bugs) == 1, (
+            f"one planted bug must dedup to one BugRecord, got "
+            f"{len(camp.bugs)}: "
+            f"{[(b.signature[:12], b.violation_kind) for b in camp.bugs]}"
+        )
+        bug = camp.bugs[0]
+        assert bug.shrink_error is None, f"shrink failed: {bug.shrink_error}"
+        assert len(bug.witnesses) >= 2, (
+            f"seed-dense bug attached only {len(bug.witnesses)} witnesses"
+        )
+
+        # -- shrink: the minimal plan blames the membership axis --------
+        profile = dict((n, c) for n, c in bug.clause_profile)
+        assert "reconfig" in profile, (
+            f"ddmin must keep reconfig occurrence atoms, kept {profile}"
+        )
+        assert "crash" not in profile, (
+            f"no crash clause exists in this plan, yet ddmin kept {profile}"
+        )
+        assert bug.bundle_path and os.path.exists(bug.bundle_path), (
+            f"shrunk witness must leave a ReproBundle, got {bug.bundle_path}"
+        )
+
+        # -- anatomy: the skeleton names the reconfig delivery ----------
+        assert bug.anatomy and "error" not in bug.anatomy, (
+            f"cross-witness anatomy failed: {bug.anatomy}"
+        )
+        skel = bug.anatomy["skeleton"]
+        assert any(label.startswith("deliver:FETCH:") for label in skel), (
+            f"the skeleton must name the rejoined replica's FETCH "
+            f"delivery (the stale-ISR admission), got {skel[-8:]}"
+        )
+        t_anatomy = time.perf_counter() - t0
+
+        # -- control: correct spec silent under the same churn ----------
+        t1 = time.perf_counter()
+        ctrl = reconfig_only_workload(buggy=False)
+        st = BatchedSim(ctrl.spec, ctrl.config).run(
+            jnp.arange(LANES, dtype=jnp.uint32), max_steps=wl.max_steps
+        )
+        n_ctrl = int(np.asarray(st.violated).sum())
+        assert n_ctrl == 0, (
+            f"correct catch-up spec violated on {n_ctrl} lanes under the "
+            "same reconfig churn"
+        )
+        t_control = time.perf_counter() - t1
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(json.dumps({
+        "reconfig_smoke": "ok",
+        "violations": n_viol,
+        "witnesses": len(bug.witnesses),
+        "bug_records": 1,
+        "signature": bug.signature[:12],
+        "clause_profile": bug.clause_profile,
+        "skeleton_len": len(skel),
+        "skeleton_sha": bug.anatomy["skeleton_sha"],
+        "coverage_bits": rep.coverage_bits,
+        "wall_s": {
+            "campaign": round(t_campaign, 1),
+            "anatomy": round(t_anatomy - t_campaign, 1),
+            "control": round(t_control, 1),
+            "total": round(time.perf_counter() - t0, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,8 +73,8 @@ ALL_RULES = JAXPR_RULES + LINT_RULES
 # independence of the edge-ring bookkeeping (the eid prefix count runs
 # over the NODE axis, never lanes).
 WORKLOADS = (
-    "raft", "kv", "paxos", "twopc", "chain", "raft-refill",
-    "raft-refill-sharded", "raft-lineage",
+    "raft", "kv", "paxos", "twopc", "chain", "isr", "lease",
+    "raft-refill", "raft-refill-sharded", "raft-lineage",
 )
 
 

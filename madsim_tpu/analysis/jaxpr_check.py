@@ -107,7 +107,7 @@ SHARD_COLLECTIVE_ALLOW: Tuple[str, ...] = ()
 # occurrence counters: the ONLY non-key values a schedule draw may touch
 NEUTRAL_LEAVES = frozenset({
     "hot.nem.crash_k", "hot.nem.part_k", "hot.nem.clog_k",
-    "hot.nem.spike_k",
+    "hot.nem.spike_k", "hot.nem.reconfig_k",
 })
 # the schedule key root: ConstState.key0 on the plain partition, carried
 # as hot.key0 on the refill partition (a refilled lane adopts a new root)
@@ -119,7 +119,7 @@ KEYCHAIN_LEAVES = frozenset({"hot.key"})
 TIME_LEAF_NAMES = frozenset({
     "hot.clock", "hot.timer", "hot.chaos_at", "hot.part_at",
     "hot.msgs.deliver", "hot.strag.deliver",
-    "hot.nem.clog_at", "hot.nem.spike_at",
+    "hot.nem.clog_at", "hot.nem.spike_at", "hot.nem.reconfig_at",
     "cold.violation_at", "const.ctl.h_off",
 })
 
@@ -141,13 +141,16 @@ def full_fault_plan():
             nem.Duplicate(rate=0.05),
             nem.Reorder(rate=0.1, window_us=50_000),
             nem.ClockSkew(max_ppm=50_000),
+            nem.Reconfig(),
         ),
     )
 
 
 def spec_factories() -> Dict[str, object]:
     from ..tpu.chain import make_chain_spec
+    from ..tpu.isr import make_isr_spec
     from ..tpu.kv import make_kv_spec
+    from ..tpu.lease import make_lease_spec
     from ..tpu.paxos import make_paxos_spec
     from ..tpu.raft import make_raft_spec
     from ..tpu.twopc import make_twopc_spec
@@ -158,6 +161,8 @@ def spec_factories() -> Dict[str, object]:
         "paxos": make_paxos_spec,
         "twopc": make_twopc_spec,
         "chain": make_chain_spec,
+        "isr": make_isr_spec,
+        "lease": make_lease_spec,
     }
 
 

@@ -137,7 +137,7 @@ def graph_from_events(
             g.msg_pred[e.eid] = e.sent_eid
     for e in events:
         if e.kind in ("crash", "restart", "split", "heal", "clog",
-                      "unclog", "spike_on", "spike_off"):
+                      "unclog", "spike_on", "spike_off", "remove", "join"):
             g.chaos.append(e)
         elif e.kind == "violation" and g.violation is None:
             g.violation = e

@@ -189,13 +189,18 @@ class Candidate:
 
     @staticmethod
     def from_dict(doc: Dict[str, Any]) -> "Candidate":
+        # Corpus lines written before a clause registry grew carry shorter
+        # genome rows; pad to the current registry length (0 / 1.0 = the
+        # neutral face) so old corpora stay loadable.
+        occ = [int(v) for v in doc.get("occ_off") or ()]
+        occ += [0] * (len(OCC_CLAUSES) - len(occ))
+        rate = [float(v) for v in doc.get("rate_scale") or ()]
+        rate += [1.0] * (len(RATE_CLAUSES) - len(rate))
         return Candidate(
             seed=int(doc["seed"]),
             off=int(doc.get("off", 0)),
-            occ_off=tuple(int(v) for v in doc.get("occ_off") or
-                          (0,) * len(OCC_CLAUSES)),
-            rate_scale=tuple(float(v) for v in doc.get("rate_scale") or
-                             (1.0,) * len(RATE_CLAUSES)),
+            occ_off=tuple(occ),
+            rate_scale=tuple(rate),
             horizon_us=int(doc.get("horizon_us", 0)),
             origin=str(doc.get("origin", "fresh")),
         )
@@ -1346,13 +1351,14 @@ def _named_workload(name: str, virtual_secs: float, storm: bool):
     import dataclasses as dc
 
     from .tpu import (
-        chain_workload, kv_workload, paxos_workload, raft_workload,
-        twopc_workload,
+        chain_workload, isr_workload, kv_workload, lease_workload,
+        paxos_workload, raft_workload, twopc_workload,
     )
 
     factories = {
         "raft": raft_workload, "kv": kv_workload, "twopc": twopc_workload,
         "paxos": paxos_workload, "chain": chain_workload,
+        "isr": isr_workload, "lease": lease_workload,
     }
     if name not in factories:
         raise SystemExit(

@@ -67,6 +67,20 @@ class FsSim(Simulator):
         for inode in node_fs.values():
             inode.data[:] = inode.synced
 
+    def wipe_node(self, node_id: NodeId) -> None:
+        """Blank the node's disk entirely — the membership-JOIN rule.
+
+        `power_fail` models a crash: synced inodes survive, never-synced
+        ones vanish. A node re-entering the cluster after a `reconfig`
+        removal is a DIFFERENT machine (a fresh replica receiving state
+        transfer), so nothing survives — not even synced inodes. Before
+        this existed, a create→remove→rejoin sequence would stat() the
+        pre-removal file on the "new" replica: the joining node's rebuild
+        resurrected pre-wipe inodes, the exact lie `power_fail`'s
+        never-synced rule exists to prevent, extended here to joins
+        (NemesisDriver applies it before the join's restart)."""
+        self._fs[node_id] = {}
+
     def get_file_size(self, node_id: NodeId, path: str) -> Optional[int]:
         inode = self._fs.get(node_id, {}).get(str(path))
         return len(inode.data) if inode is not None else None

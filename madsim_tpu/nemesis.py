@@ -128,6 +128,9 @@ NEM_SITE_CLOG_DST = 224      # drawn in [0, N-1), shifted past src
 NEM_SITE_SPIKE_IV = 231
 NEM_SITE_SPIKE_DUR = 232
 NEM_SITE_SKEW = 241          # per-node skew ppm; index = node
+NEM_SITE_RECONF_IV = 251     # stable interval before remove event k
+NEM_SITE_RECONF_DUR = 252    # out-of-membership duration of reconfig k
+NEM_SITE_RECONF_VICTIM = 253 # removed node of reconfig event k
 
 # per-message coin sites. The engine draws them on its per-step net_key
 # stream; the host draws them on the per-seed base key via ScheduleCoins
@@ -144,7 +147,7 @@ NET_SITE_NEM_LOSS = 8
 
 FIRE_KINDS: Tuple[str, ...] = (
     "crash", "restart", "wipe", "partition", "heal", "clog", "spike",
-    "loss", "dup", "reorder", "skew",
+    "loss", "dup", "reorder", "skew", "remove", "join",
 )
 FIRE_INDEX: Dict[str, int] = {k: i for i, k in enumerate(FIRE_KINDS)}
 
@@ -161,11 +164,11 @@ FIRE_INDEX: Dict[str, int] = {k: i for i, k in enumerate(FIRE_KINDS)}
 
 TRIAGE_CLAUSES: Tuple[str, ...] = (
     "crash", "partition", "clog", "spike", "skew", "loss", "dup",
-    "reorder", "wipe",
+    "reorder", "wipe", "reconfig",
 )
 TRIAGE_BIT: Dict[str, int] = {n: 1 << i for i, n in enumerate(TRIAGE_CLAUSES)}
 # schedule clauses with occurrence counters (rows of TriageCtl.occ)
-OCC_CLAUSES: Tuple[str, ...] = ("crash", "partition", "clog", "spike")
+OCC_CLAUSES: Tuple[str, ...] = ("crash", "partition", "clog", "spike", "reconfig")
 OCC_ROW: Dict[str, int] = {n: i for i, n in enumerate(OCC_CLAUSES)}
 # message-level clauses with per-lane rate scaling (rows of
 # TriageCtl.rate_scale)
@@ -179,6 +182,7 @@ CLAUSE_OF_EVENT: Dict[str, str] = {
     "clog": "clog", "unclog": "clog",
     "spike_on": "spike", "spike_off": "spike",
     "skew": "skew",
+    "remove": "reconfig", "join": "reconfig",
 }
 
 
@@ -271,11 +275,31 @@ class ClockSkew:
     max_ppm: int = 50_000  # 5% — aggressive, this is a fuzzer
 
 
+@dataclasses.dataclass(frozen=True)
+class Reconfig:
+    """Dynamic membership: every `interval` a random node is REMOVED from
+    the cluster (its member bit clears, its in-flight traffic drops, and
+    sends addressed to it count as a distinct non-member drop class), then
+    after `down` it JOINS back as a brand-new replica — rebuilt through the
+    spec's real `init`, never `on_restart` recovery, because a joining node
+    has no history (the snapshot-transfer-to-fresh-replica regime). Each
+    applied remove and each applied join bumps the lane's membership epoch,
+    so specs can fence on configuration age. This is the
+    joint-consensus/reconfiguration fault axis the fixed-cluster clauses
+    cannot produce: stale-ISR re-entry, leases held by departed nodes,
+    quorum arithmetic across membership changes."""
+
+    interval_lo_us: int = 1_000_000
+    interval_hi_us: int = 5_000_000
+    down_lo_us: int = 500_000
+    down_hi_us: int = 3_000_000
+
+
 Clause = Any  # one of the dataclasses above
 
 _CLAUSE_TYPES: Tuple[type, ...] = (
     Crash, Partition, LinkClog, LatencySpike, MsgLoss, Duplicate, Reorder,
-    ClockSkew,
+    ClockSkew, Reconfig,
 )
 
 # --------------------------------------------------------------------------
@@ -296,7 +320,7 @@ _CLAUSE_TYPES: Tuple[type, ...] = (
 # `nem_<name>_*` knob prefixes).
 SCHEDULE_CLAUSES: Dict[str, type] = {
     "crash": Crash, "partition": Partition, "clog": LinkClog,
-    "spike": LatencySpike,
+    "spike": LatencySpike, "reconfig": Reconfig,
 }
 # message-level clauses: per-message coins. Streams are per-backend but
 # every host draw VALUE is schedule-matched (pure in (seed, site, index)
@@ -332,6 +356,7 @@ CLAUSE_EVENT_KINDS: Dict[str, Tuple[str, ...]] = {
     "clog": ("clog", "unclog"),
     "spike": ("spike_on", "spike_off"),
     "skew": ("skew",),
+    "reconfig": ("remove", "join"),
 }
 # clause -> FIRE_KINDS rows it can increment
 CLAUSE_FIRE_KINDS: Dict[str, Tuple[str, ...]] = {
@@ -343,6 +368,7 @@ CLAUSE_FIRE_KINDS: Dict[str, Tuple[str, ...]] = {
     "dup": ("dup",),
     "reorder": ("reorder",),
     "skew": ("skew",),
+    "reconfig": ("remove", "join"),
 }
 
 
@@ -400,6 +426,9 @@ class FaultPlan:
             elif isinstance(c, (Partition, LinkClog)):
                 _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
                 _check_interval(f"{n}.heal", c.heal_lo_us, c.heal_hi_us)
+            elif isinstance(c, Reconfig):
+                _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
+                _check_interval(f"{n}.down", c.down_lo_us, c.down_hi_us)
             elif isinstance(c, LatencySpike):
                 _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
                 _check_interval(f"{n}.duration", c.duration_lo_us, c.duration_hi_us)
@@ -450,6 +479,8 @@ class FaultPlan:
             kinds.append("reorder")
         if self.get(ClockSkew) is not None:
             kinds.append("skew")
+        if self.get(Reconfig) is not None:
+            kinds += ["remove", "join"]
         return tuple(kinds)
 
     # -- the pure schedule (what both backends must execute) --
@@ -508,6 +539,8 @@ class NemesisEvent:
         if self.kind in ("crash", "restart"):
             w = " (wipe)" if self.wipe else ""
             return f"[{t:9.6f}s] {self.kind} node{self.node}{w}"
+        if self.kind in ("remove", "join"):
+            return f"[{t:9.6f}s] {self.kind} node{self.node} (reconfig k={self.k})"
         if self.kind == "split":
             return f"[{t:9.6f}s] split side_mask={self.side_mask:#x}"
         if self.kind in ("clog", "unclog"):
@@ -597,6 +630,23 @@ def plan_schedule(
             if t >= horizon_us:
                 break
             events.append(NemesisEvent(t, "unclog", node=src, dst=dst, k=k))
+            k += 1
+
+    reconf = plan.get(Reconfig)
+    if reconf is not None:
+        t, k = 0, 0
+        while len(events) < max_events:
+            t += randint32(key, NEM_SITE_RECONF_IV, reconf.interval_lo_us,
+                           reconf.interval_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            victim = randint32(key, NEM_SITE_RECONF_VICTIM, 0, n_nodes, index=k)
+            events.append(NemesisEvent(t, "remove", node=victim, k=k))
+            t += randint32(key, NEM_SITE_RECONF_DUR, reconf.down_lo_us,
+                           reconf.down_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(NemesisEvent(t, "join", node=victim, k=k))
             k += 1
 
     spike = plan.get(LatencySpike)
@@ -899,7 +949,7 @@ class NemesisDriver:
 
     def _apply(self, ev: NemesisEvent) -> None:
         net = self._netsim()
-        if ev.kind in ("crash", "split", "clog", "spike_on") and ev.k >= 0:
+        if ev.kind in ("crash", "split", "clog", "spike_on", "remove") and ev.k >= 0:
             clause = CLAUSE_OF_EVENT[ev.kind]
             self.occ_fired[clause] = self.occ_fired.get(clause, 0) | (
                 1 << min(ev.k, 31)
@@ -952,6 +1002,31 @@ class NemesisDriver:
         elif ev.kind == "spike_off":
             if net is not None:
                 net.network.config.spike_extra_latency = 0.0
+        elif ev.kind == "remove":
+            # membership removal: the node leaves the cluster. The host
+            # runtime has no separate membership plane — a removed node is
+            # killed (its tasks drop, its inbound traffic dies with it),
+            # which matches the engine clearing BOTH member and alive bits.
+            if self.on_crash is not None:
+                self.on_crash(ev.node)
+            self.handle.kill(self.node_ids[ev.node])
+            self._count("remove")
+        elif ev.kind == "join":
+            # the node re-enters as a BRAND-NEW replica: blank disk (the
+            # power_fail never-synced rule extended to joins — nothing
+            # survives a membership change, see FsSim.wipe_node), durable
+            # app state discarded via the same on_wipe hook wiped restarts
+            # use, then the init closure rebuilds it from scratch — the
+            # host face of the engine's join-through-`_init` rebuild.
+            from .fs import FsSim
+
+            fs = self.handle.simulators.get(FsSim)
+            if fs is not None:
+                fs.wipe_node(self.node_ids[ev.node])
+            if self.on_wipe is not None:
+                self.on_wipe(ev.node)
+            self.handle.restart(self.node_ids[ev.node])
+            self._count("join")
         self.applied.append(ev)
 
     def _crosses_open_split(self, a_idx: int, b_idx: int) -> bool:

@@ -101,6 +101,9 @@ from ..nemesis import (
     NEM_SITE_PART_HEAL,
     NEM_SITE_PART_IV,
     NEM_SITE_PART_SIDE,
+    NEM_SITE_RECONF_DUR,
+    NEM_SITE_RECONF_IV,
+    NEM_SITE_RECONF_VICTIM,
     NEM_SITE_SKEW,
     NEM_SITE_SPIKE_DUR,
     NEM_SITE_SPIKE_IV,
@@ -273,6 +276,10 @@ class NemesisState(NamedTuple):
     spike_at: Any  # i32 [L] next latency-spike toggle
     spiking: Any  # bool [L]
     spike_k: Any  # i32 [L]
+    reconfig_at: Any  # i32 [L] next membership toggle (INF_US disabled)
+    reconf_node: Any  # i32 [L] node currently OUT of the membership (-1 =
+    #           all in; the next reconfig event is a REMOVE, else a JOIN)
+    reconfig_k: Any  # i32 [L] remove/join cycle counter
     skew_ppm: Any  # i32 [L,N] per-node timer rate skew in ppm (0 = none)
     #           | None. Integer ppm, not an f32 rate: the r8 precision fix
     #           — f32 multiply loses integer microseconds above 2^24 us
@@ -297,7 +304,7 @@ class TriageCtl(NamedTuple):
     """
 
     off: Any  # i32 [L] clause-disable bitmask over nemesis.TRIAGE_CLAUSES
-    occ: Any  # i32 [L, 4] occurrence-disable bitmasks (nemesis.OCC_CLAUSES
+    occ: Any  # i32 [L, len(OCC_CLAUSES)] occurrence-disable bitmasks (OCC_CLAUSES
     #           rows; bit k suppresses occurrence k; occurrences past the
     #           mask are always enabled — triage.py caps atoms at bit 30,
     #           the int32 sign bit being unusable)
@@ -367,6 +374,7 @@ class RefillLog(NamedTuple):
     events: Any  # i32 [A]
     overflow: Any  # i32 [A]
     dead_drops: Any  # i32 [A]
+    nonmember_drops: Any  # i32 [A]
     clock: Any  # i32 [A] final clock offset at retirement
     epoch: Any  # i32 [A]
     fires: Any  # i32 [A, len(FIRE_KINDS)]
@@ -435,6 +443,8 @@ class TraceRecord(NamedTuple):
     unclog: Any  # bool [L] link unclogged this step
     spike_on: Any  # bool [L] latency spike opened this step
     spike_off: Any  # bool [L]
+    remove: Any  # i32 [L] node removed from membership this step, -1 = none
+    join: Any  # i32 [L] node (re)joined this step (fresh-init), -1 = none
     # -- lineage plane (BatchedSim(lineage=True) only, else None): the
     # device edge ring. Each step's events carry their global event id
     # and, for deliveries, the RECONSTRUCTED full send eid — so a traced
@@ -486,6 +496,10 @@ class SimState(NamedTuple):
     dead_drops: Any  # i32 [L] (messages dropped: destination node down —
     #            distinct from `overflow` so graceful-degradation
     #            assertions can tell pool pressure from crash fallout)
+    nonmember_drops: Any  # i32 [L] (messages dropped: destination not a
+    #            cluster MEMBER — removed by the reconfig clause. Checked
+    #            before liveness, so the classes are disjoint: a crashed
+    #            member counts in dead_drops, a removed node here)
     fires: Any  # i32 [L, len(FIRE_KINDS)] per-fault-kind chaos fire counts
     occ_fired: Any  # u32 [L, len(OCC_CLAUSES)] | None — bit k set when
     #            occurrence k of the schedule clause APPLIED in this lane
@@ -497,6 +511,15 @@ class SimState(NamedTuple):
     alive_p: Any  # u32 [L,1] packed node-liveness bits (N <= 32)
     crashed: Any  # i32 [L] (node id currently down, -1 = none)
     chaos_at: Any  # i32 [L] (next crash/restart event)
+    member_p: Any  # u32 [L,1] packed cluster-MEMBERSHIP bits (the reconfig
+    #           clause's plane; all-ones when the clause is off). Liveness
+    #           and membership are independent axes: a removed node keeps
+    #           its alive bit state, but non-members receive nothing
+    #           (sends to them count in nonmember_drops) and a join
+    #           rebuilds the node from the real _init (fresh replica).
+    member_epoch: Any  # i32 [L] membership-epoch counter: increments on
+    #           every remove AND every join (the reconfig clause's
+    #           configuration-change ordinal, exposed to traces/summaries)
     link_ok_p: Any  # u32 [L,N,1] packed directed-link-up bits, row = src
     partitioned: Any  # bool [L] (a partition is currently active)
     part_at: Any  # i32 [L] (next partition split/heal event)
@@ -528,6 +551,11 @@ class SimState(NamedTuple):
         """bool [L,N,N] directed-link view (unpacks link_ok_p)."""
         return bitpack.unpack_bits(self.link_ok_p, self.timer.shape[1])
 
+    @property
+    def member(self):
+        """bool [L,N] cluster-membership view (unpacks member_p)."""
+        return bitpack.unpack_bits(self.member_p, self.timer.shape[1])
+
 
 class ColdState(NamedTuple):
     """The accumulate-only half of the sweep carry (see SimState). Grouped
@@ -542,6 +570,7 @@ class ColdState(NamedTuple):
     events: Any
     overflow: Any
     dead_drops: Any
+    nonmember_drops: Any
     fires: Any
     occ_fired: Any
     cov: Any
@@ -717,6 +746,11 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
         "hot.nem.spike_at": toff,
         "hot.nem.spiking": (0, 1, False),
         "hot.nem.spike_k": (0, ctr_hi, False),
+        "hot.nem.reconfig_at": toff,
+        "hot.nem.reconf_node": (-1, N - 1, False),
+        "hot.nem.reconfig_k": (0, ctr_hi, False),
+        "hot.member_p": u32,
+        "hot.member_epoch": (0, ctr_hi, False),
         "cold.violation_at": toff,
         "cold.violation_epoch": (0, ep_hi, False),
         "cold.violation_step": (-1, ctr_hi, False),
@@ -725,6 +759,7 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
         "cold.events": (0, ctr_hi, False),
         "cold.overflow": (0, ctr_hi, False),
         "cold.dead_drops": (0, ctr_hi, False),
+        "cold.nonmember_drops": (0, ctr_hi, False),
         "cold.fires": (0, ctr_hi, False),
         "cold.occ_fired": u32,
         "cold.cov.bitmap": u32,
@@ -792,6 +827,7 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
             "cold.refill.events": ctr,
             "cold.refill.overflow": ctr,
             "cold.refill.dead_drops": ctr,
+            "cold.refill.nonmember_drops": ctr,
             "cold.refill.clock": (0, off_hi, True),
             "cold.refill.epoch": (0, ep_hi, False),
             "cold.refill.fires": ctr,
@@ -1007,6 +1043,7 @@ class BatchedSim:
             ("nem_partition", (("interval", True), ("heal", False))),
             ("nem_clog", (("interval", True), ("heal", False))),
             ("nem_spike", (("interval", True), ("duration", False))),
+            ("nem_reconfig", (("interval", True), ("down", False))),
         ):
             if getattr(cfg, f"{prefix}_interval_hi_us") <= 0:
                 continue  # clause disabled
@@ -1150,7 +1187,7 @@ class BatchedSim:
         self._nem_state = (
             cfg.nem_crash_enabled or cfg.nem_partition_enabled
             or cfg.nem_clog_enabled or cfg.nem_spike_enabled
-            or cfg.nem_skew_enabled
+            or cfg.nem_skew_enabled or cfg.nem_reconfig_enabled
         )
         # occurrence-fire tracking exists iff a nemesis SCHEDULE clause is
         # on (legacy trajectory-coupled chaos has no occurrence index):
@@ -1158,6 +1195,7 @@ class BatchedSim:
         self._occ_track = (
             cfg.nem_crash_enabled or cfg.nem_partition_enabled
             or cfg.nem_clog_enabled or cfg.nem_spike_enabled
+            or cfg.nem_reconfig_enabled
         )
         # scalar-style handlers -> [L,N] batched. `now` is per-(lane,node):
         # under the lookahead window, nodes in one step process events at
@@ -1344,6 +1382,17 @@ class BatchedSim:
                     else jnp.full((L,), INF_US, jnp.int32)
                 ),
                 spiking=zb, spike_k=zi,
+                reconfig_at=(
+                    prng.randint(
+                        key, NEM_SITE_RECONF_IV,
+                        cfg.nem_reconfig_interval_lo_us,
+                        cfg.nem_reconfig_interval_hi_us, index=0,
+                    )
+                    if cfg.nem_reconfig_enabled
+                    else jnp.full((L,), INF_US, jnp.int32)
+                ),
+                reconf_node=jnp.full((L,), -1, jnp.int32),
+                reconfig_k=zi,
                 skew_ppm=skew_ppm,
             )
         else:
@@ -1379,6 +1428,7 @@ class BatchedSim:
             events=jnp.zeros((L,), jnp.int32),
             overflow=jnp.zeros((L,), jnp.int32),
             dead_drops=jnp.zeros((L,), jnp.int32),
+            nonmember_drops=jnp.zeros((L,), jnp.int32),
             fires=fires,
             occ_fired=(
                 jnp.zeros((L, len(OCC_CLAUSES)), jnp.uint32)
@@ -1389,6 +1439,10 @@ class BatchedSim:
             ),
             crashed=jnp.full((L,), -1, jnp.int32),
             chaos_at=chaos_at,
+            member_p=jnp.full(
+                (L, 1), bitpack.full_mask_word(N), jnp.uint32
+            ),
+            member_epoch=jnp.zeros((L,), jnp.int32),
             link_ok_p=jnp.full(
                 (L, N, 1), bitpack.full_mask_word(N), jnp.uint32
             ),
@@ -1492,6 +1546,8 @@ class BatchedSim:
             t_next = jnp.minimum(t_next, state.nem.clog_at)
         if cfg.nem_spike_enabled:
             t_next = jnp.minimum(t_next, state.nem.spike_at)
+        if cfg.nem_reconfig_enabled:
+            t_next = jnp.minimum(t_next, state.nem.reconfig_at)
 
         deadlocked = (~state.done) & (t_next >= INF_US)
         active = (~state.done) & (t_next < INF_US)
@@ -1511,12 +1567,15 @@ class BatchedSim:
         if lo_w and (
             cfg.any_crash_enabled or cfg.any_partition_enabled
             or cfg.nem_clog_enabled or cfg.nem_spike_enabled
+            or cfg.nem_reconfig_enabled
         ):
             next_chaos = jnp.minimum(state.chaos_at, state.part_at)
             if cfg.nem_clog_enabled:
                 next_chaos = jnp.minimum(next_chaos, state.nem.clog_at)
             if cfg.nem_spike_enabled:
                 next_chaos = jnp.minimum(next_chaos, state.nem.spike_at)
+            if cfg.nem_reconfig_enabled:
+                next_chaos = jnp.minimum(next_chaos, state.nem.reconfig_at)
             chaos_in_w = next_chaos <= w_end
             w_end = jnp.where(chaos_in_w, t_next, w_end)
 
@@ -2058,6 +2117,105 @@ class BatchedSim:
             tr_spike_on = do_spike & spike_en
             tr_spike_off = do_unspike & spike_en
 
+        # -- 5d. nemesis membership reconfiguration (remove/join windows) --
+        # Same toggle machinery as crash's down-window, on the MEMBERSHIP
+        # plane: a remove takes the schedule-drawn victim out of the
+        # cluster (member + alive bits cleared, in-flight messages to it
+        # lost), the paired join brings the SAME node back as a FRESH
+        # replica — rebuilt through the real spec.init like wipe-restart,
+        # never from its pre-removal state. member_epoch counts every
+        # applied configuration change. reconf_node doubles as the
+        # open/closed discriminator (-1 = all members, next event is a
+        # remove), exactly like `crashed` does for the crash clause.
+        tr_remove = jnp.full((L,), -1, jnp.int32)
+        tr_join = jnp.full((L,), -1, jnp.int32)
+        member = None
+        member_epoch = state.member_epoch
+        nem_reconfig_at = nem_reconf_node = nem_reconfig_k = None
+        if cfg.nem_reconfig_enabled:
+            nst = state.nem
+            member = bitpack.unpack_bits(state.member_p, N)  # bool [L,N]
+            reconf_due = active & (nst.reconfig_at <= t_next)
+            do_remove = reconf_due & (nst.reconf_node < 0)
+            do_join = reconf_due & (nst.reconf_node >= 0)
+            rk = nst.reconfig_k
+            # one gate per occurrence covers BOTH halves (k increments at
+            # the join, like clog/spike close their windows): a suppressed
+            # occurrence advances the timing machinery through its window
+            # but applies no membership change at all
+            reconf_en = (
+                _occ_on(ctl, "reconfig", rk) if self.triage
+                else jnp.ones((L,), jnp.bool_)
+            )
+            victim_d = prng.randint(
+                state.key0, NEM_SITE_RECONF_VICTIM, 0, N, index=rk
+            )
+            join_node = jnp.clip(nst.reconf_node, 0, N - 1)
+            ap_remove = do_remove & reconf_en
+            ap_join = do_join & reconf_en
+            remove_mask = ap_remove[:, None] & (node_ids == victim_d[:, None])
+            join_mask = ap_join[:, None] & (node_ids == join_node[:, None])
+            member = (member & ~remove_mask) | join_mask
+            # liveness and membership stay INDEPENDENT planes (a crashed
+            # member is dead_drops, a removed node nonmember_drops), but a
+            # remove also downs the node and a join revives it: a removed
+            # replica must not keep firing timers against the cluster
+            alive = (alive & ~remove_mask) | join_mask
+            member_epoch = member_epoch + (
+                ap_remove | ap_join
+            ).astype(jnp.int32)
+            # in-flight messages to the removed node are lost, like a
+            # crash (its pool slice empties; not counted as drops either)
+            valid = valid & ~remove_mask[:, :, None]
+            if self._B:
+                svalid = svalid & ~(
+                    ap_remove[:, None] & (strag.dst == victim_d[:, None])
+                )
+            # the joining node is a fresh replica: rebuilt through the
+            # real spec.init (the wipe-restart idiom), its first timer and
+            # declared absolute-time fields shifted to the join instant
+            ns_j, timer_j = self._v_init(rkeys, narange)
+            timer_j = jnp.asarray(timer_j, jnp.int32)
+            j_ok = (timer_j >= 0) & (timer_j < INF_GUARD)
+            timer_j = jnp.where(j_ok, timer_j + t_next[:, None], timer_j)
+            if cfg.nem_skew_enabled:
+                dj = timer_j - t_next[:, None]
+                sk_j = j_ok & (dj > 0)
+                timer_j = jnp.where(
+                    sk_j,
+                    t_next[:, None] + scale_delay_ppm(dj, state.nem.skew_ppm),
+                    timer_j,
+                )
+            if spec.time_fields:
+                ns_j = ns_j._replace(**{
+                    f: getattr(ns_j, f)
+                    + t_next.reshape((L,) + (1,) * (getattr(ns_j, f).ndim - 1))
+                    for f in spec.time_fields
+                })
+            node = _tree_where(join_mask, ns_j, node)
+            timer = jnp.where(join_mask, timer_j, timer)
+            # schedule arithmetic: next toggle = previous toggle time plus
+            # an occurrence-indexed delta (never clock + delta)
+            down_d = prng.randint(
+                state.key0, NEM_SITE_RECONF_DUR, cfg.nem_reconfig_down_lo_us,
+                cfg.nem_reconfig_down_hi_us, index=rk,
+            )
+            next_d = prng.randint(
+                state.key0, NEM_SITE_RECONF_IV,
+                cfg.nem_reconfig_interval_lo_us,
+                cfg.nem_reconfig_interval_hi_us, index=rk + 1,
+            )
+            nem_reconfig_at = jnp.where(
+                do_remove, nst.reconfig_at + down_d,
+                jnp.where(do_join, nst.reconfig_at + next_d, nst.reconfig_at),
+            )
+            nem_reconf_node = jnp.where(
+                do_remove, victim_d, jnp.where(do_join, -1, nst.reconf_node)
+            )
+            nem_reconfig_k = rk + do_join.astype(jnp.int32)
+            tr_remove = jnp.where(ap_remove, victim_d, -1)
+            tr_join = jnp.where(ap_join, join_node, -1)
+
         # -- 6. collect outboxes, roll the network, pack into pool ---------
         def flat(out: Outbox, emitting, e):  # [L,N,e,...] -> [L, N*e, ...]
             v = (out.valid & emitting[:, :, None]).reshape(L, N * e)
@@ -2126,6 +2284,17 @@ class BatchedSim:
         # semantics) and counted in their OWN lane counter: pool-overflow
         # drops mean back-pressure, dead-node drops mean crash fallout,
         # and graceful-degradation assertions need to tell them apart
+        if cfg.nem_reconfig_enabled:
+            # membership filter FIRST, so the two drop classes stay
+            # disjoint: a send to a REMOVED node counts here (whatever its
+            # alive bit says), a send to a crashed member in dead_dropped
+            member_dst = (cand_dst_oh & member[:, None, :]).any(-1)
+            nonmember_dropped = (keep & ~member_dst).sum(
+                axis=1, dtype=jnp.int32
+            )
+            keep = keep & member_dst
+        else:
+            nonmember_dropped = jnp.zeros((L,), jnp.int32)
         alive_dst = (cand_dst_oh & alive[:, None, :]).any(-1)
         dead_dropped = (keep & ~alive_dst).sum(axis=1, dtype=jnp.int32)
         keep = keep & alive_dst
@@ -2431,6 +2600,9 @@ class BatchedSim:
             _count("clog", do_clog & clog_en)
         if cfg.nem_spike_enabled:
             _count("spike", do_spike & spike_en)
+        if cfg.nem_reconfig_enabled:
+            _count("remove", ap_remove)
+            _count("join", ap_join)
         _count("loss", loss_drops)
         _count("dup", dup_fires)
         _count("reorder", reorder_fires)
@@ -2457,6 +2629,12 @@ class BatchedSim:
             if cfg.nem_spike_enabled:
                 _occ_mark(
                     OCC_ROW["spike"], do_spike & spike_en, state.nem.spike_k
+                )
+            if cfg.nem_reconfig_enabled:
+                # the OPEN half marks the occurrence, like every clause
+                # (k is shared by the remove and its paired join)
+                _occ_mark(
+                    OCC_ROW["reconfig"], ap_remove, state.nem.reconfig_k
                 )
             occ_fired = jnp.stack(ocols, axis=1)
 
@@ -2578,6 +2756,19 @@ class BatchedSim:
                 ),
                 spiking=spiking if spiking is not None else nst.spiking,
                 spike_k=nem_spike_k if nem_spike_k is not None else nst.spike_k,
+                reconfig_at=rb(
+                    nem_reconfig_at if nem_reconfig_at is not None
+                    else nst.reconfig_at,
+                    shift,
+                ),
+                reconf_node=(
+                    nem_reconf_node if nem_reconf_node is not None
+                    else nst.reconf_node
+                ),
+                reconfig_k=(
+                    nem_reconfig_k if nem_reconfig_k is not None
+                    else nst.reconfig_k
+                ),
                 skew_ppm=nst.skew_ppm,
             )
         else:
@@ -2610,11 +2801,17 @@ class BatchedSim:
             + due_t.sum(axis=1, dtype=jnp.int32),
             overflow=overflow,
             dead_drops=state.dead_drops + dead_dropped,
+            nonmember_drops=state.nonmember_drops + nonmember_dropped,
             fires=fires,
             occ_fired=occ_fired,
             alive_p=bitpack.pack_bits(alive),
             crashed=crashed,
             chaos_at=chaos_at,
+            member_p=(
+                bitpack.pack_bits(member) if member is not None
+                else state.member_p
+            ),
+            member_epoch=member_epoch,
             link_ok_p=bitpack.pack_bits(link_ok),
             partitioned=partitioned,
             part_at=part_at,
@@ -2667,6 +2864,8 @@ class BatchedSim:
             unclog=tr_unclog,
             spike_on=tr_spike_on,
             spike_off=tr_spike_off,
+            remove=tr_remove,
+            join=tr_join,
             lam=tr_lam,
             evt_eid=tr_evt_eid,
             sent_eid=tr_sent_eid,
@@ -2739,6 +2938,9 @@ class BatchedSim:
                 events=put(rf.events, ns.events),
                 overflow=put(rf.overflow, ns.overflow),
                 dead_drops=put(rf.dead_drops, ns.dead_drops),
+                nonmember_drops=put(
+                    rf.nonmember_drops, ns.nonmember_drops
+                ),
                 clock=put(rf.clock, ns.clock),
                 epoch=put(rf.epoch, ns.epoch),
                 fires=put(rf.fires, ns.fires),
@@ -2883,6 +3085,7 @@ class BatchedSim:
             events=zi((A,)),
             overflow=zi((A,)),
             dead_drops=zi((A,)),
+            nonmember_drops=zi((A,)),
             clock=zi((A,)),
             epoch=zi((A,)),
             fires=zi((A, len(FIRE_KINDS))),
@@ -3359,6 +3562,7 @@ def _summary_reduction(state: SimState) -> dict:
         "events64": _sum64(state.events),
         "overflow64": _sum64(state.overflow),
         "dead_drops64": _sum64(state.dead_drops),
+        "nonmember_drops64": _sum64(state.nonmember_drops),
         "steps64": _sum64(state.steps),
         "epoch64": _sum64(state.epoch),
         "clock64": _sum64(state.clock),
@@ -3420,6 +3624,7 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
         "total_events": _join64(*red["events64"]),
         "total_overflow": _join64(*red["overflow64"]),
         "total_dead_drops": _join64(*red["dead_drops64"]),
+        "total_nonmember_drops": _join64(*red["nonmember_drops64"]),
         "mean_steps": steps_total / L,
         "mean_virtual_secs": vt_total_us / L / 1e6,
     }
@@ -3483,7 +3688,8 @@ def refill_results(state: SimState) -> dict:
         for f in (
             "retired", "violated", "deadlocked", "violation_at",
             "violation_epoch", "violation_step", "steps", "events",
-            "overflow", "dead_drops", "clock", "epoch", "fires",
+            "overflow", "dead_drops", "nonmember_drops", "clock",
+            "epoch", "fires",
         )
     }
     for f in ("occ_fired", "cov_bitmap", "cov_hiwater", "cov_transitions"):
@@ -3503,6 +3709,7 @@ def refill_results(state: SimState) -> dict:
             "violation_step": state.violation_step,
             "steps": state.steps, "events": state.events,
             "overflow": state.overflow, "dead_drops": state.dead_drops,
+            "nonmember_drops": state.nonmember_drops,
             "clock": state.clock, "epoch": state.epoch,
             "fires": state.fires,
         }
@@ -3566,8 +3773,9 @@ def refill_results_sharded(
     row_fields = [
         "retired", "violated", "deadlocked", "violation_at",
         "violation_epoch", "violation_step", "steps", "events",
-        "overflow", "dead_drops", "clock", "epoch", "fires",
-        "occ_fired", "cov_bitmap", "cov_hiwater", "cov_transitions",
+        "overflow", "dead_drops", "nonmember_drops", "clock", "epoch",
+        "fires", "occ_fired", "cov_bitmap", "cov_hiwater",
+        "cov_transitions",
     ]
     out: dict = {}
     for f in row_fields:
@@ -3627,6 +3835,9 @@ def summarize_refill(res: dict) -> dict:
         "total_events": int(res["events"].astype(np.int64).sum()),
         "total_overflow": int(res["overflow"].astype(np.int64).sum()),
         "total_dead_drops": int(res["dead_drops"].astype(np.int64).sum()),
+        "total_nonmember_drops": int(
+            res["nonmember_drops"].astype(np.int64).sum()
+        ),
         "mean_steps": steps_total / A,
         "mean_virtual_secs": vt_total_us / A / 1e6,
         "occupancy": round(float(res["occupancy"]), 4),

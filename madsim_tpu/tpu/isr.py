@@ -1,0 +1,322 @@
+"""Kafka-family ISR log replication — the reconfig-era fuzz protocol.
+
+A sixth *shape* (raft: symmetric replicated log; kv: primary/backup
+quorum rounds; twopc: one-shot commit; paxos: ballot duels; chain: fixed
+linear topology): a FIXED LEADER (node 0, the partition leader) with a
+dynamic In-Sync Replica set, follower fetch/response replication, and a
+high watermark advanced to the minimum acked offset across the ISR —
+the Kafka replication contract (KIP-101 family). Written with
+`fuse_two_handlers` per docs/authoring_protocol_specs.md.
+
+Protocol:
+
+  * Followers FETCH(leo, sent_t) from the leader on their tick. The
+    leader applies a fetch only when its sent time beats the last one it
+    applied from that replica (`lf_t`, the reorder/duplicate guard —
+    regression of a replica's acked offset after a wipe-join is
+    LEGITIMATE and must not be masked by a monotone max), records the
+    acked offset `fa[src] = min(f_leo, leo)`, and replies FRESP(leo, hw,
+    echo). The follower adopts the leader's (leo, hw) wholesale when the
+    echo matches its latest fetch — instant catch-up, which keeps the
+    spec small; truncation after a leader wipe falls out for free.
+  * The leader produces on its tick (leo += 1 at `produce_rate`, its own
+    ack rides along), evicts followers whose last applied fetch is older
+    than `repl_timeout_us` from the ISR, and advances
+    `hw = max(hw, min over ISR of fa)`. The leader's own ISR bit is
+    pinned. ISR membership changes ONLY at the leader — the bitmask and
+    `fa` are meaningful at node 0 alone (followers carry init values).
+  * Admission (the Kafka catch-up contract): a fetching replica is IN
+    the ISR iff its freshly acked offset has caught up to the high
+    watermark — the correct leader demotes a replica whose applied ack
+    regressed below `hw` and admits one at `ack >= hw`, so
+    `fa[r] >= hw` holds for every ISR member BY CONSTRUCTION at every
+    mutation point (admission, eviction, and hw-advance all preserve
+    it). Crash/restart keeps the log (leo/hw durable); a reconfig
+    wipe-join restarts the replica from offset 0 via the engine's
+    `_init` path.
+
+Device invariants (per lane, per step — leader-local, hence race-free
+under per-node clock skew: the engine's virtual time is global and all
+checked fields live on node 0 except hw<=leo which is node-local):
+  * ISR catch-up contract: every replica in node 0's ISR has
+    `fa[r] >= hw`.
+  * Watermark sanity: `hw <= leo` on every node (the leader's min runs
+    over an ISR containing itself; followers adopt (leo, hw) pairs).
+
+The canonical injected bug (`buggy_stale_isr=True`): the leader
+re-admits a fetching replica into the ISR UNCONDITIONALLY — no catch-up
+check on admission and no demotion on a regressed ack. A replica
+removed by the reconfig nemesis and later re-joined as a fresh disk
+fetches at offset 0; the buggy leader puts it straight back into the
+ISR while `hw` is already ahead, acking a stale high-watermark — the
+`fa[r] >= hw` contract fires on the next check. (Plain crash/restart
+can also fire it — a durably lagging replica is evicted, hw advances,
+and its first fetch after restart is re-admitted stale — so the
+reconfig smoke plan isolates the membership axis by running reconfig
+WITHOUT crash clauses.)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from . import prng
+from .spec import Outbox, ProtocolSpec, RateFloor, fuse_two_handlers
+
+FETCH, FRESP = range(2)
+PAYLOAD_WIDTH = 3  # FETCH: (leo, sent_t, 0) / FRESP: (leo, hw, echo)
+
+
+class IsrState(NamedTuple):
+    # the replicated log, abstracted to its end offset (durable)
+    leo: jnp.ndarray  # i32 log end offset
+    hw: jnp.ndarray  # i32 high watermark (leader authoritative; followers
+    # hold the last adopted copy)
+    # leader-only replication bookkeeping (durable; junk on followers)
+    in_sync: jnp.ndarray  # i32 [N] 0|1; replica r in the ISR (a 0/1
+    # array, not a bitmask: the range certifier proves closed u8 fields
+    # by interval, and bit-twiddling would escape it)
+    fa: jnp.ndarray  # i32 [N] last acked offset per replica
+    lf_t: jnp.ndarray  # i32 [N] sent time of the last APPLIED fetch per
+    # replica (eviction clock + the stale-fetch guard)
+    # follower fetch bookkeeping (volatile)
+    ft: jnp.ndarray  # i32 sent time of my latest FETCH (FRESP echo match)
+
+
+def make_isr_spec(
+    n_nodes: int = 5,
+    tick_us: int = 25_000,
+    repl_timeout_us: int = 150_000,
+    produce_rate: float = 0.7,
+    buggy_stale_isr: bool = False,
+) -> ProtocolSpec:
+    N = n_nodes
+    assert N >= 3
+    peers = jnp.arange(N, dtype=jnp.int32)
+    LEADER = 0
+
+    def _min_acked(member, fa):
+        # min over ISR members' acked offsets. The non-member fallback
+        # is fa[LEADER] — the leader's bit is pinned, so this equals the
+        # true member-min while keeping the interval bounded (an INF
+        # sentinel would poison the u16 range certificate)
+        return jnp.where(member, fa, fa[LEADER]).min()
+
+    # ------------------------------------------------------------------ init
+
+    def init(key, nid):
+        z = jnp.int32(0)
+        state = IsrState(
+            leo=z, hw=z,
+            in_sync=jnp.ones((N,), jnp.int32),
+            fa=jnp.zeros((N,), jnp.int32),
+            lf_t=jnp.zeros((N,), jnp.int32),
+            ft=z,
+        )
+        # first fire >= tick_us out: the leo rate-floor argument wants
+        # every inter-produce gap >= tick_us, including the first
+        return state, tick_us + prng.randint(key, 60, 0, tick_us)
+
+    # ----------------------------------------------------------------- timer
+
+    def on_timer(s: IsrState, nid, now, key):
+        is_leader = nid == LEADER
+        # leader: produce at most one record per tick
+        produce = is_leader & (prng.uniform(key, 61) < produce_rate)
+        leo = s.leo + produce.astype(jnp.int32)
+        fa = jnp.where(produce & (peers == nid), leo, s.fa)
+        # leader: evict replicas whose last applied fetch went stale;
+        # the leader's own bit is pinned
+        stale = is_leader & (peers != nid) & (now - s.lf_t > repl_timeout_us)
+        in_sync = jnp.where(stale, 0, s.in_sync)
+        hw = jnp.where(
+            is_leader,
+            jnp.maximum(s.hw, _min_acked(in_sync > 0, fa)),
+            s.hw,
+        )
+        # follower: fetch every tick
+        fetch = ~is_leader
+        state = s._replace(
+            leo=leo, hw=hw, in_sync=in_sync, fa=fa,
+            ft=jnp.where(fetch, now, s.ft),
+        )
+        pay = jnp.stack([s.leo, now, jnp.int32(0)])
+        out = Outbox(
+            valid=jnp.stack([fetch]),
+            dst=jnp.stack([jnp.int32(LEADER)]),
+            kind=jnp.stack([jnp.int32(FETCH)]),
+            payload=jnp.stack([pay]),
+        )
+        return state, out, now + tick_us
+
+    # --------------------------------------------------------------- message
+
+    def on_message(s: IsrState, nid, src, kind, payload, now, key):
+        f = payload
+        is_leader = nid == LEADER
+        is_fetch = (kind == FETCH) & is_leader
+        is_fresp = (kind == FRESP) & ~is_leader
+
+        # -- leader: apply a fetch only when it beats the last applied
+        # one from this replica (sent-time guard: reordered/duplicated
+        # fetches are rejected, while a wipe-join's offset regression —
+        # fresh send time, smaller leo — applies, as it must)
+        sel = is_fetch & (peers == src) & (f[1] > s.lf_t)  # [N]
+        ack = jnp.minimum(f[0], s.leo)
+        fa = jnp.where(sel, ack, s.fa)
+        lf_t = jnp.where(sel, f[1], s.lf_t)
+        if buggy_stale_isr:
+            # THE PLANTED BUG: unconditional re-admission — no catch-up
+            # check, no demotion on a regressed ack. A wipe-joined
+            # replica fetching at offset 0 re-enters the ISR while hw is
+            # ahead, acking a stale high-watermark.
+            in_sync = jnp.where(sel, 1, s.in_sync)
+        else:
+            # Kafka contract: in the ISR iff caught up to the watermark
+            in_sync = jnp.where(
+                sel, (ack >= s.hw).astype(jnp.int32), s.in_sync
+            )
+        hw = jnp.where(
+            is_fetch,
+            jnp.maximum(s.hw, _min_acked(in_sync > 0, fa)),
+            s.hw,
+        )
+
+        # -- follower: adopt the leader's (leo, hw) when the echo matches
+        # my latest fetch (stale/reordered responses drop)
+        adopt = is_fresp & (f[2] == s.ft) & (s.ft > 0)
+        resp_pay = jnp.stack([s.leo, hw, f[1]])
+        state = s._replace(
+            leo=jnp.where(adopt, f[0], s.leo),
+            hw=jnp.where(adopt, f[1], hw),
+            in_sync=in_sync, fa=fa, lf_t=lf_t,
+        )
+        # reply to every fetch (stale ones re-ack: the follower's echo
+        # guard makes redelivery idempotent)
+        out = Outbox(
+            valid=jnp.stack([is_fetch]),
+            dst=jnp.stack([src.astype(jnp.int32)]),
+            kind=jnp.stack([jnp.int32(FRESP)]),
+            payload=jnp.stack([resp_pay]),
+        )
+        return state, out, jnp.int32(-1)
+
+    # --------------------------------------------------------------- restart
+
+    def on_restart(s: IsrState, nid, now, key):
+        state = s._replace(ft=jnp.int32(0))
+        # re-arm >= tick_us out (part of the leo rate-floor argument)
+        return state, now + tick_us + prng.randint(key, 62, 0, tick_us)
+
+    # ------------------------------------------------------------ invariants
+
+    def check_invariants(ns: IsrState, alive, now):
+        # ns leaves are [N, ...] for one lane; everything checked is
+        # leader-local (node 0) or node-local — race-free under skew
+        member = ns.in_sync[LEADER] > 0  # [N]
+        fa0, hw0 = ns.fa[LEADER], ns.hw[LEADER]
+        catch_up = ~(member & (fa0 < hw0)).any()
+        hw_sane = (ns.hw <= ns.leo).all()
+        return catch_up & hw_sane
+
+    # ------------------------------------------------------------ diagnostics
+
+    def lane_metrics(node):
+        return {
+            "mean_hw": node.hw[:, LEADER].astype(jnp.float32),
+            "mean_isr_size": (
+                node.in_sync[:, LEADER] > 0
+            ).sum(-1).astype(jnp.float32),
+        }
+
+    floor_why = (
+        "leo advances by at most 1 per leader tick: produce happens only "
+        "in on_timer, the re-arm is always now + tick_us, and init/"
+        "restart arm the first fire >= tick_us out"
+    )
+    return fuse_two_handlers(ProtocolSpec(
+        name=f"isr{N}",
+        n_nodes=N,
+        payload_width=PAYLOAD_WIDTH,
+        max_out=1,
+        max_out_msg=1,
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+        lane_metrics=lane_metrics,
+        msg_kind_names=("FETCH", "FRESP"),
+        time_fields=("lf_t", "ft"),
+        # r8 carry compaction (docs/state_layout.md): the offsets are
+        # rate-bounded counters — leo ticks up at most once per leader
+        # tick, and hw/fa only ever copy leo-family values (min/max over
+        # acked offsets, payload copies), so they ride the same budget
+        # under the certifier's copy premise. in_sync is a 0/1 flag row.
+        narrow_fields={
+            "in_sync": jnp.uint8,
+            "leo": jnp.uint16,
+            "hw": jnp.uint16,
+            "fa": jnp.uint16,
+        },
+        rate_floors={
+            "leo": RateFloor(floor_us=tick_us, ratchet=1, inc=1,
+                             why=floor_why),
+            "hw": RateFloor(floor_us=tick_us, ratchet=1, inc=1,
+                            why="copy: max/min over fa, itself leo copies"),
+            "fa": RateFloor(floor_us=tick_us, ratchet=1, inc=1,
+                            why="copy: min(fetched leo, own leo)"),
+        },
+        # u16 budget at one bump per tick, halved for skew derating and
+        # engineering margin; benches run seconds, this proves ~13 min
+        narrow_horizon_us=65_535 * tick_us // 2,
+    ))
+
+
+def isr_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
+                 loss_rate: float = 0.1, buggy: bool = False):
+    """ISR replication under loss + crash + RECONFIG chaos — the
+    membership axis is the point: wipe-joins regress a replica's acked
+    offset, which only a catch-up-checking leader survives. A violating
+    seed gets both microscopes: the device trace and the host twin
+    (workloads/isr_host.py), verified by the same invariants."""
+    from .batch import BatchWorkload
+    from .spec import SimConfig, pool_kw_for
+
+    spec = make_isr_spec(n_nodes, buggy_stale_isr=buggy)
+
+    def host_repro(seed: int):
+        from ..workloads import isr_host
+
+        try:
+            out = isr_host.fuzz_one_seed(
+                seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+                loss_rate=loss_rate, buggy=buggy,
+            )
+            out["violations"] = 0
+            return out
+        except isr_host.InvariantViolation as e:
+            return {"violations": 1, "violation": str(e)}
+
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+        loss_rate=loss_rate,
+        crash_interval_lo_us=500_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=900_000,
+        # membership churn: down windows comfortably above repl_timeout
+        # so the removed replica is evicted before its fresh join
+        nem_reconfig_interval_lo_us=600_000,
+        nem_reconfig_interval_hi_us=1_800_000,
+        nem_reconfig_down_lo_us=300_000,
+        nem_reconfig_down_hi_us=900_000,
+    )
+    return BatchWorkload(spec=spec, config=cfg, host_repro=host_repro)

@@ -1,0 +1,386 @@
+"""etcd-family lease/watch — the membership-epoch fuzz protocol.
+
+A seventh *shape*: a LEASE SERVER (node 0 — the stand-in for the raft-
+replicated lease state machine; wiping it is losing the lease log, which
+the invariant's guards acknowledge) granting time-bound exclusive leases
+to client nodes, with keepalive renewal, fenced release, and a
+best-effort watch plane (NOTIFY) — the etcd lease/lock shape. Written
+with `fuse_two_handlers` per docs/authoring_protocol_specs.md.
+
+The membership hook: every client draws a DURABLE random incarnation
+nonce at init. A crash/restart keeps it (disk survives); a reconfig
+WIPE-JOIN re-runs init and draws a fresh one — the nonce is how this
+protocol observes membership epochs, exactly the client-identity
+rotation an etcd client gets when a member is removed and a new one
+joins with a fresh client session.
+
+Protocol:
+
+  * ACQUIRE(inc, req_t): the server grants when the lease is free or
+    expired (`l_token += 1`, a fencing token; holder/incarnation/expiry
+    recorded), and RENEWS when the caller IS the current holder — the
+    correct server matches holder identity AND incarnation. GRANT
+    carries (token, expiry, echo); the client believes only while a
+    request is pending and the echo matches it, so a delayed grant for
+    an abandoned request can never create belief.
+  * KA(inc, token)/KACK: keepalive extends a live lease for the
+    matching holder+incarnation; every renewal bumps the fencing token
+    (an etcd-revision-style bump), which is what makes a stale RELEASE
+    — reordered past a re-acquire — bounce off the token guard instead
+    of freeing a live lease.
+  * RELEASE(token, inc): frees the lease iff holder and token match.
+    The releasing client stops believing BEFORE the message is sent.
+  * NOTIFY(token, holder): the server's tick broadcasts the lease head
+    to one random watcher; watchers fold `wseen = max(wseen, token)` —
+    a diagnostics-only observation plane (lane_metrics), deliberately
+    not part of the invariant.
+
+Device invariant (per lane, per step — server-local facts against each
+client's local belief; global virtual time makes the expiry comparisons
+race-free): whenever the server records client i as the holder AND i
+currently believes it holds the lease (held, now <= my_expiry), the
+server-recorded incarnation is i's CURRENT one. Cross-holder mutual
+exclusion is deliberately out of scope: a server wipe-join loses the
+lease log and restarts the token counter, so no server-local fact can
+separate that amnesia from a genuine double-grant — the lost-lease-log
+mode is the replicated state machine's problem, not this check's.
+
+The canonical injected bug (`buggy_zombie_lease=True`): renewal matches
+on the HOLDER NODE ID ALONE, ignoring the incarnation. A client removed
+by the reconfig nemesis rejoins with a fresh nonce while its old lease
+is still live; its ACQUIRE hits the holder-id match and is serviced as
+a RENEWAL of the old lease — old incarnation kept alive by a node that
+was removed in that same epoch. The fresh client believes (echo-matched
+GRANT), the server records the stale incarnation, and the invariant
+fires. Crash/restart CANNOT fire it (the nonce is durable, so renewal
+is then legitimate) — this bug lives purely on the membership axis,
+which is what lets ddmin isolate the reconfig clause.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from . import prng
+from .spec import Outbox, ProtocolSpec, RateFloor, fuse_two_handlers
+
+ACQUIRE, GRANT, KA, KACK, RELEASE, NOTIFY = range(6)
+PAYLOAD_WIDTH = 3
+
+
+class LeaseState(NamedTuple):
+    # client identity (durable — init-drawn, so a wipe-join rotates it)
+    inc: jnp.ndarray  # i32 incarnation nonce
+    # client belief (durable: a restarted client resumes a live lease)
+    held: jnp.ndarray  # i32 0|1
+    my_token: jnp.ndarray  # i32 fencing token of my lease
+    my_expiry: jnp.ndarray  # i32 server-stamped expiry
+    # client request/keepalive bookkeeping
+    pend: jnp.ndarray  # i32 0|1 acquire outstanding      (volatile)
+    req_t: jnp.ndarray  # i32 acquire send time (GRANT echo)
+    ka_t: jnp.ndarray  # i32 last keepalive send time
+    # watch plane (diagnostics)
+    wseen: jnp.ndarray  # i32 max token observed via NOTIFY
+    # the lease head (server/node 0 only; junk elsewhere — durable)
+    l_holder: jnp.ndarray  # i32 node id, -1 = free
+    l_inc: jnp.ndarray  # i32 holder's incarnation at grant
+    l_token: jnp.ndarray  # i32 monotone fencing token
+    l_expiry: jnp.ndarray  # i32
+
+
+def make_lease_spec(
+    n_nodes: int = 5,
+    tick_us: int = 25_000,
+    ttl_us: int = 1_500_000,
+    ka_interval_us: int = 200_000,
+    req_timeout_us: int = 300_000,
+    acquire_rate: float = 0.5,
+    release_rate: float = 0.04,
+    buggy_zombie_lease: bool = False,
+) -> ProtocolSpec:
+    N = n_nodes
+    assert N >= 3
+    peers = jnp.arange(N, dtype=jnp.int32)
+    SERVER = 0
+
+    # ------------------------------------------------------------------ init
+
+    def init(key, nid):
+        z = jnp.int32(0)
+        state = LeaseState(
+            inc=prng.randint(key, 70, 1, 1 << 30),
+            held=z, my_token=z, my_expiry=z,
+            pend=z, req_t=z, ka_t=z, wseen=z,
+            l_holder=jnp.int32(-1), l_inc=z, l_token=z, l_expiry=z,
+        )
+        # first fire >= tick_us out (part of the l_token rate-floor
+        # argument: at most one lease message per client per tick)
+        return state, tick_us + prng.randint(key, 71, 0, tick_us)
+
+    # ----------------------------------------------------------------- timer
+
+    def on_timer(s: LeaseState, nid, now, key):
+        is_server = nid == SERVER
+        is_client = ~is_server
+        # client: local expiry ends belief
+        holding = is_client & (s.held > 0) & (now <= s.my_expiry)
+        held = jnp.where(is_client & (s.held > 0) & ~holding, 0, s.held)
+        # client: release (rare), else keepalive, else maybe acquire
+        send_rel = holding & (prng.uniform(key, 72) < release_rate)
+        held = jnp.where(send_rel, 0, held)  # stop believing BEFORE sending
+        send_ka = holding & ~send_rel & (now - s.ka_t > ka_interval_us)
+        pend = jnp.where(
+            is_client & (s.pend > 0) & (now - s.req_t > req_timeout_us),
+            0, s.pend,
+        )
+        send_acq = (
+            is_client & ~holding & (held == 0) & (pend == 0)
+            & (prng.uniform(key, 73) < acquire_rate)
+        )
+        # server: watch plane — tell one random watcher the lease head
+        watcher = prng.randint(key, 74, 1, N)
+
+        state = s._replace(
+            held=held,
+            pend=jnp.where(send_acq, 1, pend),
+            req_t=jnp.where(send_acq, now, s.req_t),
+            ka_t=jnp.where(send_ka, now, s.ka_t),
+        )
+        c_pay = jnp.where(
+            send_acq,
+            jnp.stack([s.inc, now, jnp.int32(0)]),
+            jnp.where(
+                send_rel,
+                jnp.stack([s.my_token, s.inc, jnp.int32(0)]),
+                jnp.stack([s.inc, s.my_token, jnp.int32(0)]),  # KA
+            ),
+        )
+        c_kind = jnp.where(
+            send_acq, ACQUIRE, jnp.where(send_rel, RELEASE, KA)
+        ).astype(jnp.int32)
+        out = Outbox(
+            valid=jnp.stack([is_server | send_acq | send_rel | send_ka]),
+            dst=jnp.stack([jnp.where(is_server, watcher, SERVER)
+                           .astype(jnp.int32)]),
+            kind=jnp.stack([jnp.where(is_server, NOTIFY, c_kind)
+                            .astype(jnp.int32)]),
+            payload=jnp.stack([jnp.where(
+                is_server,
+                jnp.stack([s.l_token, s.l_holder, jnp.int32(0)]),
+                c_pay,
+            )]),
+        )
+        return state, out, now + tick_us
+
+    # --------------------------------------------------------------- message
+
+    def on_message(s: LeaseState, nid, src, kind, payload, now, key):
+        f = payload
+        is_server = nid == SERVER
+        live = now <= s.l_expiry
+
+        # -- server: ACQUIRE — grant when free/expired, renew when the
+        # caller is the current holder
+        is_acq = (kind == ACQUIRE) & is_server
+        if buggy_zombie_lease:
+            # THE PLANTED BUG: renewal matches the holder NODE ID alone
+            # — the incarnation is ignored, so a wipe-joined client's
+            # fresh ACQUIRE renews the removed incarnation's live lease
+            match_holder = s.l_holder == src
+        else:
+            match_holder = (s.l_holder == src) & (s.l_inc == f[0])
+        free = (s.l_holder < 0) | ~live
+        grant_new = is_acq & free
+        renew = is_acq & ~free & match_holder
+        granted = grant_new | renew
+        # -- server: KA — extend a live lease for the matching holder
+        ka_ok = (kind == KA) & is_server & live & match_holder
+        # every renewal bumps the fencing token (etcd-revision style):
+        # stale RELEASEs reordered past a re-acquire bounce off it
+        bump = granted | ka_ok
+        l_token = jnp.where(bump, s.l_token + 1, s.l_token)
+        # -- server: RELEASE — free iff holder and token match
+        rel_ok = (
+            (kind == RELEASE) & is_server
+            & (s.l_holder == src) & (s.l_token == f[0])
+        )
+
+        # -- client: GRANT — believe only against the pending request
+        is_grant = (
+            (kind == GRANT) & ~is_server & (s.pend > 0) & (f[2] == s.req_t)
+        )
+        # -- client: KACK — fold in the renewed token/expiry
+        is_kack = (
+            (kind == KACK) & ~is_server & (s.held > 0)
+            & (f[0] >= s.my_token)
+        )
+        # -- client: NOTIFY — watch plane
+        is_ntf = (kind == NOTIFY) & ~is_server
+
+        state = s._replace(
+            l_holder=jnp.where(grant_new, src,
+                               jnp.where(rel_ok, -1, s.l_holder)),
+            l_inc=jnp.where(grant_new, f[0], s.l_inc),
+            l_token=l_token,
+            l_expiry=jnp.where(bump, now + ttl_us, s.l_expiry),
+            held=jnp.where(is_grant, 1, s.held),
+            my_token=jnp.where(is_grant | is_kack, f[0], s.my_token),
+            my_expiry=jnp.where(
+                is_grant, f[1],
+                jnp.where(is_kack, jnp.maximum(s.my_expiry, f[1]),
+                          s.my_expiry),
+            ),
+            pend=jnp.where(is_grant, 0, s.pend),
+            ka_t=jnp.where(is_grant, now, s.ka_t),
+            wseen=jnp.where(
+                is_grant | is_kack | is_ntf,
+                jnp.maximum(s.wseen, f[0]), s.wseen,
+            ),
+        )
+        out = Outbox(
+            valid=jnp.stack([granted | ka_ok]),
+            dst=jnp.stack([src.astype(jnp.int32)]),
+            kind=jnp.stack([jnp.where(granted, GRANT, KACK)
+                            .astype(jnp.int32)]),
+            payload=jnp.stack([jnp.stack([
+                l_token, now + ttl_us,
+                jnp.where(granted, f[1], jnp.int32(0)),
+            ])]),
+        )
+        return state, out, jnp.int32(-1)
+
+    # --------------------------------------------------------------- restart
+
+    def on_restart(s: LeaseState, nid, now, key):
+        # inc/held/my_* are durable: a restarted client resumes a live
+        # lease and renews under the SAME incarnation — crash/restart is
+        # deliberately invisible to the lease server
+        state = s._replace(pend=jnp.int32(0))
+        return state, now + tick_us + prng.randint(key, 75, 0, tick_us)
+
+    # ------------------------------------------------------------ invariants
+
+    def check_invariants(ns: LeaseState, alive, now):
+        # ns leaves are [N, ...] for one lane. The incarnation-identity
+        # claim: whenever the server records node i as holder AND i
+        # itself currently believes, the recorded incarnation is i's
+        # CURRENT one. In the correct spec this holds by construction
+        # (every grant/renewal to i writes or verifies i's live inc,
+        # and belief only comes from an echo-matched grant) — including
+        # across server wipes, since a fresh server only ever learns
+        # current incarnations. Cross-holder mutual exclusion is NOT
+        # checked: a server wipe loses the lease log (token counter
+        # restarts), so no local guard can separate amnesia from a
+        # genuine double-grant — that's the replicated state machine's
+        # obligation, not this safety check's.
+        lh, li = ns.l_holder[SERVER], ns.l_inc[SERVER]
+        believer = (peers != SERVER) & (ns.held > 0) & (now <= ns.my_expiry)
+        checked = believer & (lh == peers)
+        ok = ~checked | (li == ns.inc)
+        return ok.all()
+
+    # ------------------------------------------------------------ diagnostics
+
+    def lane_metrics(node):
+        return {
+            "mean_lease_token": node.l_token[:, SERVER].astype(jnp.float32),
+            "mean_believers": (
+                (node.held[:, 1:] > 0).sum(-1).astype(jnp.float32)
+            ),
+            "mean_wseen": node.wseen[:, 1:].max(-1).astype(jnp.float32),
+        }
+
+    floor_why = (
+        "the server bumps l_token at most once per arriving lease "
+        "message; each client sends at most one lease message per tick "
+        "(the timer's three sends are mutually exclusive, re-arm is "
+        "now + tick_us, init/restart arm >= tick_us out), so <= N-1 "
+        "bumps per tick window, doubled for the Duplicate clause"
+    )
+    return fuse_two_handlers(ProtocolSpec(
+        name=f"lease{N}",
+        n_nodes=N,
+        payload_width=PAYLOAD_WIDTH,
+        max_out=1,
+        max_out_msg=1,
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+        lane_metrics=lane_metrics,
+        msg_kind_names=("ACQUIRE", "GRANT", "KA", "KACK", "RELEASE",
+                        "NOTIFY"),
+        time_fields=("my_expiry", "req_t", "ka_t", "l_expiry"),
+        # r8 carry compaction: held/pend are flags; the fencing tokens
+        # are rate-bounded (see floor); inc stays i32 (a 30-bit random
+        # nonce — narrowing it would collide incarnations); l_holder
+        # stays i32 for its -1 sentinel
+        narrow_fields={
+            "held": jnp.uint8,
+            "pend": jnp.uint8,
+            "l_token": jnp.uint16,
+            "my_token": jnp.uint16,
+            "wseen": jnp.uint16,
+        },
+        rate_floors={
+            "l_token": RateFloor(floor_us=tick_us, ratchet=2 * N, inc=1,
+                                 why=floor_why),
+            "my_token": RateFloor(floor_us=tick_us, ratchet=2 * N, inc=1,
+                                  why="copy: GRANT/KACK payload of l_token"),
+            "wseen": RateFloor(floor_us=tick_us, ratchet=2 * N, inc=1,
+                               why="copy: max over observed l_token values"),
+        },
+        # u16 budget at <= 2N bumps per tick, halved again for skew
+        # derating and margin; benches run seconds, this proves ~80 s
+        narrow_horizon_us=65_535 * tick_us // (4 * N),
+    ))
+
+
+def lease_workload(n_nodes: int = 5, virtual_secs: float = 10.0,
+                   loss_rate: float = 0.1, buggy: bool = False):
+    """Lease/watch under loss + crash + RECONFIG chaos. Crash/restart
+    keeps the incarnation nonce (durable), so only the membership axis
+    rotates client identity — the zombie-lease bug cannot fire without
+    a wipe-join. A violating seed gets both microscopes: the device
+    trace and the host twin (workloads/lease_host.py)."""
+    from .batch import BatchWorkload
+    from .spec import SimConfig, pool_kw_for
+
+    spec = make_lease_spec(n_nodes, buggy_zombie_lease=buggy)
+
+    def host_repro(seed: int):
+        from ..workloads import lease_host
+
+        try:
+            out = lease_host.fuzz_one_seed(
+                seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+                loss_rate=loss_rate, buggy=buggy,
+            )
+            out["violations"] = 0
+            return out
+        except lease_host.InvariantViolation as e:
+            return {"violations": 1, "violation": str(e)}
+
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+        loss_rate=loss_rate,
+        crash_interval_lo_us=500_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=900_000,
+        # down windows well under ttl_us: the removed holder's lease is
+        # still live when its fresh incarnation rejoins and re-acquires
+        nem_reconfig_interval_lo_us=600_000,
+        nem_reconfig_interval_hi_us=1_800_000,
+        nem_reconfig_down_lo_us=300_000,
+        nem_reconfig_down_hi_us=900_000,
+    )
+    return BatchWorkload(spec=spec, config=cfg, host_repro=host_repro)

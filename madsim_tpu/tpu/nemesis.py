@@ -34,6 +34,7 @@ from ..nemesis import (
     NemesisEvent,
     OCC_CLAUSES,
     Partition,
+    Reconfig,
     Reorder,
 )
 from .spec import SimConfig
@@ -100,6 +101,14 @@ def compile_plan(plan: FaultPlan, base: Optional[SimConfig] = None) -> SimConfig
     skew = plan.get(ClockSkew)
     if skew is not None:
         kw.update(nem_skew_max_ppm=skew.max_ppm)
+    reconf = plan.get(Reconfig)
+    if reconf is not None:
+        kw.update(
+            nem_reconfig_interval_lo_us=reconf.interval_lo_us,
+            nem_reconfig_interval_hi_us=reconf.interval_hi_us,
+            nem_reconfig_down_lo_us=reconf.down_lo_us,
+            nem_reconfig_down_hi_us=reconf.down_hi_us,
+        )
     return dataclasses.replace(cfg, **kw)
 
 
@@ -107,7 +116,7 @@ def compile_plan(plan: FaultPlan, base: Optional[SimConfig] = None) -> SimConfig
 # and spike magnitudes are schedule-side detail the trace doesn't carry
 _CHAOS_KINDS = (
     "crash", "restart", "split", "heal", "clog", "unclog",
-    "spike_on", "spike_off",
+    "spike_on", "spike_off", "remove", "join",
 )
 
 
@@ -155,7 +164,7 @@ def device_chaos_events(
             continue
         if horizon_us is not None and ev.t_us >= horizon_us:
             continue
-        if ev.kind in ("crash", "restart"):
+        if ev.kind in ("crash", "restart", "remove", "join"):
             out.append((ev.t_us, ev.kind, ev.node, -1))
         elif ev.kind in ("split", "heal"):
             # trace detail carries the split sides; side_mask round-trips
@@ -251,6 +260,8 @@ def enabled_fire_kinds(cfg: SimConfig) -> Tuple[str, ...]:
         kinds.append("reorder")
     if cfg.nem_skew_enabled:
         kinds.append("skew")
+    if cfg.nem_reconfig_enabled:
+        kinds += ["remove", "join"]
     return tuple(kinds)
 
 

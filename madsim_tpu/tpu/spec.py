@@ -452,6 +452,14 @@ class SimConfig:
     # per-node clock skew: relative timer delays scale by 1 + ppm * 1e-6,
     # ppm drawn once per (seed, node) from [-max, +max]
     nem_skew_max_ppm: int = 0
+    # dynamic membership: every interval a random node is REMOVED (member
+    # + alive bits clear, inbound counted as non-member drops), rejoining
+    # after the down window as a fresh replica rebuilt through `init`;
+    # each applied half bumps the lane's membership epoch
+    nem_reconfig_interval_lo_us: int = 0
+    nem_reconfig_interval_hi_us: int = 0  # 0 disables
+    nem_reconfig_down_lo_us: int = 500_000
+    nem_reconfig_down_hi_us: int = 3_000_000
     horizon_us: int = 30_000_000  # virtual-time budget per lane
     # scheduling-order nondeterminism (the utils/mpsc.rs:71-84 random-pop
     # analog, on device): break equal-timestamp delivery ties by a random
@@ -529,6 +537,10 @@ class SimConfig:
     @property
     def nem_skew_enabled(self) -> bool:
         return self.nem_skew_max_ppm > 0
+
+    @property
+    def nem_reconfig_enabled(self) -> bool:
+        return self.nem_reconfig_interval_hi_us > 0
 
     @property
     def nem_dup_enabled(self) -> bool:

@@ -30,7 +30,7 @@ class TraceEvent:
     step: int
     t_us: int
     # deliver | timer | crash | restart | split | heal | clog | unclog |
-    # spike_on | spike_off | violation | deadlock
+    # spike_on | spike_off | remove | join | violation | deadlock
     kind: str
     node: int = -1  # acting node (dst for deliver; src for clog)
     src: int = -1  # sender (deliver only)
@@ -67,6 +67,16 @@ class TraceEvent:
             return f"[{t:9.6f}s #{self.step}] latency spike begins {self.detail}"
         if self.kind == "spike_off":
             return f"[{t:9.6f}s #{self.step}] latency spike ends"
+        if self.kind == "remove":
+            return (
+                f"[{t:9.6f}s #{self.step}] node{self.node} REMOVED from "
+                "membership"
+            )
+        if self.kind == "join":
+            return (
+                f"[{t:9.6f}s #{self.step}] node{self.node} joins as a "
+                "fresh replica"
+            )
         return f"[{t:9.6f}s #{self.step}] {self.kind.upper()} {self.detail}"
 
 
@@ -107,6 +117,8 @@ def extract_trace(
     unclog = np.asarray(recs.unclog)[:, lane]
     spike_on = np.asarray(recs.spike_on)[:, lane]
     spike_off = np.asarray(recs.spike_off)[:, lane]
+    remove = np.asarray(recs.remove)[:, lane]
+    join = np.asarray(recs.join)[:, lane]
     # lineage plane (BatchedSim(lineage=True) traces only)
     has_lin = recs.evt_eid is not None
     if has_lin:
@@ -122,6 +134,7 @@ def extract_trace(
         msg_fired.any(1) | timer_fired.any(1) | (crash >= 0) | (restart >= 0)
         | split | heal | violation | deadlock
         | (clog_src >= 0) | unclog | spike_on | spike_off
+        | (remove >= 0) | (join >= 0)
     )
     for t in np.nonzero(busy)[0]:
         t = int(t)
@@ -202,6 +215,18 @@ def extract_trace(
             events.append(TraceEvent(step=t, t_us=t_chaos, kind="spike_on"))
         if spike_off[t]:
             events.append(TraceEvent(step=t, t_us=t_chaos, kind="spike_off"))
+        if remove[t] >= 0:
+            events.append(
+                TraceEvent(
+                    step=t, t_us=t_chaos, kind="remove", node=int(remove[t])
+                )
+            )
+        if join[t] >= 0:
+            events.append(
+                TraceEvent(
+                    step=t, t_us=t_chaos, kind="join", node=int(join[t])
+                )
+            )
         if violation[t]:
             events.append(
                 TraceEvent(

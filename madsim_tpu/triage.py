@@ -59,6 +59,7 @@ from .nemesis import (
     Partition,
     RATE_CLAUSES,
     RATE_ROW,
+    Reconfig,
     Reorder,
     TRIAGE_BIT,
     TRIAGE_CLAUSES,
@@ -81,7 +82,7 @@ Atom = Tuple[str, Optional[int]]
 _CLAUSE_TYPES = {
     "crash": Crash, "partition": Partition, "clog": LinkClog,
     "spike": LatencySpike, "skew": ClockSkew, "loss": MsgLoss,
-    "dup": Duplicate, "reorder": Reorder,
+    "dup": Duplicate, "reorder": Reorder, "reconfig": Reconfig,
 }
 
 
@@ -146,6 +147,13 @@ def plan_from_config(cfg, name: str = "recovered") -> FaultPlan:
         ))
     if cfg.nem_skew_enabled:
         clauses.append(ClockSkew(max_ppm=cfg.nem_skew_max_ppm))
+    if cfg.nem_reconfig_enabled:
+        clauses.append(Reconfig(
+            interval_lo_us=cfg.nem_reconfig_interval_lo_us,
+            interval_hi_us=cfg.nem_reconfig_interval_hi_us,
+            down_lo_us=cfg.nem_reconfig_down_lo_us,
+            down_hi_us=cfg.nem_reconfig_down_hi_us,
+        ))
     return FaultPlan(clauses=tuple(clauses), name=name)
 
 

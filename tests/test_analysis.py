@@ -422,6 +422,30 @@ def test_mirror_rule_ignores_docstring_prose():
                for v in res.violations), [v.render() for v in res.violations]
 
 
+def test_mirror_rule_fires_on_driver_missing_reconfig_path():
+    """The r17 fixture: a NemesisDriver whose _apply handles every legacy
+    kind (and assigns skew) but never the reconfig clause's remove/join —
+    the host application path of the membership axis silently gone. The
+    mirror rule must name BOTH halves of the missing window."""
+    fake_driver = '\n'.join([
+        "class NemesisDriver:",
+        "    def install(self):",
+        "        self._assign('skew')",
+        "    def _apply(self, ev):",
+        "        for k in ('crash', 'restart', 'split', 'heal', 'clog',",
+        "                  'unclog', 'spike_on', 'spike_off'):",
+        "            if ev.kind == k:",
+        "                return",
+    ])
+    res = lint.check_mirror(driver_source=fake_driver)
+    assert not res.ok
+    missing = [v for v in res.violations if "never handles" in v.detail]
+    assert any("'remove'" in v.detail for v in missing), (
+        [v.render() for v in res.violations]
+    )
+    assert any("'join'" in v.detail for v in missing)
+
+
 def test_mirror_rule_fires_on_clause_without_host_coin_methods():
     """Face (f): a message clause with no HOST_COIN_METHODS entry is a
     FaultPlan clause whose host draws the oracle cannot verify."""

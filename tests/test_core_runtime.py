@@ -455,6 +455,37 @@ def test_fs_power_fail_rolls_back_inplace_overwrites():
     rt.block_on(main())
 
 
+def test_fs_wipe_node_drops_even_synced_inodes():
+    # the membership-JOIN rule next to power_fail's crash rule: a synced
+    # file SURVIVES a power failure but does NOT survive wipe_node — a
+    # node rejoining after a `reconfig` removal is a different machine,
+    # so a create -> sync -> remove -> rejoin -> stat sequence must see
+    # an empty disk, not the pre-removal inode (the resurrection bug the
+    # r17 regression fixed)
+    rt = ms.Runtime(seed=1)
+    from madsim_tpu import fs
+
+    async def main():
+        f = await fs.File.create("/data/segment")
+        await f.write_all_at(b"durable", 0)
+        await f.sync_all()
+
+        sim = ms.plugin.simulator(fs.FsSim)
+        node_id = ms.plugin.node()
+        sim.power_fail(node_id)
+        assert await fs.read("/data/segment") == b"durable"  # crash: kept
+
+        sim.wipe_node(node_id)  # membership join: a brand-new replica
+        assert sim.get_file_size(node_id, "/data/segment") is None
+        try:
+            await fs.File.open("/data/segment")
+            raise AssertionError("pre-wipe inode resurrected after join")
+        except FileNotFoundError:
+            pass
+
+    rt.block_on(main())
+
+
 def test_notify_stores_at_most_one_permit():
     # tokio Notify semantics: N notify_one calls with no waiters grant ONE
     # stored wakeup, not N

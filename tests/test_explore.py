@@ -31,6 +31,7 @@ from madsim_tpu.explore import (
 from madsim_tpu.nemesis import (
     Crash,
     FaultPlan,
+    OCC_CLAUSES,
     OCC_ROW,
     Partition,
     TRIAGE_BIT,
@@ -78,9 +79,11 @@ def test_meta_rng_is_a_pure_counter_chain():
 
 def test_candidate_base_ctl_faces():
     assert Candidate(seed=3).base_ctl() is None
+    occ = [0] * len(OCC_CLAUSES)
+    occ[OCC_ROW["partition"]] = 0b101
     c = Candidate(
         seed=3, off=TRIAGE_BIT["loss"],
-        occ_off=(0, 0b101, 0, 0), rate_scale=(1.0, 0.5, 1.0),
+        occ_off=tuple(occ), rate_scale=(1.0, 0.5, 1.0),
         horizon_us=1_000_000,
     )
     ctl = c.base_ctl()
@@ -93,6 +96,10 @@ def test_candidate_base_ctl_faces():
     assert "partition.occ_off=0x5" in c.describe()
     # genome identity excludes provenance
     assert c.key() == dataclasses.replace(c, origin="swarm").key()
+    # corpus lines from before a registry grew pad to the current length
+    old = Candidate.from_dict({"seed": 1, "occ_off": [0, 0b101, 0, 0]})
+    assert len(old.occ_off) == len(OCC_CLAUSES)
+    assert old.base_ctl()["occ_off"] == {"partition": 0b101}
 
 
 def test_cov_index_mirrors_engine_hash_shape():

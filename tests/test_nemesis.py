@@ -525,3 +525,73 @@ def test_acceptance_1024_seed_batch_reports_every_clause():
     for kind in ACCEPT_PLAN.enabled_kinds:
         assert res.chaos_fires.get(kind, 0) > 0, (kind, res.chaos_fires)
     assert "DEAD CLAUSE" not in res.chaos_report()
+
+
+@pytest.mark.chaos
+def test_reconfig_join_wipes_fs_no_inode_resurrection():
+    """create -> remove -> rejoin -> stat, end to end through the driver:
+    a node that wrote and SYNCED a file before its reconfig removal must
+    come back with a blank disk (FsSim.wipe_node runs before the join's
+    restart) — synced durability is a crash promise, not a membership
+    one. Nodes the plan never removed keep their files."""
+    import madsim_tpu as ms
+    from madsim_tpu import fs
+    from madsim_tpu.nemesis import Reconfig
+
+    N, SEED, HOR_US = 5, 5, 4_000_000
+    plan = FaultPlan(name="join-wipe", clauses=(
+        Reconfig(interval_lo_us=500_000, interval_hi_us=1_200_000,
+                 down_lo_us=200_000, down_hi_us=600_000),
+    ))
+    joined = sorted(
+        {e.node for e in plan.schedule(SEED, HOR_US, N) if e.kind == "join"}
+    )
+    assert joined, "pick a seed whose plan completes a remove -> join"
+    incarnations = [0] * N
+
+    async def body():
+        handle = ms.Handle.current()
+
+        def mk(i):
+            async def run():
+                # only the FIRST incarnation writes its marker; a rejoin
+                # must not find it
+                if incarnations[i] == 0:
+                    f = await fs.File.create("/data/marker")
+                    await f.write_all_at(b"pre-removal", 0)
+                    await f.sync_all()
+                incarnations[i] += 1
+                while True:
+                    await ms.time.sleep(0.05)
+
+            return run
+
+        nodes = [
+            handle.create_node().name(f"fsn-{i}").ip(f"10.0.5.{i + 1}")
+            .init(mk(i)).build()
+            for i in range(N)
+        ]
+        driver = nemesis.NemesisDriver(
+            plan, handle, [nd.id for nd in nodes], horizon_us=HOR_US,
+        )
+        driver.install()
+        t = ms.time.current()
+        end = t.elapsed() + HOR_US / 1e6
+        while t.elapsed() < end:
+            await ms.time.sleep(0.02)
+        sim = ms.plugin.simulator(fs.FsSim)
+        return driver, [sim.get_file_size(nd.id, "/data/marker")
+                        for nd in nodes]
+
+    rt = ms.Runtime(seed=SEED)
+    driver, sizes = rt.block_on(body())
+    got_joined = sorted({e.node for e in driver.applied if e.kind == "join"})
+    assert got_joined == joined
+    for i in range(N):
+        if i in joined:
+            assert sizes[i] is None, (
+                f"node {i} rejoined with its pre-removal inode intact"
+            )
+            assert incarnations[i] >= 2
+        else:
+            assert sizes[i] == len(b"pre-removal")

@@ -243,6 +243,66 @@ def test_filtered_schedule_drops_whole_occurrence_windows():
     ]
 
 
+def test_reconfig_occurrence_suppression_is_schedule_pure():
+    """The r17 clause rides the same schedule-purity contract as every
+    other occurrence axis: suppressing reconfig occurrence 0 (pure face
+    `filter_schedule`, device face a TriageCtl occ bit) drops exactly
+    that remove/join window and perturbs NOTHING else — the crash stream
+    and the later reconfig windows keep their times bit-for-bit."""
+    from madsim_tpu.nemesis import Reconfig
+
+    plan = FaultPlan(name="reconfig-purity", clauses=(
+        Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=300_000, down_hi_us=1_000_000),
+        Reconfig(interval_lo_us=500_000, interval_hi_us=1_200_000,
+                 down_lo_us=200_000, down_hi_us=600_000),
+    ))
+    evs = plan.schedule(7, HORIZON_US, 5)
+    ks = sorted({e.k for e in evs if e.kind in ("remove", "join")})
+    assert len(ks) >= 2 and ks[0] == 0
+
+    # pure face: dropping occurrence 0 removes exactly its window
+    kept = nm.filter_schedule(evs, occ_off={"reconfig": 0b1})
+    assert not any(e.kind in ("remove", "join") and e.k == 0 for e in kept)
+    assert kept == [
+        e for e in evs if not (e.kind in ("remove", "join") and e.k == 0)
+    ]
+
+    # device face: the suppressed lane's chaos stream equals the filtered
+    # schedule event-for-event
+    from madsim_tpu.nemesis import OCC_ROW
+    from madsim_tpu.tpu import BatchedSim, SimConfig, default_ctl, make_raft_spec
+    from madsim_tpu.tpu import nemesis as tn
+
+    cfg = tn.compile_plan(plan, SimConfig(horizon_us=HORIZON_US))
+    sim = BatchedSim(make_raft_spec(5), cfg, triage=True)
+    full_ctl = default_ctl(1, HORIZON_US)
+    supp_ctl = full_ctl._replace(
+        occ=full_ctl.occ.at[:, OCC_ROW["reconfig"]].set(0b1)
+    )
+    compared = tn.assert_device_matches_schedule(
+        sim, plan, 7, horizon_us=HORIZON_US,
+        ctl=supp_ctl, occ_off={"reconfig": 0b1},
+    )
+    assert compared > 0
+
+    # purity across clauses: the surviving streams are bit-identical to
+    # the full run's — suppression did not shift anyone's draws
+    full = tn.device_chaos_events(
+        sim, 7, max_steps=40_000, horizon_us=HORIZON_US, ctl=full_ctl
+    )
+    supp = tn.device_chaos_events(
+        sim, 7, max_steps=40_000, horizon_us=HORIZON_US, ctl=supp_ctl
+    )
+    assert [t for t in supp if t[1] in ("crash", "restart")] == [
+        t for t in full if t[1] in ("crash", "restart")
+    ]
+    assert [t for t in supp if t[1] in ("remove", "join")] == tn.schedule_tuples(
+        [e for e in evs if e.kind in ("remove", "join") and e.k != 0],
+        HORIZON_US,
+    )
+
+
 def test_atom_universe_enumeration():
     from madsim_tpu.tpu import SimConfig
     from madsim_tpu.tpu import nemesis as tn

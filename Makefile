@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke reconfig-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke reconfig-smoke durability-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -54,6 +54,10 @@ oracle-smoke:    ## <60s CPU: the differential oracle both ways — a small raft
 reconfig-smoke:  ## <60s CPU: membership as a fault axis end to end — the planted kafka-family stale-ISR bug under a reconfig-ONLY plan is found by the explorer, ddmin-shrinks to reconfig occurrence atoms, campaign-dedups to ONE BugRecord, and the cross-witness anatomy names the rejoined replica's FETCH delivery; then the isr/lease spec suites
 	$(PY) benches/reconfig_smoke.py
 	$(PY) -m pytest tests/test_tpu_isr.py tests/test_tpu_lease.py -q -m "not slow"
+
+durability-smoke: ## <80s CPU: durability as a fault axis end to end — the planted ack-before-fsync WAL bug under a disk-ONLY plan is found by the explorer, ddmin-shrinks to disk occurrence atoms, campaign-dedups to ONE BugRecord, and the cross-witness anatomy names the ACK delivery fsync never covered; then the wal/fs spec suites
+	$(PY) benches/durability_smoke.py
+	$(PY) -m pytest tests/test_tpu_wal.py tests/test_fs_durability.py -q -m "not slow"
 
 tune:            ## measured autotune over every workload's throughput knobs; winners cached per (device_kind, workload, config, lane bucket) and consumed via tuning="auto" (docs/tuning.md)
 	$(PY) -m madsim_tpu.tune --workload all --virtual-secs 10 --lanes 32768

@@ -1,0 +1,199 @@
+"""durability-smoke: <80s durability-axis gate for CI.
+
+The r18 DiskFault clause's pitch is that durability is a fault AXIS, not
+scenery: a bug class REACHABLE ONLY by destroying unsynced state must
+flow through the whole farm — explorer, ddmin, campaign dedup, causal
+anatomy — and come out the other side named. This smoke walks that path
+on the planted WAL bug (a group-committing server acks appends BEFORE
+fsync, `make_wal_spec(buggy_ack_before_fsync=True)`) under a disk-ONLY
+plan — no crash clauses, loss pinned low — so the shrunk minimal plan
+can only ever blame the durability axis:
+
+  * FIND: one explorer generation over the planted config surfaces the
+    bug on multiple fresh seeds (lost acks are seed-dense once disks
+    die mid-group-commit);
+  * SHRINK: the campaign ddmin-shrinks the first witness and the kept
+    minimal plan names `disk` occurrence atoms (crash cannot appear:
+    the plan has none to keep);
+  * DEDUP: every further violating seed attaches as a witness of ONE
+    BugRecord — one bug class, one record, a saved ReproBundle;
+  * REPRO: the saved bundle replays bit-identically (repro.replay,
+    repeats=2) and still violates at the recorded step/time — the
+    bundle carries spec_ref, so `python -m madsim_tpu.repro` works
+    from any process;
+  * ANATOMY: the r12 cross-witness skeleton names the ack delivery —
+    the ACK the server issued for bytes fsync never saw;
+  * CONTROL: the fsync-before-ack spec stays silent under the exact
+    same dying disks.
+
+Wall times are printed for eyes only. Usage:
+python benches/durability_smoke.py  (or `make durability-smoke`)
+Exit code != 0 on any assertion failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LANES = 32
+VIRTUAL_SECS = 6.0
+
+
+def disk_only_workload(buggy: bool = True):
+    """The planted WAL config with durability chaos as the ONLY schedule
+    clause (loss stays as low message noise). `wal_workload` proper is
+    the same shape; this bench pins the knobs so ddmin's verdict is
+    unambiguous and the episode cadence outpaces the group-commit."""
+    from madsim_tpu.tpu.batch import BatchWorkload
+    from madsim_tpu.tpu.spec import SimConfig, pool_kw_for
+    from madsim_tpu.tpu.wal import make_wal_spec
+
+    spec = make_wal_spec(4, buggy_ack_before_fsync=buggy)
+    cfg = SimConfig(
+        horizon_us=int(VIRTUAL_SECS * 1e6),
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+        loss_rate=0.02,
+        nem_disk_interval_lo_us=300_000,
+        nem_disk_interval_hi_us=1_000_000,
+        # degraded window shorter than the 120ms group-commit period so
+        # crashes regularly land on a dirty, unsynced tail
+        nem_disk_slow_lo_us=80_000,
+        nem_disk_slow_hi_us=200_000,
+        nem_disk_down_lo_us=200_000,
+        nem_disk_down_hi_us=600_000,
+        nem_disk_torn_rate=0.5,
+        nem_disk_extra_us=30_000,
+    )
+    return BatchWorkload(spec=spec, config=cfg)
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from madsim_tpu import campaign
+    from madsim_tpu.nemesis import FIRE_KINDS
+    from madsim_tpu.tpu.engine import BatchedSim
+
+    wl = disk_only_workload(buggy=True)
+    sim = BatchedSim(wl.spec, wl.config, triage=True, coverage=True)
+    root = tempfile.mkdtemp(prefix="durability_smoke_")
+    try:
+        # -- find + shrink + dedup: one campaign generation -------------
+        camp = campaign.Campaign(
+            wl, os.path.join(root, "c"), meta_seed=0, lanes=LANES,
+            shrink=True, max_shrinks=2, sim=sim,
+            anatomy=True, max_anatomy_witnesses=2,
+            # baked into every saved bundle, so `python -m madsim_tpu.repro
+            # bundle.json` rebuilds the planted spec from any process
+            spec_ref="madsim_tpu.tpu.wal:make_wal_spec",
+            spec_kwargs={"n_nodes": 4, "buggy_ack_before_fsync": True},
+        )
+        rep = camp.run(1)
+        t_campaign = time.perf_counter() - t0
+        n_viol = len(camp.ex.violations)
+        assert n_viol >= 2, (
+            f"planted WAL bug found on only {n_viol} candidates — disk "
+            "crashes are not landing on unsynced acked appends"
+        )
+
+        # -- dedup: one bug class, ONE record ---------------------------
+        assert len(camp.bugs) == 1, (
+            f"one planted bug must dedup to one BugRecord, got "
+            f"{len(camp.bugs)}: "
+            f"{[(b.signature[:12], b.violation_kind) for b in camp.bugs]}"
+        )
+        bug = camp.bugs[0]
+        assert bug.shrink_error is None, f"shrink failed: {bug.shrink_error}"
+        assert len(bug.witnesses) >= 2, (
+            f"seed-dense bug attached only {len(bug.witnesses)} witnesses"
+        )
+
+        # -- shrink: the minimal plan blames the durability axis --------
+        profile = dict((n, c) for n, c in bug.clause_profile)
+        assert "disk" in profile, (
+            f"ddmin must keep disk occurrence atoms, kept {profile}"
+        )
+        assert "crash" not in profile, (
+            f"no crash clause exists in this plan, yet ddmin kept {profile}"
+        )
+        assert bug.bundle_path and os.path.exists(bug.bundle_path), (
+            f"shrunk witness must leave a ReproBundle, got {bug.bundle_path}"
+        )
+
+        # -- repro: the bundle replays bit-identically ------------------
+        from madsim_tpu import repro
+        from madsim_tpu.triage import ReproBundle
+
+        rep_replay = repro.replay(
+            ReproBundle.load(bug.bundle_path), backend="tpu", repeats=2,
+            out=lambda *_: None,
+        )
+        assert rep_replay.get("violated"), (
+            f"repro replay of the shrunk bundle did not violate: {rep_replay}"
+        )
+
+        # -- anatomy: the skeleton names the unsynced ack ---------------
+        assert bug.anatomy and "error" not in bug.anatomy, (
+            f"cross-witness anatomy failed: {bug.anatomy}"
+        )
+        skel = bug.anatomy["skeleton"]
+        assert any(label.startswith("deliver:ACK:") for label in skel), (
+            f"the skeleton must name the ACK delivery for bytes fsync "
+            f"never saw (the ack-before-fsync mechanism), got {skel[-8:]}"
+        )
+        t_anatomy = time.perf_counter() - t0
+
+        # -- control: correct spec silent under the same dying disks ----
+        t1 = time.perf_counter()
+        ctrl = disk_only_workload(buggy=False)
+        st = BatchedSim(ctrl.spec, ctrl.config).run(
+            jnp.arange(LANES, dtype=jnp.uint32), max_steps=wl.max_steps
+        )
+        n_ctrl = int(np.asarray(st.violated).sum())
+        assert n_ctrl == 0, (
+            f"fsync-before-ack spec violated on {n_ctrl} lanes under the "
+            "same disk chaos"
+        )
+        # the control leg still SAW the chaos (dead-clause guard) but
+        # never had unsynced durable state to lose
+        assert int(np.asarray(st.fires)[
+            :, FIRE_KINDS.index("disk_crash")
+        ].sum()) > 0
+        t_control = time.perf_counter() - t1
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(json.dumps({
+        "durability_smoke": "ok",
+        "violations": n_viol,
+        "witnesses": len(bug.witnesses),
+        "bug_records": 1,
+        "signature": bug.signature[:12],
+        "clause_profile": bug.clause_profile,
+        "skeleton_len": len(skel),
+        "skeleton_sha": bug.anatomy["skeleton_sha"],
+        "coverage_bits": rep.coverage_bits,
+        "wall_s": {
+            "campaign": round(t_campaign, 1),
+            "anatomy": round(t_anatomy - t_campaign, 1),
+            "control": round(t_control, 1),
+            "total": round(time.perf_counter() - t0, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

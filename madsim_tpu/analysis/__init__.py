@@ -73,7 +73,7 @@ ALL_RULES = JAXPR_RULES + LINT_RULES
 # independence of the edge-ring bookkeeping (the eid prefix count runs
 # over the NODE axis, never lanes).
 WORKLOADS = (
-    "raft", "kv", "paxos", "twopc", "chain", "isr", "lease",
+    "raft", "kv", "paxos", "twopc", "chain", "isr", "lease", "wal",
     "raft-refill", "raft-refill-sharded", "raft-lineage",
 )
 

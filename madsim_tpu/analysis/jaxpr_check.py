@@ -107,7 +107,7 @@ SHARD_COLLECTIVE_ALLOW: Tuple[str, ...] = ()
 # occurrence counters: the ONLY non-key values a schedule draw may touch
 NEUTRAL_LEAVES = frozenset({
     "hot.nem.crash_k", "hot.nem.part_k", "hot.nem.clog_k",
-    "hot.nem.spike_k", "hot.nem.reconfig_k",
+    "hot.nem.spike_k", "hot.nem.reconfig_k", "hot.nem.disk_k",
 })
 # the schedule key root: ConstState.key0 on the plain partition, carried
 # as hot.key0 on the refill partition (a refilled lane adopts a new root)
@@ -120,6 +120,7 @@ TIME_LEAF_NAMES = frozenset({
     "hot.clock", "hot.timer", "hot.chaos_at", "hot.part_at",
     "hot.msgs.deliver", "hot.strag.deliver",
     "hot.nem.clog_at", "hot.nem.spike_at", "hot.nem.reconfig_at",
+    "hot.nem.disk_at",
     "cold.violation_at", "const.ctl.h_off",
 })
 
@@ -142,6 +143,7 @@ def full_fault_plan():
             nem.Reorder(rate=0.1, window_us=50_000),
             nem.ClockSkew(max_ppm=50_000),
             nem.Reconfig(),
+            nem.DiskFault(torn_rate=0.5),
         ),
     )
 
@@ -154,6 +156,7 @@ def spec_factories() -> Dict[str, object]:
     from ..tpu.paxos import make_paxos_spec
     from ..tpu.raft import make_raft_spec
     from ..tpu.twopc import make_twopc_spec
+    from ..tpu.wal import make_wal_spec
 
     return {
         "raft": make_raft_spec,
@@ -163,6 +166,9 @@ def spec_factories() -> Dict[str, object]:
         "chain": make_chain_spec,
         "isr": make_isr_spec,
         "lease": make_lease_spec,
+        # the one spec with a durable plane: its hot.dur.* watermark
+        # leaves and recovery copy-back are range-certified here
+        "wal": make_wal_spec,
     }
 
 

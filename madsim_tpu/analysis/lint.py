@@ -422,6 +422,7 @@ def check_mirror(
     host_coin_methods: Optional[Dict[str, Tuple[str, ...]]] = None,
     net_source: Optional[str] = None,
     oracle_source: Optional[str] = None,
+    fs_source: Optional[str] = None,
 ) -> RuleResult:
     """Every clause exists on all four faces: the pure schedule, the
     device tensor program, the host driver, and the oracle comparator's
@@ -684,12 +685,47 @@ def check_mirror(
                     "falls back to the ambient rng, unverifiable by the "
                     "oracle",
                 )
-    stray = sorted(set(coin_methods) - set(message_clauses))
+    # schedule clauses may ALSO register host draws (DiskFault's torn
+    # extent: the one value only the host stream contains, applied by
+    # FsSim at a torn power failure). Their apply path is the driver +
+    # fs layer, not net/ — a registered method no driver arm ever passes
+    # to the filesystem means every scheduled torn crash silently
+    # un-tears on the host face.
+    fs_src = fs_source
+    if fs_src is None:
+        fs_src, _ = _read(os.path.join(root, "madsim_tpu", "fs.py"))
+    driver_attrs = {
+        node.attr
+        for src in (driver_src, fs_src)
+        for node in ast.walk(ast.parse(src))
+        if isinstance(node, ast.Attribute)
+    }
+    res.checked += 1
+    for name in sorted(set(coin_methods) & set(schedule_clauses)):
+        for m in coin_methods[name]:
+            if not callable(getattr(nem.ScheduleCoins, m, None)):
+                res.add(
+                    "ScheduleCoins",
+                    f"registered draw method {m!r} (schedule clause "
+                    f"{name!r}) does not exist on ScheduleCoins",
+                )
+            if m not in driver_attrs:
+                res.add(
+                    "NemesisDriver/fs",
+                    f"ScheduleCoins.{m} (schedule clause {name!r}) is never "
+                    "referenced from the host driver's apply path "
+                    "(madsim_tpu/nemesis.py) or accepted by the fs layer — "
+                    "the host face drops the draw, so e.g. a scheduled torn "
+                    "crash silently un-tears on the host",
+                )
+    stray = sorted(
+        set(coin_methods) - set(message_clauses) - set(schedule_clauses)
+    )
     if stray:
         res.add(
             "HOST_COIN_METHODS",
-            f"entries {stray} name no MESSAGE_CLAUSES clause — the "
-            "comparator would verify draws no clause produces",
+            f"entries {stray} name no MESSAGE_CLAUSES or SCHEDULE_CLAUSES "
+            "clause — the comparator would verify draws no clause produces",
         )
     res.checked += 1
     orc_src = oracle_source

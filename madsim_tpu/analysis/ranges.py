@@ -1154,9 +1154,18 @@ def step_seeds(
             seeds[name] = Iv(lo, hi, may_inf)
             continue
         leaf_field = None
+        ini_key = name.replace("hot.", "", 1)
         if name.startswith("hot.node."):
             leaf_field = name[len("hot.node."):]
-        ini = init_ivs.get(name.replace("hot.", "", 1), None)
+        elif name.startswith("hot.dur."):
+            # durability watermark: every dur leaf is a SNAPSHOT of its
+            # node twin (advance/reset copy node -> dur, disk recovery
+            # copies dur -> node), so it carries the node field's
+            # spec-declared interval — seeding it wider would let the
+            # recovery copy-back break the node leaf's own certificate
+            leaf_field = name[len("hot.dur."):]
+            ini_key = f"node.{leaf_field}"
+        ini = init_ivs.get(ini_key, None)
         if leaf_field in kinds:
             k = kinds[leaf_field]
             dt_hi = dtype_range(sim.spec.narrow_fields[leaf_field]).hi

@@ -131,6 +131,11 @@ NEM_SITE_SKEW = 241          # per-node skew ppm; index = node
 NEM_SITE_RECONF_IV = 251     # stable interval before remove event k
 NEM_SITE_RECONF_DUR = 252    # out-of-membership duration of reconfig k
 NEM_SITE_RECONF_VICTIM = 253 # removed node of reconfig event k
+NEM_SITE_DISK_IV = 261       # healthy interval before disk episode k
+NEM_SITE_DISK_SLOW = 262     # degraded (slow-disk) window length of episode k
+NEM_SITE_DISK_DOWN = 263     # post-crash down duration of episode k
+NEM_SITE_DISK_VICTIM = 264   # victim node of disk episode k
+NEM_SITE_DISK_TORN = 265     # torn-tail coin of disk episode k
 
 # per-message coin sites. The engine draws them on its per-step net_key
 # stream; the host draws them on the per-seed base key via ScheduleCoins
@@ -140,6 +145,11 @@ NET_SITE_DUP = 5
 NET_SITE_REORDER = 6
 NET_SITE_REORDER_EXTRA = 7
 NET_SITE_NEM_LOSS = 8
+# host-only schedule-matched draw: how many unsynced tail bytes a TORN
+# disk crash retains (the device abstracts the extent behind the torn
+# flag; the host FsSim consumes the byte count, and the oracle verifies
+# the draw like any other ScheduleCoins value)
+NET_SITE_DISK_EXTENT = 9
 
 # --------------------------------------------------------------------------
 # fire-count vocabulary (engine fires tensor + host registries use indices)
@@ -148,6 +158,7 @@ NET_SITE_NEM_LOSS = 8
 FIRE_KINDS: Tuple[str, ...] = (
     "crash", "restart", "wipe", "partition", "heal", "clog", "spike",
     "loss", "dup", "reorder", "skew", "remove", "join",
+    "disk_slow", "disk_crash", "disk_recover",
 )
 FIRE_INDEX: Dict[str, int] = {k: i for i, k in enumerate(FIRE_KINDS)}
 
@@ -164,11 +175,13 @@ FIRE_INDEX: Dict[str, int] = {k: i for i, k in enumerate(FIRE_KINDS)}
 
 TRIAGE_CLAUSES: Tuple[str, ...] = (
     "crash", "partition", "clog", "spike", "skew", "loss", "dup",
-    "reorder", "wipe", "reconfig",
+    "reorder", "wipe", "reconfig", "disk",
 )
 TRIAGE_BIT: Dict[str, int] = {n: 1 << i for i, n in enumerate(TRIAGE_CLAUSES)}
 # schedule clauses with occurrence counters (rows of TriageCtl.occ)
-OCC_CLAUSES: Tuple[str, ...] = ("crash", "partition", "clog", "spike", "reconfig")
+OCC_CLAUSES: Tuple[str, ...] = (
+    "crash", "partition", "clog", "spike", "reconfig", "disk",
+)
 OCC_ROW: Dict[str, int] = {n: i for i, n in enumerate(OCC_CLAUSES)}
 # message-level clauses with per-lane rate scaling (rows of
 # TriageCtl.rate_scale)
@@ -183,6 +196,7 @@ CLAUSE_OF_EVENT: Dict[str, str] = {
     "spike_on": "spike", "spike_off": "spike",
     "skew": "skew",
     "remove": "reconfig", "join": "reconfig",
+    "disk_slow": "disk", "disk_crash": "disk", "disk_recover": "disk",
 }
 
 
@@ -295,11 +309,40 @@ class Reconfig:
     down_hi_us: int = 3_000_000
 
 
+@dataclasses.dataclass(frozen=True)
+class DiskFault:
+    """Durability chaos: slow-then-dying disks with fsync loss (r18).
+
+    Occurrence k is a THREE-phase episode, every draw a pure function of
+    (seed, k): after `interval` a victim's disk turns SLOW (`disk_slow` —
+    host writes pay `extra_us` each and fsync raises EIO; the degraded
+    window real storage failures almost always open with), after `slow`
+    the disk DIES (`disk_crash` — the node goes down and every write
+    since its last fsync is GONE: recovery rolls back to the per-node
+    durable watermark, not live state, unlike the crash clause's
+    full-state `on_restart` and the wipe's bare `init`), and after
+    `down` the node RECOVERS (`disk_recover` — rebuilt from the
+    watermark through `spec.on_recover`). `torn_rate` upgrades a
+    fraction of the crashes to TORN: the host keeps a schedule-drawn
+    prefix of the last unsynced write (the partial-sector class ALICE
+    calls torn writes); the device surfaces the same coin as the
+    `torn` flag `on_recover` receives."""
+
+    interval_lo_us: int = 1_000_000
+    interval_hi_us: int = 5_000_000
+    slow_lo_us: int = 100_000
+    slow_hi_us: int = 500_000
+    down_lo_us: int = 500_000
+    down_hi_us: int = 3_000_000
+    torn_rate: float = 0.0
+    extra_us: int = 50_000
+
+
 Clause = Any  # one of the dataclasses above
 
 _CLAUSE_TYPES: Tuple[type, ...] = (
     Crash, Partition, LinkClog, LatencySpike, MsgLoss, Duplicate, Reorder,
-    ClockSkew, Reconfig,
+    ClockSkew, Reconfig, DiskFault,
 )
 
 # --------------------------------------------------------------------------
@@ -320,7 +363,7 @@ _CLAUSE_TYPES: Tuple[type, ...] = (
 # `nem_<name>_*` knob prefixes).
 SCHEDULE_CLAUSES: Dict[str, type] = {
     "crash": Crash, "partition": Partition, "clog": LinkClog,
-    "spike": LatencySpike, "reconfig": Reconfig,
+    "spike": LatencySpike, "reconfig": Reconfig, "disk": DiskFault,
 }
 # message-level clauses: per-message coins. Streams are per-backend but
 # every host draw VALUE is schedule-matched (pure in (seed, site, index)
@@ -338,6 +381,12 @@ HOST_COIN_METHODS: Dict[str, Tuple[str, ...]] = {
     "loss": ("loss",),
     "dup": ("dup",),
     "reorder": ("reorder", "reorder_extra"),
+    # schedule clause with a HOST-consumed draw: the torn-tail byte
+    # extent FsSim applies at a torn disk_crash (the device abstracts
+    # the extent behind the schedule's torn coin, so this is the one
+    # draw only the host stream contains — still seed-pure, still
+    # oracle-verified)
+    "disk": ("disk_torn_extent",),
 }
 # ScheduleCoins method -> murmur3 draw site (shared with tpu/engine.py)
 COIN_SITE: Dict[str, int] = {
@@ -345,6 +394,7 @@ COIN_SITE: Dict[str, int] = {
     "dup": NET_SITE_DUP,
     "reorder": NET_SITE_REORDER,
     "reorder_extra": NET_SITE_REORDER_EXTRA,
+    "disk_torn_extent": NET_SITE_DISK_EXTENT,
 }
 # assignment clauses: applied once at t=0 per (seed, node), no windows
 ASSIGN_CLAUSES: Dict[str, type] = {"skew": ClockSkew}
@@ -357,6 +407,7 @@ CLAUSE_EVENT_KINDS: Dict[str, Tuple[str, ...]] = {
     "spike": ("spike_on", "spike_off"),
     "skew": ("skew",),
     "reconfig": ("remove", "join"),
+    "disk": ("disk_slow", "disk_crash", "disk_recover"),
 }
 # clause -> FIRE_KINDS rows it can increment
 CLAUSE_FIRE_KINDS: Dict[str, Tuple[str, ...]] = {
@@ -369,6 +420,7 @@ CLAUSE_FIRE_KINDS: Dict[str, Tuple[str, ...]] = {
     "reorder": ("reorder",),
     "skew": ("skew",),
     "reconfig": ("remove", "join"),
+    "disk": ("disk_slow", "disk_crash", "disk_recover"),
 }
 
 
@@ -429,6 +481,13 @@ class FaultPlan:
             elif isinstance(c, Reconfig):
                 _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
                 _check_interval(f"{n}.down", c.down_lo_us, c.down_hi_us)
+            elif isinstance(c, DiskFault):
+                _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
+                _check_interval(f"{n}.slow", c.slow_lo_us, c.slow_hi_us)
+                _check_interval(f"{n}.down", c.down_lo_us, c.down_hi_us)
+                _check_rate(f"{n}.torn_rate", c.torn_rate)
+                if c.extra_us < 0:
+                    raise ValueError(f"{n}.extra_us must be >= 0, got {c.extra_us}")
             elif isinstance(c, LatencySpike):
                 _check_interval(f"{n}.interval", c.interval_lo_us, c.interval_hi_us)
                 _check_interval(f"{n}.duration", c.duration_lo_us, c.duration_hi_us)
@@ -481,6 +540,8 @@ class FaultPlan:
             kinds.append("skew")
         if self.get(Reconfig) is not None:
             kinds += ["remove", "join"]
+        if self.get(DiskFault) is not None:
+            kinds += ["disk_slow", "disk_crash", "disk_recover"]
         return tuple(kinds)
 
     # -- the pure schedule (what both backends must execute) --
@@ -531,8 +592,9 @@ class NemesisEvent:
     side_mask: int = 0  # split: bitmask of nodes on side A
     wipe: bool = False  # crash/restart: state-wipe variant
     ppm: int = 0  # skew
-    extra_us: int = 0  # spike_on
+    extra_us: int = 0  # spike_on / disk_slow per-write latency
     k: int = -1  # clause occurrence index (the ddmin atom id; -1 = n/a)
+    torn: bool = False  # disk_crash/disk_recover: torn-tail variant
 
     def __str__(self) -> str:
         t = self.t_us / 1e6
@@ -541,6 +603,14 @@ class NemesisEvent:
             return f"[{t:9.6f}s] {self.kind} node{self.node}{w}"
         if self.kind in ("remove", "join"):
             return f"[{t:9.6f}s] {self.kind} node{self.node} (reconfig k={self.k})"
+        if self.kind == "disk_slow":
+            return (
+                f"[{t:9.6f}s] disk_slow node{self.node} "
+                f"+{self.extra_us}us/write (disk k={self.k})"
+            )
+        if self.kind in ("disk_crash", "disk_recover"):
+            w = " (torn)" if self.torn else ""
+            return f"[{t:9.6f}s] {self.kind} node{self.node}{w} (disk k={self.k})"
         if self.kind == "split":
             return f"[{t:9.6f}s] split side_mask={self.side_mask:#x}"
         if self.kind in ("clog", "unclog"):
@@ -649,6 +719,37 @@ def plan_schedule(
             events.append(NemesisEvent(t, "join", node=victim, k=k))
             k += 1
 
+    disk = plan.get(DiskFault)
+    if disk is not None:
+        t, k = 0, 0
+        while len(events) < max_events:
+            t += randint32(key, NEM_SITE_DISK_IV, disk.interval_lo_us,
+                           disk.interval_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            victim = randint32(key, NEM_SITE_DISK_VICTIM, 0, n_nodes, index=k)
+            torn = disk.torn_rate > 0 and coin32(
+                key, NEM_SITE_DISK_TORN, disk.torn_rate, index=k
+            )
+            events.append(NemesisEvent(
+                t, "disk_slow", node=victim, extra_us=disk.extra_us, k=k
+            ))
+            t += randint32(key, NEM_SITE_DISK_SLOW, disk.slow_lo_us,
+                           disk.slow_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(
+                NemesisEvent(t, "disk_crash", node=victim, torn=torn, k=k)
+            )
+            t += randint32(key, NEM_SITE_DISK_DOWN, disk.down_lo_us,
+                           disk.down_hi_us, index=k)
+            if t >= horizon_us:
+                break
+            events.append(
+                NemesisEvent(t, "disk_recover", node=victim, torn=torn, k=k)
+            )
+            k += 1
+
     spike = plan.get(LatencySpike)
     if spike is not None:
         t, k = 0, 0
@@ -747,6 +848,10 @@ class ScheduleCoins:
         )
         self._index: Dict[int, int] = {}
         self.draws: List[Tuple[int, int, int, int, int]] = []
+        # (site, index) -> draw modulus, for draws whose span is HOST
+        # state rather than clause config (disk_torn_extent's unsynced
+        # tail length): the oracle needs the span to recompute the value
+        self.spans: Dict[Tuple[int, int], int] = {}
         self.dropped = 0
         self._time = None
         self._lineage = None
@@ -806,6 +911,20 @@ class ScheduleCoins:
             span += 1
         v = randint32(self.key, NET_SITE_REORDER_EXTRA, 0, span, index=idx)
         self._log(NET_SITE_REORDER_EXTRA, idx, v)
+        return v
+
+    def disk_torn_extent(self, unsynced_len: int) -> int:
+        """Torn-tail retained bytes in [0, unsynced_len) (NET_SITE_DISK_EXTENT).
+
+        Consumed by `FsSim.power_fail_node` at a torn `disk_crash`: the
+        crash keeps this many bytes of the victim's last unsynced write
+        on top of the synced snapshot — a PROPER prefix, because a torn
+        write that survived whole would have been a completed one."""
+        idx = self._next_index(NET_SITE_DISK_EXTENT)
+        span = max(int(unsynced_len), 1)
+        v = randint32(self.key, NET_SITE_DISK_EXTENT, 0, span, index=idx)
+        self.spans[(NET_SITE_DISK_EXTENT, idx)] = span
+        self._log(NET_SITE_DISK_EXTENT, idx, v)
         return v
 
 
@@ -901,6 +1020,11 @@ class NemesisDriver:
 
         return self.handle.simulators.get(NetSim)
 
+    def _fssim(self):
+        from .fs import FsSim
+
+        return self.handle.simulators.get(FsSim)
+
     def install(self) -> None:
         """Apply message-level knobs + clock skew, spawn the schedule task."""
         if self._installed:
@@ -949,7 +1073,9 @@ class NemesisDriver:
 
     def _apply(self, ev: NemesisEvent) -> None:
         net = self._netsim()
-        if ev.kind in ("crash", "split", "clog", "spike_on", "remove") and ev.k >= 0:
+        if ev.kind in (
+            "crash", "split", "clog", "spike_on", "remove", "disk_slow"
+        ) and ev.k >= 0:
             clause = CLAUSE_OF_EVENT[ev.kind]
             self.occ_fired[clause] = self.occ_fired.get(clause, 0) | (
                 1 << min(ev.k, 31)
@@ -1027,6 +1153,45 @@ class NemesisDriver:
                 self.on_wipe(ev.node)
             self.handle.restart(self.node_ids[ev.node])
             self._count("join")
+        elif ev.kind == "disk_slow":
+            # the victim's disk degrades: every write pays extra latency
+            # and fsync raises EIO until the disk dies at disk_crash —
+            # the FsSim fault hooks the device face mirrors as a pure
+            # fire/trace marker (no device state effect: the loss
+            # semantics land at the crash)
+            fs = self._fssim()
+            if fs is not None:
+                fs.set_disk_fault(
+                    self.node_ids[ev.node], extra_ns=ev.extra_us * 1_000
+                )
+            self._count("disk_slow")
+        elif ev.kind == "disk_crash":
+            # the disk dies: the node goes down and every unsynced byte
+            # is dropped back to the synced snapshot (FsSim.power_fail
+            # semantics) — except a TORN crash, which keeps a
+            # schedule-drawn PREFIX of the last unsynced write
+            # (coins.disk_torn_extent: the one host-only draw of the
+            # clause, verified by the differential oracle)
+            if self.on_crash is not None:
+                self.on_crash(ev.node)
+            self.handle.kill(self.node_ids[ev.node])
+            fs = self._fssim()
+            if fs is not None:
+                fs.clear_disk_fault(self.node_ids[ev.node])
+                fs.power_fail_node(
+                    self.node_ids[ev.node],
+                    torn_extent=(
+                        self.coins.disk_torn_extent if ev.torn else None
+                    ),
+                )
+            self._count("disk_crash")
+        elif ev.kind == "disk_recover":
+            # recovery from the durable watermark: the host node's init
+            # closure re-reads whatever FsSim retained (synced prefix,
+            # plus the torn tail if any) — on_wipe is NOT called, synced
+            # durability survives a disk death by definition
+            self.handle.restart(self.node_ids[ev.node])
+            self._count("disk_recover")
         self.applied.append(ev)
 
     def _crosses_open_split(self, a_idx: int, b_idx: int) -> bool:

@@ -281,6 +281,8 @@ def compare(
             if clause is not None:
                 rate_of[cname] = clause.rate
         reorder = plan.get(nem.Reorder)
+        disk = plan.get(nem.DiskFault)
+        coin_spans = dict(getattr(coins, "spans", None) or {})
         for site, index, value, t_ns, eid in coins.draws:
             name = site_name.get(site)
             cname = clause_of_method.get(name or "")
@@ -292,6 +294,19 @@ def compare(
                     # float window_us -> ns, rounded, floor 1
                     span = max(round(reorder.window_us / 1e6 * 1e9), 1)
                     expect = nem.randint32(key, site, 0, span, index=index)
+            elif name == "disk_torn_extent":
+                # the span is host state (the victim's unsynced tail
+                # length), logged by ScheduleCoins alongside the draw;
+                # given the span the value is pure in (seed, site, index)
+                if disk is None or disk.torn_rate <= 0:
+                    expect = None
+                else:
+                    span = coin_spans.get((site, index))
+                    if span is None:
+                        continue  # pre-span artifact: value unverifiable
+                    expect = nem.randint32(
+                        key, site, 0, max(int(span), 1), index=index
+                    )
             elif cname in rate_of:
                 expect = int(
                     nem.coin32(key, site, rate_of[cname], index=index)

@@ -42,4 +42,5 @@ from .isr import IsrState, isr_workload, make_isr_spec  # noqa: F401
 from .lease import LeaseState, lease_workload, make_lease_spec  # noqa: F401
 from .paxos import PaxosState, make_paxos_spec, paxos_workload  # noqa: F401
 from .twopc import TpcState, make_twopc_spec, twopc_workload  # noqa: F401
+from .wal import WalState, make_wal_spec, wal_workload  # noqa: F401
 from .trace import TraceEvent, extract_trace, format_trace, trace_seed  # noqa: F401

@@ -98,6 +98,11 @@ from ..nemesis import (
     NEM_SITE_CRASH_IV,
     NEM_SITE_CRASH_VICTIM,
     NEM_SITE_CRASH_WIPE,
+    NEM_SITE_DISK_DOWN,
+    NEM_SITE_DISK_IV,
+    NEM_SITE_DISK_SLOW,
+    NEM_SITE_DISK_TORN,
+    NEM_SITE_DISK_VICTIM,
     NEM_SITE_PART_HEAL,
     NEM_SITE_PART_IV,
     NEM_SITE_PART_SIDE,
@@ -280,6 +285,15 @@ class NemesisState(NamedTuple):
     reconf_node: Any  # i32 [L] node currently OUT of the membership (-1 =
     #           all in; the next reconfig event is a REMOVE, else a JOIN)
     reconfig_k: Any  # i32 [L] remove/join cycle counter
+    disk_at: Any  # i32 [L] next disk-fault phase toggle (INF_US disabled)
+    disk_phase: Any  # i32 [L] DiskFault 3-phase cursor: 0 = healthy (next
+    #           event disk_slow), 1 = degraded window open (next event
+    #           disk_crash), 2 = down (next event disk_recover). The
+    #           victim and torn bit are NOT carried: both are pure draws
+    #           at (key0, site, disk_k), recomputed identically at every
+    #           phase of occurrence k — the schedule-purity discipline
+    #           applied to the carry itself
+    disk_k: Any  # i32 [L] disk-fault occurrence counter (bumps at recover)
     skew_ppm: Any  # i32 [L,N] per-node timer rate skew in ppm (0 = none)
     #           | None. Integer ppm, not an f32 rate: the r8 precision fix
     #           — f32 multiply loses integer microseconds above 2^24 us
@@ -375,6 +389,7 @@ class RefillLog(NamedTuple):
     overflow: Any  # i32 [A]
     dead_drops: Any  # i32 [A]
     nonmember_drops: Any  # i32 [A]
+    unsynced_loss: Any  # i32 [A]
     clock: Any  # i32 [A] final clock offset at retirement
     epoch: Any  # i32 [A]
     fires: Any  # i32 [A, len(FIRE_KINDS)]
@@ -445,6 +460,12 @@ class TraceRecord(NamedTuple):
     spike_off: Any  # bool [L]
     remove: Any  # i32 [L] node removed from membership this step, -1 = none
     join: Any  # i32 [L] node (re)joined this step (fresh-init), -1 = none
+    disk_slow: Any  # i32 [L] disk degraded-window opened on node, -1 = none
+    disk_crash: Any  # i32 [L] disk died on node (unsynced loss), -1 = none
+    disk_recover: Any  # i32 [L] node recovered from watermark, -1 = none
+    disk_torn: Any  # bool [L] the occurrence's torn-write coin (marked on
+    #           the crash and recover halves; the torn tail itself is a
+    #           host-face FsSim effect and a device-face on_recover input)
     # -- lineage plane (BatchedSim(lineage=True) only, else None): the
     # device edge ring. Each step's events carry their global event id
     # and, for deliveries, the RECONSTRUCTED full send eid — so a traced
@@ -500,6 +521,14 @@ class SimState(NamedTuple):
     #            cluster MEMBER — removed by the reconfig clause. Checked
     #            before liveness, so the classes are disjoint: a crashed
     #            member counts in dead_drops, a removed node here)
+    unsynced_loss: Any  # i32 [L] disk crashes that lost unsynced durable
+    #            state: the victim's durable fields differed from its
+    #            watermark at the crash instant (every disk crash counts
+    #            when the spec declares no durable_fields — the whole
+    #            state is then unsynced by definition). Always present,
+    #            like nonmember_drops: a zero column when the DiskFault
+    #            clause is off costs nothing and spares every consumer
+    #            an Optional branch
     fires: Any  # i32 [L, len(FIRE_KINDS)] per-fault-kind chaos fire counts
     occ_fired: Any  # u32 [L, len(OCC_CLAUSES)] | None — bit k set when
     #            occurrence k of the schedule clause APPLIED in this lane
@@ -527,6 +556,17 @@ class SimState(NamedTuple):
     node: Any  # protocol pytree, leaves [L,N,...] (fields named in
     #           spec.narrow_fields are stored at their narrow dtypes and
     #           widened to i32 before every handler call)
+    dur: Any  # durable WATERMARK | None — the DiskFault clause's
+    #           durability plane (None unless nem_disk is enabled AND the
+    #           spec declares durable_fields). A namedtuple over
+    #           spec.durable_fields with leaves [L,N,...] at the same
+    #           at-rest (narrowed) dtypes as the node carry: the last
+    #           value of each durable field the node made it to disk.
+    #           Initialized from spec.init (boot is fsynced), re-snapshot
+    #           whenever spec.sync_field increases (the spec's declared
+    #           fsync points), reset to the node's fresh state on
+    #           wipe / join / disk-recover. A disk crash recovery
+    #           rebuilds the victim FROM this plane, not from live state
     msgs: MsgPool
     strag: Any  # StragPool | None (None unless buggify_delay_rate > 0)
     nem: Any  # NemesisState | None (None unless a nemesis clause is on)
@@ -571,6 +611,7 @@ class ColdState(NamedTuple):
     overflow: Any
     dead_drops: Any
     nonmember_drops: Any
+    unsynced_loss: Any
     fires: Any
     occ_fired: Any
     cov: Any
@@ -749,6 +790,9 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
         "hot.nem.reconfig_at": toff,
         "hot.nem.reconf_node": (-1, N - 1, False),
         "hot.nem.reconfig_k": (0, ctr_hi, False),
+        "hot.nem.disk_at": toff,
+        "hot.nem.disk_phase": (0, 2, False),
+        "hot.nem.disk_k": (0, ctr_hi, False),
         "hot.member_p": u32,
         "hot.member_epoch": (0, ctr_hi, False),
         "cold.violation_at": toff,
@@ -760,6 +804,7 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
         "cold.overflow": (0, ctr_hi, False),
         "cold.dead_drops": (0, ctr_hi, False),
         "cold.nonmember_drops": (0, ctr_hi, False),
+        "cold.unsynced_loss": (0, ctr_hi, False),
         "cold.fires": (0, ctr_hi, False),
         "cold.occ_fired": u32,
         "cold.cov.bitmap": u32,
@@ -795,6 +840,11 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
     # time tensor
     for f in sim.spec.time_fields:
         hints[f"hot.node.{f}"] = toff
+    # the durability watermark mirrors node fields value-for-value: every
+    # dur leaf is a SNAPSHOT of its node leaf (advance/reset both copy),
+    # so it inherits the node field's interval — the certifier seeds
+    # hot.dur.* from the same spec declarations as hot.node.* and these
+    # engine-owned hints only exist for fields the engine itself bounds
     if refill:
         # the refill carry partition: key0/ctl/skew ride in hot (a
         # refilled lane rewrites them), only the queue is const
@@ -828,6 +878,7 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
             "cold.refill.overflow": ctr,
             "cold.refill.dead_drops": ctr,
             "cold.refill.nonmember_drops": ctr,
+            "cold.refill.unsynced_loss": ctr,
             "cold.refill.clock": (0, off_hi, True),
             "cold.refill.epoch": (0, ep_hi, False),
             "cold.refill.fires": ctr,
@@ -1023,7 +1074,7 @@ class BatchedSim:
         # machinery (the two time sources would fight over chaos_at)
         for name in (
             "nem_loss_rate", "nem_dup_rate", "nem_reorder_rate",
-            "nem_crash_wipe_rate",
+            "nem_crash_wipe_rate", "nem_disk_torn_rate",
         ):
             v = getattr(cfg, name)
             if not (0.0 <= v < 1.0):
@@ -1044,6 +1095,7 @@ class BatchedSim:
             ("nem_clog", (("interval", True), ("heal", False))),
             ("nem_spike", (("interval", True), ("duration", False))),
             ("nem_reconfig", (("interval", True), ("down", False))),
+            ("nem_disk", (("interval", True), ("slow", False), ("down", False))),
         ):
             if getattr(cfg, f"{prefix}_interval_hi_us") <= 0:
                 continue  # clause disabled
@@ -1188,6 +1240,7 @@ class BatchedSim:
             cfg.nem_crash_enabled or cfg.nem_partition_enabled
             or cfg.nem_clog_enabled or cfg.nem_spike_enabled
             or cfg.nem_skew_enabled or cfg.nem_reconfig_enabled
+            or cfg.nem_disk_enabled
         )
         # occurrence-fire tracking exists iff a nemesis SCHEDULE clause is
         # on (legacy trajectory-coupled chaos has no occurrence index):
@@ -1195,8 +1248,50 @@ class BatchedSim:
         self._occ_track = (
             cfg.nem_crash_enabled or cfg.nem_partition_enabled
             or cfg.nem_clog_enabled or cfg.nem_spike_enabled
-            or cfg.nem_reconfig_enabled
+            or cfg.nem_reconfig_enabled or cfg.nem_disk_enabled
         )
+        # durability plane (DiskFault clause, docs/nemesis.md r18): carried
+        # iff the clause can fire AND the spec declares what is durable —
+        # a disk-faulted spec without durable_fields recovers like a wipe
+        # (nothing survives), and a durable contract without the clause
+        # costs nothing
+        if spec.on_recover is not None and not spec.durable_fields:
+            raise ValueError(
+                "spec.on_recover requires spec.durable_fields — the hook "
+                "receives the durable watermark, and without declared "
+                "durable fields there is nothing durable to recover from"
+            )
+        if spec.durable_fields and spec.sync_field is None:
+            raise ValueError(
+                "spec.durable_fields requires spec.sync_field — the i32 "
+                "node-state counter the spec's handlers bump at their "
+                "fsync points; without it the watermark could never "
+                "advance past boot"
+            )
+        if spec.durable_fields and spec.sync_field in spec.durable_fields:
+            raise ValueError(
+                "spec.sync_field must not itself be durable: the watermark "
+                "advance compares its live value against the PREVIOUS "
+                "step's, not against the snapshot"
+            )
+        bad_dur = set(spec.durable_fields) & set(spec.time_fields)
+        if bad_dur:
+            raise ValueError(
+                "durable_fields cannot include time_fields (the watermark "
+                "snapshot is not epoch-rebased; an absolute time in it "
+                f"would go stale): remove {sorted(bad_dur)}"
+            )
+        self._dur_state = cfg.nem_disk_enabled and bool(spec.durable_fields)
+        if spec.durable_fields:
+            import collections
+
+            # a stable namedtuple type (created once per sim) so the dur
+            # pytree structure is identical across every jitted call
+            self._DurTuple = collections.namedtuple(
+                "DurState", spec.durable_fields
+            )
+        else:
+            self._DurTuple = None
         # scalar-style handlers -> [L,N] batched. `now` is per-(lane,node):
         # under the lookahead window, nodes in one step process events at
         # different virtual times.
@@ -1218,6 +1313,16 @@ class BatchedSim:
         self._v_on_restart = jax.vmap(
             jax.vmap(spec.on_restart, in_axes=(0, 0, None, 0)), in_axes=(0, 0, 0, 0)
         )
+        if spec.on_recover is not None:
+            # on_recover(durable_state, node_id, now_us, torn, key):
+            # now_us and the torn bit are per-LANE (the disk clause's
+            # crash instant and schedule coin), everything else per-node
+            self._v_on_recover = jax.vmap(
+                jax.vmap(spec.on_recover, in_axes=(0, 0, None, None, 0)),
+                in_axes=(0, 0, 0, 0, 0),
+            )
+        else:
+            self._v_on_recover = None
         self._v_check = jax.vmap(spec.check_invariants, in_axes=(0, 0, 0))
         self.step = jax.jit(self._step)
         # jitted: eager init measured ~1.4 s PER SWEEP at 32k lanes over
@@ -1284,6 +1389,42 @@ class BatchedSim:
                     "narrower than i32"
                 )
 
+    # ----------------------------------------------- durability watermark
+    # spec.durable_fields: the DiskFault clause's at-rest plane. The
+    # watermark stores each durable field at the SAME narrowed dtype as
+    # the node carry (it is a snapshot of those exact leaves), and widens
+    # back to i32 only at recovery — symmetric with _narrow_node.
+
+    def _check_durable(self, node) -> None:
+        for f in self.spec.durable_fields:
+            if not hasattr(node, f):
+                raise ValueError(
+                    f"durable_fields names unknown node-state field {f!r}"
+                )
+        sf = self.spec.sync_field
+        if sf is not None and not hasattr(node, sf):
+            raise ValueError(
+                f"sync_field names unknown node-state field {sf!r}"
+            )
+
+    def _dur_of(self, node):
+        """Snapshot the durable fields of a WIDE node pytree, narrowed to
+        their at-rest dtypes (the watermark's storage form)."""
+        return self._DurTuple(**{
+            f: (
+                getattr(node, f).astype(self._narrow[f])
+                if f in self._narrow else getattr(node, f)
+            )
+            for f in self.spec.durable_fields
+        })
+
+    def _widen_dur(self, dur):
+        return dur._replace(**{
+            f: getattr(dur, f).astype(jnp.int32)
+            for f in self.spec.durable_fields
+            if f in self._narrow
+        })
+
     # ------------------------------------------------------------------ init
 
     def _init(self, seeds: jnp.ndarray, ctl=None) -> SimState:
@@ -1306,6 +1447,8 @@ class BatchedSim:
         node_state, timer = self._v_init(node_keys, jnp.arange(N, dtype=jnp.int32))
         timer = jnp.asarray(timer, jnp.int32)
         self._check_narrow(node_state)
+        if self.spec.durable_fields:
+            self._check_durable(node_state)
 
         # per-node clock skew (nemesis): timer rate drawn once per
         # (seed, node) — the same formula FaultPlan.skew_ppm mirrors.
@@ -1393,6 +1536,16 @@ class BatchedSim:
                 ),
                 reconf_node=jnp.full((L,), -1, jnp.int32),
                 reconfig_k=zi,
+                disk_at=(
+                    prng.randint(
+                        key, NEM_SITE_DISK_IV, cfg.nem_disk_interval_lo_us,
+                        cfg.nem_disk_interval_hi_us, index=0,
+                    )
+                    if cfg.nem_disk_enabled
+                    else jnp.full((L,), INF_US, jnp.int32)
+                ),
+                disk_phase=zi,
+                disk_k=zi,
                 skew_ppm=skew_ppm,
             )
         else:
@@ -1429,6 +1582,7 @@ class BatchedSim:
             overflow=jnp.zeros((L,), jnp.int32),
             dead_drops=jnp.zeros((L,), jnp.int32),
             nonmember_drops=jnp.zeros((L,), jnp.int32),
+            unsynced_loss=jnp.zeros((L,), jnp.int32),
             fires=fires,
             occ_fired=(
                 jnp.zeros((L, len(OCC_CLAUSES)), jnp.uint32)
@@ -1450,6 +1604,8 @@ class BatchedSim:
             part_at=part_at,
             timer=timer,
             node=self._narrow_node(node_state),
+            # boot is fsynced: the watermark starts as the init snapshot
+            dur=self._dur_of(node_state) if self._dur_state else None,
             msgs=MsgPool(
                 valid_p=jnp.zeros(
                     (L, N, bitpack.packed_words(CK)), jnp.uint32
@@ -1548,6 +1704,8 @@ class BatchedSim:
             t_next = jnp.minimum(t_next, state.nem.spike_at)
         if cfg.nem_reconfig_enabled:
             t_next = jnp.minimum(t_next, state.nem.reconfig_at)
+        if cfg.nem_disk_enabled:
+            t_next = jnp.minimum(t_next, state.nem.disk_at)
 
         deadlocked = (~state.done) & (t_next >= INF_US)
         active = (~state.done) & (t_next < INF_US)
@@ -1567,7 +1725,7 @@ class BatchedSim:
         if lo_w and (
             cfg.any_crash_enabled or cfg.any_partition_enabled
             or cfg.nem_clog_enabled or cfg.nem_spike_enabled
-            or cfg.nem_reconfig_enabled
+            or cfg.nem_reconfig_enabled or cfg.nem_disk_enabled
         ):
             next_chaos = jnp.minimum(state.chaos_at, state.part_at)
             if cfg.nem_clog_enabled:
@@ -1576,6 +1734,8 @@ class BatchedSim:
                 next_chaos = jnp.minimum(next_chaos, state.nem.spike_at)
             if cfg.nem_reconfig_enabled:
                 next_chaos = jnp.minimum(next_chaos, state.nem.reconfig_at)
+            if cfg.nem_disk_enabled:
+                next_chaos = jnp.minimum(next_chaos, state.nem.disk_at)
             chaos_in_w = next_chaos <= w_end
             w_end = jnp.where(chaos_in_w, t_next, w_end)
 
@@ -2216,6 +2376,182 @@ class BatchedSim:
             tr_remove = jnp.where(ap_remove, victim_d, -1)
             tr_join = jnp.where(ap_join, join_node, -1)
 
+        # durability watermark ADVANCE (DiskFault plane, half 1 of 2):
+        # re-snapshot the durable fields of every node whose sync counter
+        # increased this step — the spec's declared fsync points. Done
+        # BEFORE the disk clause below, so the ordering is the safety
+        # argument for correct specs: the handler ran, THEN the watermark
+        # advanced, THEN the disk crash measures its loss — a spec that
+        # syncs before acking can never lose an acked write to this
+        # clause, even when the sync and the crash land on one step.
+        dur_mid = state.dur
+        if self._dur_state:
+            sf = spec.sync_field
+            dur_adv = getattr(node, sf) > getattr(node0, sf)  # [L,N]
+            dur_mid = _tree_where(dur_adv, self._dur_of(node), state.dur)
+
+        # -- 5e. nemesis disk-fault cycle (slow -> crash -> recover) --------
+        # The durability clause (docs/nemesis.md r18): occurrence k opens
+        # a DEGRADED window at the schedule-drawn victim (host face:
+        # writes pay extra latency, fsync raises EIO; device face: a pure
+        # fire/trace marker), then the disk DIES — the victim is killed
+        # and, at recovery, rebuilt from its durable WATERMARK instead of
+        # live state: exactly the unsynced-tail-lost middle regime that
+        # crash-preserve (on_restart keeps everything) and wipe (init
+        # keeps nothing) both structurally miss. All three phases of
+        # occurrence k share ONE triage gate at k (like a reconfig's
+        # remove/join pair), and the victim + torn bit are recomputed
+        # pure draws at index k, never carried state.
+        tr_dslow = jnp.full((L,), -1, jnp.int32)
+        tr_dcrash = jnp.full((L,), -1, jnp.int32)
+        tr_drecover = jnp.full((L,), -1, jnp.int32)
+        tr_dtorn = jnp.zeros((L,), jnp.bool_)
+        ap_dslow = ap_dcrash = ap_drecover = None
+        drec_mask = None
+        unsynced_lost = jnp.zeros((L,), jnp.int32)
+        nem_disk_at = nem_disk_phase = nem_disk_k = None
+        if cfg.nem_disk_enabled:
+            nst = state.nem
+            disk_due = active & (nst.disk_at <= t_next)
+            dk = nst.disk_k
+            do_dslow = disk_due & (nst.disk_phase == 0)
+            do_dcrash = disk_due & (nst.disk_phase == 1)
+            do_drecover = disk_due & (nst.disk_phase == 2)
+            disk_en = (
+                _occ_on(ctl, "disk", dk) if self.triage
+                else jnp.ones((L,), jnp.bool_)
+            )
+            dvictim = prng.randint(
+                state.key0, NEM_SITE_DISK_VICTIM, 0, N, index=dk
+            )
+            if cfg.nem_disk_torn_rate > 0:
+                torn = (
+                    prng.bits(state.key0, NEM_SITE_DISK_TORN, index=dk)
+                    % jnp.uint32(COIN_DENOM)
+                ) < jnp.uint32(round(cfg.nem_disk_torn_rate * COIN_DENOM))
+            else:
+                torn = jnp.zeros((L,), jnp.bool_)
+            ap_dslow = do_dslow & disk_en
+            ap_dcrash = do_dcrash & disk_en
+            ap_drecover = do_drecover & disk_en
+            dcrash_mask = ap_dcrash[:, None] & (node_ids == dvictim[:, None])
+            drec_mask = ap_drecover[:, None] & (node_ids == dvictim[:, None])
+            # the disk crash kills the victim like a crash-clause kill:
+            # liveness bit down, in-flight messages to it lost
+            alive = (alive & ~dcrash_mask) | drec_mask
+            valid = valid & ~dcrash_mask[:, :, None]
+            if self._B:
+                svalid = svalid & ~(
+                    ap_dcrash[:, None] & (strag.dst == dvictim[:, None])
+                )
+            # unsynced loss: the victim's durable fields differ from its
+            # watermark at the crash instant — everything acked since the
+            # last sync point is about to vanish (no durable contract =
+            # the whole node state is unsynced by definition)
+            if self._dur_state:
+                differs = jnp.zeros((L, N), jnp.bool_)
+                for f in spec.durable_fields:
+                    d = (
+                        getattr(dur_mid, f).astype(jnp.int32)
+                        != getattr(node, f)
+                    )
+                    differs = differs | d.reshape(L, N, -1).any(axis=2)
+                unsynced_lost = (
+                    (dcrash_mask & differs).any(axis=1).astype(jnp.int32)
+                )
+            else:
+                unsynced_lost = ap_dcrash.astype(jnp.int32)
+            # RECOVERY: rebuild from what the disk durably holds — a fresh
+            # init state with the durable fields replaced by the (widened)
+            # watermark, optionally refined by spec.on_recover (which sees
+            # the torn bit); no durable contract degenerates to a wipe.
+            # The hook's returned timer is a RELATIVE delay from the
+            # recovery instant (init semantics), shifted + skew-rescaled
+            # exactly like a join's.
+            ns_d, timer_d = self._v_init(rkeys, narange)
+            timer_d = jnp.asarray(timer_d, jnp.int32)
+            if self._dur_state:
+                wm = self._widen_dur(dur_mid)
+                ns_d = ns_d._replace(**{
+                    f: getattr(wm, f) for f in spec.durable_fields
+                })
+            if self._v_on_recover is not None:
+                ns_d, timer_d = self._v_on_recover(
+                    ns_d, node_ids, t_next, torn, rkeys
+                )
+                timer_d = jnp.asarray(timer_d, jnp.int32)
+            d_ok = (timer_d >= 0) & (timer_d < INF_GUARD)
+            timer_d = jnp.where(d_ok, timer_d + t_next[:, None], timer_d)
+            if cfg.nem_skew_enabled:
+                dd = timer_d - t_next[:, None]
+                sk_d = d_ok & (dd > 0)
+                timer_d = jnp.where(
+                    sk_d,
+                    t_next[:, None] + scale_delay_ppm(dd, state.nem.skew_ppm),
+                    timer_d,
+                )
+            if spec.time_fields:
+                ns_d = ns_d._replace(**{
+                    f: getattr(ns_d, f)
+                    + t_next.reshape((L,) + (1,) * (getattr(ns_d, f).ndim - 1))
+                    for f in spec.time_fields
+                })
+            node = _tree_where(drec_mask, ns_d, node)
+            timer = jnp.where(drec_mask, timer_d, timer)
+            # schedule arithmetic: next toggle = previous toggle time plus
+            # an occurrence-indexed delta (never clock + delta)
+            slow_d = prng.randint(
+                state.key0, NEM_SITE_DISK_SLOW, cfg.nem_disk_slow_lo_us,
+                cfg.nem_disk_slow_hi_us, index=dk,
+            )
+            down_d = prng.randint(
+                state.key0, NEM_SITE_DISK_DOWN, cfg.nem_disk_down_lo_us,
+                cfg.nem_disk_down_hi_us, index=dk,
+            )
+            next_d = prng.randint(
+                state.key0, NEM_SITE_DISK_IV, cfg.nem_disk_interval_lo_us,
+                cfg.nem_disk_interval_hi_us, index=dk + 1,
+            )
+            nem_disk_at = jnp.where(
+                do_dslow, nst.disk_at + slow_d,
+                jnp.where(
+                    do_dcrash, nst.disk_at + down_d,
+                    jnp.where(
+                        do_drecover, nst.disk_at + next_d, nst.disk_at
+                    ),
+                ),
+            )
+            nem_disk_phase = jnp.where(
+                do_dslow, 1,
+                jnp.where(
+                    do_dcrash, 2, jnp.where(do_drecover, 0, nst.disk_phase)
+                ),
+            )
+            nem_disk_k = dk + do_drecover.astype(jnp.int32)
+            tr_dslow = jnp.where(ap_dslow, dvictim, -1)
+            tr_dcrash = jnp.where(ap_dcrash, dvictim, -1)
+            tr_drecover = jnp.where(ap_drecover, dvictim, -1)
+            tr_dtorn = (ap_dcrash | ap_drecover) & torn
+
+        # durability watermark RESET (half 2 of 2, node now final): where
+        # wipe / join / disk-recover just installed a fresh node state,
+        # that state IS the new on-disk truth (a wiped or joining node
+        # boots fsynced like init; a recovered node's durable fields were
+        # just read FROM the disk). Reset targets are disjoint from the
+        # advance targets above — an event-processing node is never also
+        # restarting — so the reset simply layers on dur_mid.
+        new_dur = dur_mid
+        if self._dur_state:
+            reset = drec_mask
+            if (
+                any_crash and cfg.nem_crash_enabled
+                and cfg.nem_crash_wipe_rate > 0
+            ):
+                reset = reset | wipe_mask
+            if cfg.nem_reconfig_enabled:
+                reset = reset | join_mask
+            new_dur = _tree_where(reset, self._dur_of(node), dur_mid)
+
         # -- 6. collect outboxes, roll the network, pack into pool ---------
         def flat(out: Outbox, emitting, e):  # [L,N,e,...] -> [L, N*e, ...]
             v = (out.valid & emitting[:, :, None]).reshape(L, N * e)
@@ -2603,6 +2939,10 @@ class BatchedSim:
         if cfg.nem_reconfig_enabled:
             _count("remove", ap_remove)
             _count("join", ap_join)
+        if cfg.nem_disk_enabled:
+            _count("disk_slow", ap_dslow)
+            _count("disk_crash", ap_dcrash)
+            _count("disk_recover", ap_drecover)
         _count("loss", loss_drops)
         _count("dup", dup_fires)
         _count("reorder", reorder_fires)
@@ -2636,6 +2976,10 @@ class BatchedSim:
                 _occ_mark(
                     OCC_ROW["reconfig"], ap_remove, state.nem.reconfig_k
                 )
+            if cfg.nem_disk_enabled:
+                # the OPEN half (disk_slow) marks the occurrence; k is
+                # shared by all three phases of the cycle
+                _occ_mark(OCC_ROW["disk"], ap_dslow, state.nem.disk_k)
             occ_fired = jnp.stack(ocols, axis=1)
 
         # -- 7. invariants + lane lifecycle --------------------------------
@@ -2769,6 +3113,15 @@ class BatchedSim:
                     nem_reconfig_k if nem_reconfig_k is not None
                     else nst.reconfig_k
                 ),
+                disk_at=rb(
+                    nem_disk_at if nem_disk_at is not None else nst.disk_at,
+                    shift,
+                ),
+                disk_phase=(
+                    nem_disk_phase if nem_disk_phase is not None
+                    else nst.disk_phase
+                ),
+                disk_k=nem_disk_k if nem_disk_k is not None else nst.disk_k,
                 skew_ppm=nst.skew_ppm,
             )
         else:
@@ -2802,6 +3155,7 @@ class BatchedSim:
             overflow=overflow,
             dead_drops=state.dead_drops + dead_dropped,
             nonmember_drops=state.nonmember_drops + nonmember_dropped,
+            unsynced_loss=state.unsynced_loss + unsynced_lost,
             fires=fires,
             occ_fired=occ_fired,
             alive_p=bitpack.pack_bits(alive),
@@ -2817,6 +3171,7 @@ class BatchedSim:
             part_at=part_at,
             timer=timer,
             node=self._narrow_node(node),
+            dur=new_dur,
             msgs=MsgPool(
                 valid_p=bitpack.pack_bits(new_valid),
                 deliver=new_deliver,
@@ -2866,6 +3221,10 @@ class BatchedSim:
             spike_off=tr_spike_off,
             remove=tr_remove,
             join=tr_join,
+            disk_slow=tr_dslow,
+            disk_crash=tr_dcrash,
+            disk_recover=tr_drecover,
+            disk_torn=tr_dtorn,
             lam=tr_lam,
             evt_eid=tr_evt_eid,
             sent_eid=tr_sent_eid,
@@ -2941,6 +3300,7 @@ class BatchedSim:
                 nonmember_drops=put(
                     rf.nonmember_drops, ns.nonmember_drops
                 ),
+                unsynced_loss=put(rf.unsynced_loss, ns.unsynced_loss),
                 clock=put(rf.clock, ns.clock),
                 epoch=put(rf.epoch, ns.epoch),
                 fires=put(rf.fires, ns.fires),
@@ -3086,6 +3446,7 @@ class BatchedSim:
             overflow=zi((A,)),
             dead_drops=zi((A,)),
             nonmember_drops=zi((A,)),
+            unsynced_loss=zi((A,)),
             clock=zi((A,)),
             epoch=zi((A,)),
             fires=zi((A, len(FIRE_KINDS))),
@@ -3563,6 +3924,7 @@ def _summary_reduction(state: SimState) -> dict:
         "overflow64": _sum64(state.overflow),
         "dead_drops64": _sum64(state.dead_drops),
         "nonmember_drops64": _sum64(state.nonmember_drops),
+        "unsynced_loss64": _sum64(state.unsynced_loss),
         "steps64": _sum64(state.steps),
         "epoch64": _sum64(state.epoch),
         "clock64": _sum64(state.clock),
@@ -3625,6 +3987,7 @@ def summarize(state: SimState, spec: Optional[ProtocolSpec] = None) -> dict:
         "total_overflow": _join64(*red["overflow64"]),
         "total_dead_drops": _join64(*red["dead_drops64"]),
         "total_nonmember_drops": _join64(*red["nonmember_drops64"]),
+        "total_unsynced_loss": _join64(*red["unsynced_loss64"]),
         "mean_steps": steps_total / L,
         "mean_virtual_secs": vt_total_us / L / 1e6,
     }
@@ -3688,8 +4051,8 @@ def refill_results(state: SimState) -> dict:
         for f in (
             "retired", "violated", "deadlocked", "violation_at",
             "violation_epoch", "violation_step", "steps", "events",
-            "overflow", "dead_drops", "nonmember_drops", "clock",
-            "epoch", "fires",
+            "overflow", "dead_drops", "nonmember_drops", "unsynced_loss",
+            "clock", "epoch", "fires",
         )
     }
     for f in ("occ_fired", "cov_bitmap", "cov_hiwater", "cov_transitions"):
@@ -3710,6 +4073,7 @@ def refill_results(state: SimState) -> dict:
             "steps": state.steps, "events": state.events,
             "overflow": state.overflow, "dead_drops": state.dead_drops,
             "nonmember_drops": state.nonmember_drops,
+            "unsynced_loss": state.unsynced_loss,
             "clock": state.clock, "epoch": state.epoch,
             "fires": state.fires,
         }
@@ -3773,7 +4137,8 @@ def refill_results_sharded(
     row_fields = [
         "retired", "violated", "deadlocked", "violation_at",
         "violation_epoch", "violation_step", "steps", "events",
-        "overflow", "dead_drops", "nonmember_drops", "clock", "epoch",
+        "overflow", "dead_drops", "nonmember_drops", "unsynced_loss",
+        "clock", "epoch",
         "fires", "occ_fired", "cov_bitmap", "cov_hiwater",
         "cov_transitions",
     ]
@@ -3837,6 +4202,9 @@ def summarize_refill(res: dict) -> dict:
         "total_dead_drops": int(res["dead_drops"].astype(np.int64).sum()),
         "total_nonmember_drops": int(
             res["nonmember_drops"].astype(np.int64).sum()
+        ),
+        "total_unsynced_loss": int(
+            res["unsynced_loss"].astype(np.int64).sum()
         ),
         "mean_steps": steps_total / A,
         "mean_virtual_secs": vt_total_us / A / 1e6,

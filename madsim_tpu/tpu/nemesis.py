@@ -25,6 +25,7 @@ from ..nemesis import (
     Clause,
     ClockSkew,
     Crash,
+    DiskFault,
     Duplicate,
     FaultPlan,
     FIRE_KINDS,
@@ -109,6 +110,18 @@ def compile_plan(plan: FaultPlan, base: Optional[SimConfig] = None) -> SimConfig
             nem_reconfig_down_lo_us=reconf.down_lo_us,
             nem_reconfig_down_hi_us=reconf.down_hi_us,
         )
+    disk = plan.get(DiskFault)
+    if disk is not None:
+        kw.update(
+            nem_disk_interval_lo_us=disk.interval_lo_us,
+            nem_disk_interval_hi_us=disk.interval_hi_us,
+            nem_disk_slow_lo_us=disk.slow_lo_us,
+            nem_disk_slow_hi_us=disk.slow_hi_us,
+            nem_disk_down_lo_us=disk.down_lo_us,
+            nem_disk_down_hi_us=disk.down_hi_us,
+            nem_disk_torn_rate=disk.torn_rate,
+            nem_disk_extra_us=disk.extra_us,
+        )
     return dataclasses.replace(cfg, **kw)
 
 
@@ -117,6 +130,7 @@ def compile_plan(plan: FaultPlan, base: Optional[SimConfig] = None) -> SimConfig
 _CHAOS_KINDS = (
     "crash", "restart", "split", "heal", "clog", "unclog",
     "spike_on", "spike_off", "remove", "join",
+    "disk_slow", "disk_crash", "disk_recover",
 )
 
 
@@ -137,7 +151,11 @@ def schedule_tuples(
             out.append((ev.t_us, ev.kind, ev.node, ev.dst))
         elif ev.kind in ("spike_on", "spike_off"):
             out.append((ev.t_us, ev.kind, -1, -1))
-        else:  # crash / restart
+        elif ev.kind in ("disk_crash", "disk_recover"):
+            # the torn flag is part of the stream contract: a driver that
+            # drops it silently un-tears every crash
+            out.append((ev.t_us, ev.kind, ev.node, int(ev.torn)))
+        else:  # crash / restart / disk_slow
             out.append((ev.t_us, ev.kind, ev.node, -1))
     return out
 
@@ -164,8 +182,12 @@ def device_chaos_events(
             continue
         if horizon_us is not None and ev.t_us >= horizon_us:
             continue
-        if ev.kind in ("crash", "restart", "remove", "join"):
+        if ev.kind in ("crash", "restart", "remove", "join", "disk_slow"):
             out.append((ev.t_us, ev.kind, ev.node, -1))
+        elif ev.kind in ("disk_crash", "disk_recover"):
+            out.append(
+                (ev.t_us, ev.kind, ev.node, int(ev.detail == "torn"))
+            )
         elif ev.kind in ("split", "heal"):
             # trace detail carries the split sides; side_mask round-trips
             # through the record's i32
@@ -262,6 +284,8 @@ def enabled_fire_kinds(cfg: SimConfig) -> Tuple[str, ...]:
         kinds.append("skew")
     if cfg.nem_reconfig_enabled:
         kinds += ["remove", "join"]
+    if cfg.nem_disk_enabled:
+        kinds += ["disk_slow", "disk_crash", "disk_recover"]
     return tuple(kinds)
 
 

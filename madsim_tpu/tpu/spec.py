@@ -350,6 +350,30 @@ class ProtocolSpec:
     # real field classifying "closed" in the range certificate, not as
     # a construction error.
     rate_floors: Any = None
+    # OPTIONAL durability contract (the DiskFault clause, docs/nemesis.md
+    # r18). Without it, device-face durability is binary: a crash keeps
+    # full live state (on_restart), a wipe goes back to init. Declaring
+    # `durable_fields` opens the middle regime — names of node-state
+    # fields the engine snapshots into a per-node durable WATERMARK
+    # (stored at the narrowed at-rest dtypes). The watermark starts from
+    # the init state (boot is fsynced) and re-snapshots the live values
+    # whenever `sync_field` — an i32 node-state counter the spec's
+    # handlers bump at their fsync points — increases. A DiskFault
+    # recovery then rebuilds the node from the WATERMARK, not live
+    # state: everything acked after the last sync-point bump is lost,
+    # exactly the ack-before-fsync regime crash-preserve can't reach.
+    durable_fields: tuple = ()
+    sync_field: Any = None
+    # OPTIONAL recovery hook between on_restart and init:
+    #     on_recover(durable_state, node_id, now_us, torn, key)
+    #         -> (state', next_timer_us)
+    # `durable_state` is a FRESH init-shaped state with the durable
+    # fields replaced by the (widened) watermark; `torn` is the
+    # schedule's torn-write bit for this occurrence (a spec modeling
+    # tail corruption can drop the last durable entry on it). None with
+    # durable_fields set = use durable_state with init's timer verbatim;
+    # no durable_fields at all = disk recovery degenerates to a wipe.
+    on_recover: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -460,6 +484,24 @@ class SimConfig:
     nem_reconfig_interval_hi_us: int = 0  # 0 disables
     nem_reconfig_down_lo_us: int = 500_000
     nem_reconfig_down_hi_us: int = 3_000_000
+    # durability chaos (r18, nemesis DiskFault): occurrence k is a
+    # three-phase episode — disk_slow (degraded window opens; device
+    # marks the occurrence, host FsSim pays extra write latency and
+    # fails fsync), disk_crash after `slow` (victim down; every write
+    # since its last sync point is lost), disk_recover after `down`
+    # (rebuilt from the per-node durable watermark via spec.on_recover,
+    # NOT from live state like on_restart, NOT from scratch like wipe).
+    # torn_rate upgrades crashes to TORN (the flag on_recover receives;
+    # the host additionally keeps a schedule-drawn prefix of the last
+    # unsynced write). extra_us is the host's per-write fault latency.
+    nem_disk_interval_lo_us: int = 0
+    nem_disk_interval_hi_us: int = 0  # 0 disables
+    nem_disk_slow_lo_us: int = 100_000
+    nem_disk_slow_hi_us: int = 500_000
+    nem_disk_down_lo_us: int = 500_000
+    nem_disk_down_hi_us: int = 3_000_000
+    nem_disk_torn_rate: float = 0.0
+    nem_disk_extra_us: int = 50_000
     horizon_us: int = 30_000_000  # virtual-time budget per lane
     # scheduling-order nondeterminism (the utils/mpsc.rs:71-84 random-pop
     # analog, on device): break equal-timestamp delivery ties by a random
@@ -541,6 +583,10 @@ class SimConfig:
     @property
     def nem_reconfig_enabled(self) -> bool:
         return self.nem_reconfig_interval_hi_us > 0
+
+    @property
+    def nem_disk_enabled(self) -> bool:
+        return self.nem_disk_interval_hi_us > 0
 
     @property
     def nem_dup_enabled(self) -> bool:

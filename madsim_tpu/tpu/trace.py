@@ -30,7 +30,8 @@ class TraceEvent:
     step: int
     t_us: int
     # deliver | timer | crash | restart | split | heal | clog | unclog |
-    # spike_on | spike_off | remove | join | violation | deadlock
+    # spike_on | spike_off | remove | join | disk_slow | disk_crash |
+    # disk_recover | violation | deadlock
     kind: str
     node: int = -1  # acting node (dst for deliver; src for clog)
     src: int = -1  # sender (deliver only)
@@ -77,6 +78,22 @@ class TraceEvent:
                 f"[{t:9.6f}s #{self.step}] node{self.node} joins as a "
                 "fresh replica"
             )
+        if self.kind == "disk_slow":
+            return (
+                f"[{t:9.6f}s #{self.step}] node{self.node} disk degrades "
+                "(slow writes, failing fsync)"
+            )
+        if self.kind == "disk_crash":
+            w = " (torn tail)" if self.detail else ""
+            return (
+                f"[{t:9.6f}s #{self.step}] node{self.node} disk dies{w} "
+                "— unsynced state lost"
+            )
+        if self.kind == "disk_recover":
+            return (
+                f"[{t:9.6f}s #{self.step}] node{self.node} recovers from "
+                "its durable watermark"
+            )
         return f"[{t:9.6f}s #{self.step}] {self.kind.upper()} {self.detail}"
 
 
@@ -119,6 +136,10 @@ def extract_trace(
     spike_off = np.asarray(recs.spike_off)[:, lane]
     remove = np.asarray(recs.remove)[:, lane]
     join = np.asarray(recs.join)[:, lane]
+    disk_slow = np.asarray(recs.disk_slow)[:, lane]
+    disk_crash = np.asarray(recs.disk_crash)[:, lane]
+    disk_recover = np.asarray(recs.disk_recover)[:, lane]
+    disk_torn = np.asarray(recs.disk_torn)[:, lane]
     # lineage plane (BatchedSim(lineage=True) traces only)
     has_lin = recs.evt_eid is not None
     if has_lin:
@@ -135,6 +156,7 @@ def extract_trace(
         | split | heal | violation | deadlock
         | (clog_src >= 0) | unclog | spike_on | spike_off
         | (remove >= 0) | (join >= 0)
+        | (disk_slow >= 0) | (disk_crash >= 0) | (disk_recover >= 0)
     )
     for t in np.nonzero(busy)[0]:
         t = int(t)
@@ -225,6 +247,29 @@ def extract_trace(
             events.append(
                 TraceEvent(
                     step=t, t_us=t_chaos, kind="join", node=int(join[t])
+                )
+            )
+        if disk_slow[t] >= 0:
+            events.append(
+                TraceEvent(
+                    step=t, t_us=t_chaos, kind="disk_slow",
+                    node=int(disk_slow[t]),
+                )
+            )
+        if disk_crash[t] >= 0:
+            events.append(
+                TraceEvent(
+                    step=t, t_us=t_chaos, kind="disk_crash",
+                    node=int(disk_crash[t]),
+                    detail="torn" if disk_torn[t] else "",
+                )
+            )
+        if disk_recover[t] >= 0:
+            events.append(
+                TraceEvent(
+                    step=t, t_us=t_chaos, kind="disk_recover",
+                    node=int(disk_recover[t]),
+                    detail="torn" if disk_torn[t] else "",
                 )
             )
         if violation[t]:

@@ -1,0 +1,357 @@
+"""Write-ahead-log append service — the durability-chaos fuzz protocol
+(r18, docs/nemesis.md).
+
+An eighth *shape*, deliberately the smallest one: a WAL SERVER (node 0)
+applying client appends to an append-only log and acking them, with a
+group-commit fsync cadence. It exists to make the DiskFault clause's
+middle regime observable: the server's `log_len` is DURABLE (rolled back
+to the per-node watermark on a disk crash), its `syncs` counter is the
+spec's `sync_field` (every bump is an fsync point — the watermark
+re-snapshots the durable plane), and the invariant is the lost-ack
+claim every WAL owes its clients:
+
+    whenever a client's last ack was observed under the server's
+    CURRENT incarnation nonce, the server's log is at least as long
+    as the acked count.
+
+Why the other fault axes provably cannot fire it:
+
+  * crash-preserve (`on_restart`) keeps full live state — `log_len`
+    never moves backward, so an acked count stays covered;
+  * a wipe re-runs `init` with a fresh key and ROTATES the durable
+    `nonce` (exactly like lease's incarnation), so every pre-wipe ack
+    is vacuously outside the invariant's guard;
+  * a DiskFault recovery preserves the nonce (it is durable, synced at
+    boot) but rolls `log_len` back to the watermark — the one regime
+    where an acked-but-unsynced append is LOST under the same identity.
+
+The canonical planted bug (`buggy_ack_before_fsync=True`): the server
+acks an APPEND the moment it is applied, and the append reaches the
+durable watermark only at the next group-commit tick — the classic
+ack-before-fsync bug (ALICE, Pillai et al. OSDI '14; FDB's simulation
+papers class it as the dominant real durability failure). The correct
+server bumps `syncs` in the SAME step as the append (fsync-before-ack):
+the engine advances the watermark after the handlers and before any
+disk crash on the step, so an ack can never outlive its durability.
+
+The torn-write bit is a no-op for `log_len` here BY DESIGN: records are
+modeled as checksummed, so a torn tail only destroys the last unsynced
+record — which the watermark already excludes. `on_recover` records it
+(`torn_seen`) to keep the hook's plumbing observable; the host twin
+(workloads/wal_host.py) does the real byte-level torn-tail parse.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from . import prng
+from .spec import Outbox, ProtocolSpec, RateFloor, fuse_two_handlers
+
+APPEND, ACK = 0, 1
+PAYLOAD_WIDTH = 2
+SERVER = 0
+
+
+class WalState(NamedTuple):
+    # durable plane — the DiskFault watermark snapshots exactly these
+    nonce: jnp.ndarray  # i32 init-drawn incarnation (server identity)
+    log_len: jnp.ndarray  # i32 appends applied to the WAL (server)
+    # fsync bookkeeping (server; volatile)
+    syncs: jnp.ndarray  # i32 fsync counter — the spec's sync_field
+    dirty: jnp.ndarray  # i32 appends since the last fsync
+    # client plane (durable-by-crash like all device state; a disk
+    # crash on a CLIENT rolls these back to init — conservative, the
+    # invariant only weakens)
+    sent: jnp.ndarray  # i32 appends issued (diagnostics)
+    acked: jnp.ndarray  # i32 highest acked append count observed
+    srv_nonce: jnp.ndarray  # i32 server nonce the ack was observed under
+    # recovery diagnostics (volatile; written by on_recover)
+    recovered: jnp.ndarray  # i32 0|1
+    torn_seen: jnp.ndarray  # i32 0|1
+
+
+def make_wal_spec(
+    n_nodes: int = 4,
+    tick_us: int = 20_000,
+    sync_us: int = 120_000,
+    append_rate: float = 0.7,
+    buggy_ack_before_fsync: bool = False,
+) -> ProtocolSpec:
+    N = n_nodes
+    assert N >= 2
+    peers = jnp.arange(N, dtype=jnp.int32)
+
+    # ------------------------------------------------------------------ init
+
+    def init(key, nid):
+        z = jnp.int32(0)
+        state = WalState(
+            # drawn fresh at every (re-)init: a wipe-join rotates it,
+            # which is what makes pre-wipe acks vacuous; a DiskFault
+            # recovery puts the WATERMARK copy back (boot is fsynced)
+            nonce=prng.randint(key, 80, 1, 1 << 30),
+            log_len=z, syncs=z, dirty=z,
+            sent=z, acked=z, srv_nonce=z,
+            recovered=z, torn_seen=z,
+        )
+        period = jnp.where(nid == SERVER, sync_us, tick_us)
+        return state, period + prng.randint(key, 81, 0, tick_us)
+
+    # ----------------------------------------------------------------- timer
+
+    def on_timer(s: WalState, nid, now, key):
+        is_server = nid == SERVER
+        # server: group commit — fsync whatever the WAL accumulated
+        # since the last tick (the sync-point bump re-snapshots the
+        # durable watermark this same step)
+        do_sync = is_server & (s.dirty > 0)
+        # client: issue an append (fire-and-forget; the ack raises the
+        # client's observation watermark when it lands)
+        send = ~is_server & (prng.uniform(key, 82) < append_rate)
+        sent = s.sent + send.astype(jnp.int32)
+        state = s._replace(
+            syncs=s.syncs + do_sync.astype(jnp.int32),
+            dirty=jnp.where(do_sync, 0, s.dirty),
+            sent=sent,
+        )
+        out = Outbox(
+            valid=jnp.stack([send]),
+            dst=jnp.stack([jnp.int32(SERVER)]),
+            kind=jnp.stack([jnp.int32(APPEND)]),
+            payload=jnp.stack([jnp.stack([sent, jnp.int32(0)])]),
+        )
+        return state, out, now + jnp.where(is_server, sync_us, tick_us)
+
+    # --------------------------------------------------------------- message
+
+    def on_message(s: WalState, nid, src, kind, payload, now, key):
+        f = payload
+        is_server = nid == SERVER
+        is_app = (kind == APPEND) & is_server
+        applied = is_app.astype(jnp.int32)
+        log_len = s.log_len + applied
+        if buggy_ack_before_fsync:
+            # THE PLANTED BUG: the ack (below) leaves NOW, but the
+            # append only reaches the durable watermark at the next
+            # group-commit tick — a disk crash in between loses an
+            # append the client was already told is durable
+            syncs = s.syncs
+            dirty = s.dirty + applied
+        else:
+            # fsync-before-ack: the sync-point bump lands in the SAME
+            # step as the append, and the engine advances the watermark
+            # after the handlers but before any disk crash on the step
+            # — so the acked count is durable before the ack exists
+            syncs = s.syncs + applied
+            dirty = s.dirty
+        # client: fold an ACK. Same nonce raises the observation
+        # watermark (acks may be lost/reordered/duplicated); a NEW
+        # nonce means the server was wiped to a fresh incarnation —
+        # the old observation is void, adopt the new one
+        is_ack = (kind == ACK) & ~is_server
+        same = is_ack & (f[0] == s.srv_nonce)
+        fresh = is_ack & (f[0] != s.srv_nonce)
+        state = s._replace(
+            log_len=log_len,
+            syncs=syncs,
+            dirty=dirty,
+            acked=jnp.where(
+                same, jnp.maximum(s.acked, f[1]),
+                jnp.where(fresh, f[1], s.acked),
+            ),
+            srv_nonce=jnp.where(fresh, f[0], s.srv_nonce),
+        )
+        out = Outbox(
+            valid=jnp.stack([is_app]),
+            dst=jnp.stack([src.astype(jnp.int32)]),
+            kind=jnp.stack([jnp.int32(ACK)]),
+            payload=jnp.stack([jnp.stack([s.nonce, log_len])]),
+        )
+        return state, out, jnp.int32(-1)
+
+    # --------------------------------------------------------------- restart
+
+    def on_restart(s: WalState, nid, now, key):
+        # crash-preserve: node state IS its disk here, fully synced —
+        # the too-strong durability DiskFault exists to break. Nothing
+        # is lost, so the lost-ack invariant provably cannot fire on
+        # this axis (log_len never moves backward)
+        period = jnp.where(nid == SERVER, sync_us, tick_us)
+        return s, now + period + prng.randint(key, 83, 0, tick_us)
+
+    # --------------------------------------------------------------- recover
+
+    def on_recover(ds: WalState, nid, now, torn, key):
+        # ds is a fresh init-shaped state with nonce/log_len replaced
+        # by the widened watermark: identity survives, unsynced appends
+        # are gone. The torn bit is recorded, not applied to log_len —
+        # records are checksummed, so a torn tail only destroys the
+        # last UNSYNCED record, which the watermark already excludes
+        # (the host twin does the real byte-level parse)
+        state = ds._replace(
+            recovered=jnp.int32(1),
+            torn_seen=torn.astype(jnp.int32),
+        )
+        period = jnp.where(nid == SERVER, sync_us, tick_us)
+        # relative delay — init semantics, shifted/skewed by the engine
+        return state, period + prng.randint(key, 84, 0, tick_us)
+
+    # ------------------------------------------------------------ invariants
+
+    def check_invariants(ns: WalState, alive, now):
+        # ns leaves are [N, ...] for one lane. The lost-ack claim:
+        # a client whose last ack was observed under the server's
+        # CURRENT incarnation must never be ahead of the server's log.
+        # Guards make the other fault axes vacuous: a wipe rotates
+        # nonce (srv_nonce stops matching), a client disk crash rolls
+        # acked/srv_nonce back to init (0 never matches a nonce >= 1).
+        lost = (
+            (peers != SERVER)
+            & (ns.srv_nonce == ns.nonce[SERVER])
+            & (ns.acked > ns.log_len[SERVER])
+        )
+        return ~lost.any()
+
+    # ------------------------------------------------------------ diagnostics
+
+    def lane_metrics(node):
+        return {
+            "mean_log_len": node.log_len[:, SERVER].astype(jnp.float32),
+            "mean_acked": (
+                node.acked[:, 1:].astype(jnp.float32).mean(axis=-1)
+            ),
+            "recovered_lanes": (node.recovered > 0).any(axis=-1),
+            "torn_lanes": (node.torn_seen > 0).any(axis=-1),
+        }
+
+    append_floor_why = (
+        "each client issues at most one APPEND per tick (the timer's "
+        "single send; re-arm is now + tick_us, init/restart arm >= "
+        "tick_us out), so the server applies <= N-1 appends per tick "
+        "window, doubled for the Duplicate clause"
+    )
+    return fuse_two_handlers(ProtocolSpec(
+        name=f"wal{N}",
+        n_nodes=N,
+        payload_width=PAYLOAD_WIDTH,
+        max_out=1,
+        max_out_msg=1,
+        init=init,
+        on_message=on_message,
+        on_timer=on_timer,
+        on_restart=on_restart,
+        check_invariants=check_invariants,
+        lane_metrics=lane_metrics,
+        msg_kind_names=("APPEND", "ACK"),
+        # r8 carry compaction: counters are rate-bounded by the append
+        # cadence; the flags are step-closed {0,1}. nonce/srv_nonce stay
+        # i32 (30-bit random nonces — narrowing would collide
+        # incarnations and quietly re-arm the invariant's guard)
+        narrow_fields={
+            "log_len": jnp.uint16,
+            "acked": jnp.uint16,
+            "sent": jnp.uint16,
+            "syncs": jnp.uint16,
+            "dirty": jnp.uint16,
+            "recovered": jnp.uint8,
+            "torn_seen": jnp.uint8,
+        },
+        rate_floors={
+            "log_len": RateFloor(
+                floor_us=tick_us, ratchet=2 * (N - 1), inc=1,
+                why=append_floor_why,
+            ),
+            "acked": RateFloor(
+                floor_us=tick_us, ratchet=2 * (N - 1), inc=1,
+                why="copy: ACK payload of log_len values",
+            ),
+            "dirty": RateFloor(
+                floor_us=tick_us, ratchet=2 * (N - 1), inc=1,
+                why="bounded by unsynced appends (subset of log_len "
+                "bumps)",
+            ),
+            "sent": RateFloor(
+                floor_us=tick_us, ratchet=2, inc=1,
+                why="one client APPEND issue per own tick",
+            ),
+            "syncs": RateFloor(
+                floor_us=tick_us, ratchet=2 * N, inc=1,
+                why="at most one group-commit bump per server tick "
+                "plus one per arriving APPEND (fsync-before-ack "
+                "variant), both tick-rate-bounded",
+            ),
+        },
+        # u16 budget at the syncs bound (the tightest ratchet), halved
+        # for skew derating and margin — minutes of virtual time, far
+        # past any durability-smoke horizon
+        narrow_horizon_us=65_535 * tick_us // (4 * N),
+        # ---- the r18 durability contract ----
+        durable_fields=("nonce", "log_len"),
+        sync_field="syncs",
+        on_recover=on_recover,
+    ))
+
+
+def buggy_ack_before_fsync_spec(**kw) -> ProtocolSpec:
+    """The planted lost-ack bug as a ready-made spec (tests/benches)."""
+    return make_wal_spec(buggy_ack_before_fsync=True, **kw)
+
+
+def wal_workload(
+    n_nodes: int = 4,
+    virtual_secs: float = 8.0,
+    loss_rate: float = 0.02,
+    buggy: bool = False,
+    disk: bool = True,
+):
+    """The WAL lost-ack fuzz under DiskFault chaos as a BatchWorkload.
+
+    `disk=False` is the QUIET-DISK CONTROL LEG: the same (possibly
+    buggy) spec with the clause absent must report exactly zero
+    violations — ack-before-fsync is invisible without the durability
+    axis, which is the whole point of the clause. A violating seed gets
+    both microscopes: the device trace and the host twin
+    (workloads/wal_host.py — real fs.File appends, real fsync, real
+    torn-tail parse on recovery)."""
+    from .batch import BatchWorkload
+    from .spec import SimConfig, pool_kw_for
+
+    spec = make_wal_spec(n_nodes, buggy_ack_before_fsync=buggy)
+
+    def host_repro(seed: int):
+        from ..workloads import wal_host
+
+        try:
+            out = wal_host.fuzz_one_seed(
+                seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+                loss_rate=loss_rate, buggy=buggy, disk=disk,
+            )
+            out["violations"] = 0
+            return out
+        except wal_host.InvariantViolation as e:
+            return {"violations": 1, "violation": str(e)}
+
+    disk_kw = dict(
+        nem_disk_interval_lo_us=300_000,
+        nem_disk_interval_hi_us=1_200_000,
+        nem_disk_slow_lo_us=80_000,
+        nem_disk_slow_hi_us=250_000,
+        nem_disk_down_lo_us=200_000,
+        nem_disk_down_hi_us=800_000,
+        nem_disk_torn_rate=0.5,
+        nem_disk_extra_us=30_000,
+    ) if disk else {}
+    cfg = SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+        loss_rate=loss_rate,
+        **disk_kw,
+    )
+    return BatchWorkload(spec=spec, config=cfg, host_repro=host_repro)

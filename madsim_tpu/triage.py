@@ -49,6 +49,7 @@ from .nemesis import (
     CLAUSE_OF_EVENT,
     ClockSkew,
     Crash,
+    DiskFault,
     Duplicate,
     FaultPlan,
     LatencySpike,
@@ -83,6 +84,7 @@ _CLAUSE_TYPES = {
     "crash": Crash, "partition": Partition, "clog": LinkClog,
     "spike": LatencySpike, "skew": ClockSkew, "loss": MsgLoss,
     "dup": Duplicate, "reorder": Reorder, "reconfig": Reconfig,
+    "disk": DiskFault,
 }
 
 
@@ -153,6 +155,17 @@ def plan_from_config(cfg, name: str = "recovered") -> FaultPlan:
             interval_hi_us=cfg.nem_reconfig_interval_hi_us,
             down_lo_us=cfg.nem_reconfig_down_lo_us,
             down_hi_us=cfg.nem_reconfig_down_hi_us,
+        ))
+    if cfg.nem_disk_enabled:
+        clauses.append(DiskFault(
+            interval_lo_us=cfg.nem_disk_interval_lo_us,
+            interval_hi_us=cfg.nem_disk_interval_hi_us,
+            slow_lo_us=cfg.nem_disk_slow_lo_us,
+            slow_hi_us=cfg.nem_disk_slow_hi_us,
+            down_lo_us=cfg.nem_disk_down_lo_us,
+            down_hi_us=cfg.nem_disk_down_hi_us,
+            torn_rate=cfg.nem_disk_torn_rate,
+            extra_us=cfg.nem_disk_extra_us,
         ))
     return FaultPlan(clauses=tuple(clauses), name=name)
 

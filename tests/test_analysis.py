@@ -483,6 +483,32 @@ def test_mirror_rule_fires_when_oracle_ignores_the_registry():
     ), [v.render() for v in res.violations]
 
 
+def test_mirror_rule_fires_when_host_never_consumes_disk_coin():
+    """Face (f), r18 half: `disk` is a SCHEDULE clause with a host coin
+    (disk_torn_extent — the torn-tail extent FsSim keeps at a power
+    fail). A driver+fs pair that handles every event kind but never
+    touches the coin would silently UN-TEAR every scheduled torn crash
+    on the host face; the mirror rule must catch that apply-path gap."""
+    fake_driver = '\n'.join([
+        "class NemesisDriver:",
+        "    def install(self):",
+        "        self._assign('skew')",
+        "    def _apply(self, ev):",
+        "        for k in ('crash', 'restart', 'split', 'heal', 'clog',",
+        "                  'unclog', 'spike_on', 'spike_off', 'remove',",
+        "                  'join', 'disk_slow', 'disk_crash',",
+        "                  'disk_recover'):",
+        "            if ev.kind == k:",
+        "                return",
+    ])
+    res = lint.check_mirror(driver_source=fake_driver, fs_source="x = 1\n")
+    assert not res.ok
+    assert any(
+        "disk_torn_extent" in v.detail and "un-tears" in v.detail
+        for v in res.violations
+    ), [v.render() for v in res.violations]
+
+
 def test_mirror_rule_fires_on_stray_host_coin_entry():
     from madsim_tpu import nemesis as nem
 

@@ -1,0 +1,198 @@
+"""FsSim durability semantics under the r18 DiskFault axis.
+
+What a power failure may keep is exactly: the per-inode synced snapshot,
+plus (torn crash only) a schedule-drawn PREFIX of the last unsynced
+append. Never a resurrected synced-past, never a never-synced inode, and
+never more bytes than the tail held. The File.create-over-existing-path
+regression rides along: O_CREAT|O_TRUNC is an unsynced content change,
+not an erasure of the inode's durable history.
+"""
+
+import madsim_tpu as ms
+from madsim_tpu import fs
+
+
+def _fail(torn_extent=None):
+    sim = ms.plugin.simulator(fs.FsSim)
+    sim.power_fail(ms.plugin.node(), torn_extent=torn_extent)
+    return sim
+
+
+def test_torn_extent_keeps_prefix_of_last_unsynced_append():
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        f = await fs.File.create("/data/wal")
+        await f.write_all_at(b"hdr.", 0)
+        await f.sync_all()
+        await f.write_all_at(b"ABCDEF", 4)
+        seen = []
+
+        def extent(tail_len):
+            seen.append(tail_len)
+            return 3
+
+        _fail(torn_extent=extent)
+        assert seen == [6]  # the coin is offered the WHOLE unsynced tail
+        assert await fs.read("/data/wal") == b"hdr.ABC"
+
+    rt.block_on(main())
+
+
+def test_torn_extent_is_clamped_to_the_tail():
+    """An over-wide draw keeps the full tail, nothing more — a torn write
+    can persist at most what was in flight."""
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        f = await fs.File.create("/data/wal")
+        await f.write_all_at(b"base", 0)
+        await f.sync_all()
+        await f.write_all_at(b"xy", 4)
+        _fail(torn_extent=lambda n: n + 1_000_000)
+        assert await fs.read("/data/wal") == b"basexy"
+
+    rt.block_on(main())
+
+
+def test_torn_extent_never_resurrects_rolled_back_overwrites():
+    """The torn prefix stacks on the SYNCED snapshot: an unsynced
+    in-place overwrite of synced bytes still rolls back even when the
+    crash is torn — a torn write is a partially-persisted tail, not a
+    partially-honoured overwrite."""
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        f = await fs.File.create("/data/wal")
+        await f.write_all_at(b"aaaaa", 0)
+        await f.sync_all()
+        await f.write_all_at(b"XX", 1)  # unsynced overwrite of synced range
+        await f.write_all_at(b"tail", 5)  # then an unsynced append
+        _fail(torn_extent=lambda n: n)  # keep the whole tail
+        # overwrite gone, append kept: snapshot + tail prefix
+        assert await fs.read("/data/wal") == b"aaaaatail"
+
+    rt.block_on(main())
+
+
+def test_torn_coin_is_only_consulted_with_a_tail_to_tear():
+    """A torn crash with nothing unsynced appended is a clean rollback:
+    the extent callable must not even be drawn (the host would otherwise
+    consume a coin the pure schedule never spent)."""
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        f = await fs.File.create("/data/wal")
+        await f.write_all_at(b"steady", 0)
+        await f.sync_all()
+        drawn = []
+        _fail(torn_extent=lambda n: drawn.append(n) or 0)
+        assert drawn == []
+        assert await fs.read("/data/wal") == b"steady"
+
+    rt.block_on(main())
+
+
+def test_torn_extent_applies_to_last_written_file_only():
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        a = await fs.File.create("/data/a")
+        await a.write_all_at(b"A", 0)
+        await a.sync_all()
+        await a.write_all_at(b"111", 1)
+        b = await fs.File.create("/data/b")
+        await b.write_all_at(b"B", 0)
+        await b.sync_all()
+        await b.write_all_at(b"222", 1)  # b is the LAST write
+        _fail(torn_extent=lambda n: n)
+        assert await fs.read("/data/a") == b"A"  # not the torn file: clean
+        assert await fs.read("/data/b") == b"B222"
+
+    rt.block_on(main())
+
+
+def test_create_over_synced_path_preserves_durable_history():
+    """The r18 fs bugfix regression: re-creating an existing path
+    truncates content (unsynced, like any write) but must NOT reset the
+    inode's synced/ever_synced — a power failure after the re-create
+    recovers the last-synced content, exactly what a real disk holds
+    while the truncate is still in the page cache."""
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        f = await fs.File.create("/data/wal")
+        await f.write_all_at(b"durable", 0)
+        await f.sync_all()
+        f2 = await fs.File.create("/data/wal")  # O_CREAT|O_TRUNC, no sync
+        assert await f2.read_to_end() == b""
+        _fail()
+        # the path survives (directory entry was durable) with the
+        # last-synced content, not gone and not present-but-empty
+        assert await fs.read("/data/wal") == b"durable"
+
+    rt.block_on(main())
+
+
+def test_disk_fault_window_degrades_writes_and_fails_fsync():
+    """set_disk_fault (nemesis disk_slow) charges extra_ns per write and
+    turns fsync into EIO until cleared — and an EIO'd fsync must NOT have
+    advanced the durable snapshot."""
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        f = await fs.File.create("/data/wal")
+        await f.write_all_at(b"ok", 0)
+        await f.sync_all()
+
+        sim = ms.plugin.simulator(fs.FsSim)
+        nid = ms.plugin.node()
+        sim.set_disk_fault(nid, extra_ns=5_000_000)
+        t0 = ms.time.current().elapsed()
+        await f.write_all_at(b"slow", 2)
+        assert ms.time.current().elapsed() - t0 >= 0.005  # paid the fault
+        try:
+            await f.sync_all()
+            raise AssertionError("fsync on a faulted disk must raise EIO")
+        except OSError:
+            pass
+        sim.clear_disk_fault(nid)
+        assert sim.disk_fault_extra_ns(nid) == 0
+
+        # the EIO'd fsync was not durable: a crash now rolls "slow" back
+        _fail()
+        assert await fs.read("/data/wal") == b"ok"
+
+    rt.block_on(main())
+
+
+def test_chaos_twin_disk_recovery_cannot_resurrect_post_sync_bytes():
+    """The host chaos twin of the device watermark rule: under a real
+    DiskFault plan driven by NemesisDriver over live WAL nodes, every
+    recovered server parses a log no longer than what fsync promised plus
+    one torn record — recovery can reveal LESS than was written, never
+    MORE than was synced + the in-flight tail."""
+    from madsim_tpu import nemesis
+    from madsim_tpu.workloads import wal_host
+
+    plan = nemesis.FaultPlan(
+        name="fs-chaos-twin",
+        clauses=(
+            nemesis.DiskFault(
+                interval_lo_us=300_000, interval_hi_us=900_000,
+                slow_lo_us=80_000, slow_hi_us=250_000,
+                down_lo_us=200_000, down_hi_us=600_000,
+                torn_rate=0.9, extra_us=30_000,
+            ),
+        ),
+    )
+    for seed in range(4):
+        r = wal_host.fuzz_one_seed(
+            seed, n_nodes=4, virtual_secs=4.0, loss_rate=0.0, plan=plan
+        )
+        fires = r["nemesis"]["fires"]
+        assert fires.get("disk_crash", 0) >= 1
+        # the correct fsync-before-ack server survived every torn crash
+        # (fuzz_one_seed raises InvariantViolation on a lost ack) and
+        # came back with a parsable, non-negative log
+        assert r["final_log_len"] >= 0

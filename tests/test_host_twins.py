@@ -487,9 +487,11 @@ def test_workloads_wire_host_repro():
     from madsim_tpu.tpu.kv import kv_workload
     from madsim_tpu.tpu.paxos import paxos_workload
     from madsim_tpu.tpu.twopc import twopc_workload
+    from madsim_tpu.tpu.wal import wal_workload
 
     for wl in (
-        raft_workload(), kv_workload(), twopc_workload(), paxos_workload()
+        raft_workload(), kv_workload(), twopc_workload(), paxos_workload(),
+        wal_workload(),
     ):
         assert wl.host_repro is not None
 
@@ -498,3 +500,197 @@ def test_workloads_wire_host_repro():
     assert out["violations"] == 0
     out = paxos_workload(virtual_secs=4.0).host_repro(5)
     assert out["violations"] == 0
+    # r18: the WAL twin drives real fs.File appends + power_fail recovery
+    out = wal_workload(virtual_secs=4.0).host_repro(1)
+    assert out["violations"] == 0
+
+
+# -- r18: the durability axis (DiskFault) ------------------------------
+
+
+def test_wal_host_twin_clean():
+    """The correct fsync-before-ack WAL survives native disk chaos (slow
+    disk -> power_fail with a torn tail -> recovery from the file)."""
+    from madsim_tpu.workloads import wal_host
+
+    r = wal_host.fuzz_one_seed(1, virtual_secs=6.0, buggy=False, disk=True)
+    assert r["max_acked"] > 0
+    assert r["final_log_len"] >= 0  # server recovered a parsable WAL
+
+
+def test_wal_planted_bug_reproduces_on_both_faces():
+    """ack-before-fsync loses acknowledged appends on BOTH faces once the
+    durability axis is on (host: seed swept 0..7 -> 0,2..7 all hit)."""
+    from madsim_tpu.workloads import wal_host
+
+    with pytest.raises(wal_host.InvariantViolation, match="lost ack"):
+        wal_host.fuzz_one_seed(0, virtual_secs=8.0, buggy=True, disk=True)
+
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu import BatchedSim, summarize
+    from madsim_tpu.tpu.wal import wal_workload
+
+    wl = wal_workload(virtual_secs=8.0, buggy=True)
+    sim = BatchedSim(wl.spec, wl.config)
+    state = sim.run(jnp.arange(192), max_steps=40_000)
+    s = summarize(state)
+    assert s["violations"] > 0
+    # the lost-unsynced-state cold counter is the clause's own witness:
+    # bug lanes lost bytes a quiet disk would have kept
+    import numpy as np
+
+    assert int(np.asarray(state.unsynced_loss).sum()) > 0
+
+
+def test_wal_quiet_disk_control_is_silent():
+    """CONTROL LEG: the SAME planted bug with the DiskFault clause absent
+    is invisible — exactly zero violations on both faces. Ack-before-fsync
+    only matters when unsynced state can actually be lost."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from madsim_tpu.tpu import BatchedSim, summarize
+    from madsim_tpu.tpu.wal import wal_workload
+    from madsim_tpu.workloads import wal_host
+
+    wl = wal_workload(virtual_secs=8.0, buggy=True, disk=False)
+    sim = BatchedSim(wl.spec, wl.config)
+    state = sim.run(jnp.arange(192), max_steps=40_000)
+    assert summarize(state)["violations"] == 0
+    assert int(np.asarray(state.unsynced_loss).sum()) == 0
+
+    for seed in range(4):  # host leg of the same control
+        r = wal_host.fuzz_one_seed(
+            seed, virtual_secs=6.0, buggy=True, disk=False
+        )
+        assert r["max_acked"] > 0
+
+
+@pytest.mark.chaos
+def test_disk_three_face_twin_schedule_host_device():
+    """The r18 durability axis on all three faces: ONE FaultPlan with a
+    `disk` clause + ONE seed gives the SAME slow/crash/recover stream on
+
+      schedule: plan.schedule(seed, ...) — the pure murmur3 derivation
+                (episode phases share a victim; the torn coin rides both
+                the crash and the recover);
+      host:     NemesisDriver.applied (set_disk_fault -> kill +
+                power_fail_node -> restart) over REAL fs.File WAL nodes,
+                plus its occ_fired["disk"] mask;
+      device:   the traced engine's disk events and the lane's occ_fired
+                tensor row.
+    """
+    import numpy as np
+
+    from madsim_tpu import nemesis
+    from madsim_tpu.nemesis import OCC_ROW
+    from madsim_tpu.workloads import wal_host
+
+    N, SEED, HOR_US = 4, 5, 3_000_000
+    plan = nemesis.FaultPlan(
+        name="disk-twin",
+        clauses=(
+            nemesis.DiskFault(
+                interval_lo_us=300_000, interval_hi_us=900_000,
+                slow_lo_us=80_000, slow_hi_us=250_000,
+                down_lo_us=200_000, down_hi_us=600_000,
+                torn_rate=0.5, extra_us=30_000,
+            ),
+        ),
+    )
+    sched = plan.schedule(SEED, HOR_US, N)
+    slows = [e for e in sched if e.kind == "disk_slow"]
+    assert len(slows) >= 2, "the disk clause must fire in-horizon"
+    episodes = {}
+    for ev in sched:
+        episodes.setdefault(ev.k, []).append(ev)
+    order = ("disk_slow", "disk_crash", "disk_recover")
+    for evs in episodes.values():
+        # an episode keeps one victim through all its phases, in order,
+        # and its crash and recover agree on the torn coin
+        assert len({e.node for e in evs}) == 1
+        kinds = tuple(e.kind for e in evs)
+        assert kinds == order[: len(kinds)]
+        assert len({e.torn for e in evs if e.kind != "disk_slow"}) <= 1
+    want_occ = 0
+    for ev in slows:
+        want_occ |= 1 << min(ev.k, 31)
+
+    # -- host face: the WAL twin's real files under the driver ----------
+    r = wal_host.fuzz_one_seed(
+        SEED, n_nodes=N, virtual_secs=HOR_US / 1e6, loss_rate=0.0,
+        plan=plan,
+    )
+    bundle = r["nemesis"]
+    assert bundle["applied"] == [e for e in sched if e.kind != "skew"]
+    assert bundle["occ_fired"].get("disk", 0) == want_occ
+
+    # -- device face: same plan compiled onto the batched engine --------
+    import jax.numpy as jnp
+
+    from madsim_tpu.tpu import BatchedSim, SimConfig
+    from madsim_tpu.tpu import nemesis as tpu_nemesis
+    from madsim_tpu.tpu.spec import pool_kw_for
+    from madsim_tpu.tpu.wal import make_wal_spec
+
+    spec = make_wal_spec(N)
+    cfg = tpu_nemesis.compile_plan(
+        plan,
+        SimConfig(
+            horizon_us=HOR_US,
+            **pool_kw_for(
+                spec,
+                fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+                two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+            ),
+        ),
+    )
+    sim = BatchedSim(spec, cfg)
+    n_events = tpu_nemesis.assert_device_matches_schedule(
+        sim, plan, SEED, horizon_us=HOR_US
+    )
+    assert n_events >= len(sched)
+    st = sim.run(jnp.asarray([SEED], jnp.uint32), max_steps=40_000)
+    occ = np.asarray(st.occ_fired, np.uint32)[0]
+    assert int(occ[OCC_ROW["disk"]]) == want_occ
+
+
+@pytest.mark.chaos
+def test_disk_clause_fires_across_1024_seeds():
+    """The durability axis is not a lottery ticket: across 1024 seeds of
+    the wal workload's DiskFault plan, EVERY pure schedule opens at least
+    one in-horizon episode, and on a 1024-lane device sweep every lane's
+    occ_fired row marks the clause."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from madsim_tpu import nemesis
+    from madsim_tpu.nemesis import OCC_ROW
+    from madsim_tpu.tpu import BatchedSim
+    from madsim_tpu.tpu.wal import wal_workload
+    from madsim_tpu.triage import plan_from_config
+
+    wl = wal_workload(virtual_secs=4.0)
+    plan = nemesis.FaultPlan(
+        name="sweep",
+        clauses=tuple(
+            c for c in plan_from_config(wl.config).clauses
+            if isinstance(c, nemesis.DiskFault)
+        ),
+    )
+    assert plan.clauses, "the wal workload must carry a DiskFault clause"
+    hor = int(wl.config.horizon_us)
+    for seed in range(1024):
+        evs = plan.schedule(seed, hor, wl.spec.n_nodes)
+        assert any(e.kind == "disk_slow" for e in evs), (
+            f"seed {seed}: no disk episode below the horizon"
+        )
+
+    sim = BatchedSim(wl.spec, wl.config)
+    st = sim.run(jnp.arange(1024, dtype=jnp.uint32), max_steps=25_000)
+    occ = np.asarray(st.occ_fired, np.uint32)[:, OCC_ROW["disk"]]
+    assert (occ != 0).all(), (
+        f"{int((occ == 0).sum())} of 1024 lanes never applied a disk "
+        "episode the schedule promised"
+    )

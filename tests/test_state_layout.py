@@ -81,7 +81,19 @@ LAYOUT = {
     "events": ("int32", (L,)),
     "overflow": ("int32", (L,)),
     "dead_drops": ("int32", (L,)),
-    "fires": ("int32", (L, 11)),
+    # membership churn (r17): packed membership plane + epoch + drop
+    # counter are always present (the reconfig clause toggles behavior,
+    # not layout)
+    "member_p": ("uint32", (L, 1)),
+    "member_epoch": ("int32", (L,)),
+    "nonmember_drops": ("int32", (L,)),
+    # durability chaos (r18): the lost-unsynced-state counter is always
+    # present; the watermark itself (`dur`) exists only when a DiskFault
+    # clause meets a spec with durable_fields — disk-off sweeps pay ZERO
+    # watermark bytes
+    "unsynced_loss": ("int32", (L,)),
+    "dur": None,
+    "fires": ("int32", (L, 16)),
     "occ_fired": None,
     # bit-packed planes (bitpack.py): bool would cost 8x in the carry
     "alive_p": ("uint32", (L, 1)),
@@ -157,9 +169,11 @@ REFILL_LAYOUT = {
     "refill.events": ("int32", (A,)),
     "refill.overflow": ("int32", (A,)),
     "refill.dead_drops": ("int32", (A,)),
+    "refill.nonmember_drops": ("int32", (A,)),
+    "refill.unsynced_loss": ("int32", (A,)),
     "refill.clock": ("int32", (A,)),
     "refill.epoch": ("int32", (A,)),
-    "refill.fires": ("int32", (A, 11)),
+    "refill.fires": ("int32", (A, 16)),
     "refill.occ_fired": None,  # nemesis schedule clauses only
     "refill.cov_bitmap": None,  # coverage mode only
     "refill.cov_hiwater": None,
@@ -519,6 +533,13 @@ def test_sum64_lane_bound_enforced():
 # pre-compaction (r7, flat i32/bool) engine and the compacted engine —
 # verified on both trees before pinning. Changing any of them requires a
 # layout-version note here and in docs/state_layout.md.
+# Layout-version r18: FIRE_KINDS growth (r17 remove/join, r18 disk_*)
+# widened state.fires past the r8 11 columns these constants were
+# hashed over; canonical_digest now hashes the r8 prefix contiguously
+# and later columns only where nonzero (R8_FIRE_WIDTH above), which
+# reproduces these EXACT r8 constants on the current engine — verified
+# column-for-column before restoring. The trajectories never changed;
+# the digest function had silently started hashing new zero columns.
 GOLDEN = {
     "raft": "2a0e81ea9e273a54298b0bc11e44f377ef8861607ad320278695700bf0df861b",
     "paxos": "b32a304d0682bcc183b4b3d1382816bb6187c74d8f145d082e0198dec44efa8b",
@@ -528,11 +549,24 @@ GOLDEN = {
 }
 
 
+# the FIRE_KINDS prefix width at bless time (layout-version r8): the
+# first 11 fire columns hash as one contiguous block, bit-compatible
+# with the original pinned constants; columns ADDED by later clause
+# families (r17 remove/join, r18 disk_*) enter the digest — named by
+# kind — only where nonzero, so a run in which a later clause is absent
+# digests identically to one on a tree where the clause doesn't exist.
+# Widening FIRE_KINDS therefore never re-blesses GOLDEN by itself; only
+# a trajectory change does.
+R8_FIRE_WIDTH = 11
+
+
 def canonical_digest(state) -> str:
     """Layout-independent trajectory digest: every field widened to i64,
     packed planes unpacked, narrow node leaves included as their VALUES
     (so any value-corrupting narrowing changes the digest, but a pure
-    storage change cannot)."""
+    storage change cannot). Fire columns past the r8 width count only
+    when nonzero (see R8_FIRE_WIDTH) — clause-family growth keeps old
+    digests stable wherever the new clause is off."""
     h = hashlib.sha256()
     for name in ("clock", "epoch", "key", "done", "violated",
                  "violation_step", "steps", "events", "overflow",
@@ -543,8 +577,14 @@ def canonical_digest(state) -> str:
     for leaf in jax.tree_util.tree_leaves(state.node):
         h.update(np.ascontiguousarray(np.asarray(leaf).astype(np.int64)))
     for part in (state.msgs.valid, state.msgs.deliver, state.msgs.kind,
-                 state.msgs.payload, state.fires):
+                 state.msgs.payload):
         h.update(np.ascontiguousarray(np.asarray(part).astype(np.int64)))
+    fires = np.asarray(state.fires).astype(np.int64)
+    h.update(np.ascontiguousarray(fires[:, :R8_FIRE_WIDTH]))
+    for i in range(R8_FIRE_WIDTH, fires.shape[1]):
+        if fires[:, i].any():
+            h.update(nemesis.FIRE_KINDS[i].encode())
+            h.update(np.ascontiguousarray(fires[:, i]))
     return h.hexdigest()
 
 

@@ -156,6 +156,45 @@ def test_chaos_occurrence_rows_stable_schema_roundtrip():
     assert telemetry.chaos_rows({}) == []
 
 
+def test_chaos_rows_carry_disk_occurrences_end_to_end():
+    """The r18 durability clause in the occurrence-row schema: `disk`
+    rows sort after the older clauses (OCC_CLAUSES registry order), and a
+    real wal run's summary emits exactly one row per fired disk episode —
+    the k set equals the lane's occ_fired bitmask."""
+    summary = {
+        "occfires_disk_k1": 5,
+        "occfires_crash_k0": 1,
+        "occfires_disk_k0": 9,
+    }
+    assert telemetry.chaos_rows(summary) == [
+        {"clause": "crash", "k": 0, "lanes": 1},
+        {"clause": "disk", "k": 0, "lanes": 9},
+        {"clause": "disk", "k": 1, "lanes": 5},
+    ]
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from madsim_tpu.nemesis import OCC_ROW
+    from madsim_tpu.tpu import BatchedSim, summarize
+    from madsim_tpu.tpu.wal import wal_workload
+
+    wl = wal_workload(virtual_secs=3.0)
+    sim = BatchedSim(wl.spec, wl.config)
+    st = sim.run(jnp.asarray([5], jnp.uint32), max_steps=40_000)
+    s = summarize(st)
+    mask = int(np.asarray(st.occ_fired)[0, OCC_ROW["disk"]])
+    ks = {k for k in range(32) if (mask >> k) & 1}
+    assert ks, "the wal workload's DiskFault clause must fire by 3s"
+    got = {
+        r["k"] for r in telemetry.chaos_rows(s) if r["clause"] == "disk"
+    }
+    assert got == ks
+    # the clause's three fire kinds ride the totals vocabulary too
+    assert s.get("fires_disk_slow", 0) >= 1
+    assert s.get("fires_disk_crash", 0) >= 1
+
+
 # ------------------------------------------------------------ lint satellite
 
 

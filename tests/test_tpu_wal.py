@@ -1,0 +1,104 @@
+"""Device face of the r18 durability axis: the WAL spec, its durable
+plane (spec.durable_fields watermark + on_recover), and the planted
+ack-before-fsync bug's full contrast matrix.
+
+The matrix the clause exists for (docs/nemesis.md "DiskFault"):
+  correct spec x disk chaos   -> zero violations (fsync-before-ack holds)
+  buggy spec   x quiet disk   -> zero violations (the bug is invisible)
+  buggy spec   x disk chaos   -> violations (lost acks surface)
+covered here and in tests/test_host_twins.py (host face + 3-face twin).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu.tpu import BatchedSim, summarize
+from madsim_tpu.tpu.wal import (
+    buggy_ack_before_fsync_spec,
+    make_wal_spec,
+    wal_workload,
+)
+
+
+def test_wal_spec_declares_the_durability_contract():
+    """The spec's durable plane is exactly {nonce, log_len}: the server
+    identity and what fsync promised — NOT the volatile fsync
+    bookkeeping, NOT client state (a client disk crash conservatively
+    rolls to init)."""
+    spec = make_wal_spec(4)
+    assert spec.durable_fields == ("nonce", "log_len")
+    assert spec.sync_field == "syncs"
+    assert spec.on_recover is not None
+
+
+def test_wal_durability_plane_in_carry_partition():
+    """The watermark rides the hot carry as `hot.dur.<field>` (one twin
+    per durable field) and the loss counter as `cold.unsynced_loss` —
+    the shrink/refill machinery and the range certifier see them as
+    first-class leaves."""
+    from madsim_tpu.tpu.engine import carry_partition
+
+    wl = wal_workload(virtual_secs=2.0)
+    sim = BatchedSim(wl.spec, wl.config)
+    state = sim._init(np.arange(4, dtype=np.uint32))
+    parts = carry_partition(state)
+    assert "dur.nonce" in parts["hot"]
+    assert "dur.log_len" in parts["hot"]
+    assert "unsynced_loss" in parts["cold"]
+
+
+def test_wal_correct_spec_survives_disk_chaos():
+    """fsync-before-ack tolerates the full clause: across 256 seeds of
+    slow/dying/torn disks there is not one lost ack — and not one lost
+    DURABLE byte either (the counter stays zero because the correct
+    server syncs every append before advancing log_len, so the watermark
+    never trails; losing nothing unsynced is the correctness argument)."""
+    wl = wal_workload(virtual_secs=6.0)
+    sim = BatchedSim(wl.spec, wl.config)
+    st = sim.run(jnp.arange(256), max_steps=40_000)
+    s = summarize(st)
+    assert s["violations"] == 0
+    assert s["fires_disk_crash"] > 0
+    assert int(np.asarray(st.unsynced_loss).sum()) == 0
+
+
+def test_wal_buggy_flag_only_changes_the_ack_path():
+    """The planted spec differs from the correct one ONLY in handlers —
+    same layout, same durable plane, same narrow contract — so every
+    A/B between them isolates the ack-before-fsync decision."""
+    a, b = make_wal_spec(4), buggy_ack_before_fsync_spec(n_nodes=4)
+    assert a.durable_fields == b.durable_fields
+    assert a.narrow_fields == b.narrow_fields
+    assert a.narrow_horizon_us == b.narrow_horizon_us
+
+
+def test_wal_unsynced_loss_attributes_the_ack_path():
+    """The cold counter is the clause's witness, and it separates the
+    specs under IDENTICAL chaos: the group-committing buggy server loses
+    unsynced durable state at disk crashes (counter positive), the
+    fsync-before-ack server has nothing unsynced to lose (zero). Same
+    seeds, same schedule — only the ack path differs."""
+    loud = wal_workload(virtual_secs=6.0, buggy=True)
+    sim_l = BatchedSim(loud.spec, loud.config)
+    st_l = sim_l.run(jnp.arange(64), max_steps=40_000)
+    assert int(np.asarray(st_l.unsynced_loss).sum()) > 0
+
+    quiet = wal_workload(virtual_secs=6.0, buggy=True, disk=False)
+    sim_q = BatchedSim(quiet.spec, quiet.config)
+    st_q = sim_q.run(jnp.arange(64), max_steps=40_000)
+    assert int(np.asarray(st_q.unsynced_loss).sum()) == 0
+
+
+@pytest.mark.chaos
+def test_wal_planted_bug_fires_and_is_attributable():
+    """buggy x disk violates on many lanes, and every violating lane's
+    own unsynced_loss is positive — the violation is attributable to a
+    durable-state loss on that lane, not cross-lane luck."""
+    wl = wal_workload(virtual_secs=8.0, buggy=True)
+    sim = BatchedSim(wl.spec, wl.config)
+    st = sim.run(jnp.arange(256), max_steps=40_000)
+    viol = np.asarray(st.violated)
+    assert viol.sum() >= 8, f"only {int(viol.sum())}/256 lanes violated"
+    loss = np.asarray(st.unsynced_loss)
+    assert (loss[viol != 0] > 0).all()

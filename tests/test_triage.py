@@ -303,6 +303,91 @@ def test_reconfig_occurrence_suppression_is_schedule_pure():
     )
 
 
+def test_disk_occurrence_suppression_is_schedule_pure():
+    """The r18 durability clause rides the same schedule-purity contract:
+    suppressing disk occurrence 0 (pure face `filter_schedule`, host face
+    the driver's occ_off, device face a TriageCtl occ bit) drops exactly
+    that slow/crash/recover episode and perturbs NOTHING else — the crash
+    stream and the later disk episodes keep their times bit-for-bit."""
+    from madsim_tpu.nemesis import DiskFault
+
+    DISK_KINDS = ("disk_slow", "disk_crash", "disk_recover")
+    plan = FaultPlan(name="disk-purity", clauses=(
+        Crash(interval_lo_us=400_000, interval_hi_us=1_500_000,
+              down_lo_us=300_000, down_hi_us=1_000_000),
+        DiskFault(interval_lo_us=400_000, interval_hi_us=1_200_000,
+                  slow_lo_us=80_000, slow_hi_us=250_000,
+                  down_lo_us=200_000, down_hi_us=600_000,
+                  torn_rate=0.5, extra_us=30_000),
+    ))
+    evs = plan.schedule(7, HORIZON_US, 4)
+    ks = sorted({e.k for e in evs if e.kind in DISK_KINDS})
+    assert len(ks) >= 2 and ks[0] == 0
+
+    # pure face: dropping occurrence 0 removes exactly its episode
+    kept = nm.filter_schedule(evs, occ_off={"disk": 0b1})
+    assert not any(e.kind in DISK_KINDS and e.k == 0 for e in kept)
+    assert kept == [
+        e for e in evs if not (e.kind in DISK_KINDS and e.k == 0)
+    ]
+
+    # host face: the driver applies the filtered stream, not a re-rolled
+    # one — the wal twin's files see episode 1..n at their original times
+    from madsim_tpu.workloads import wal_host
+
+    r = wal_host.fuzz_one_seed(
+        7, n_nodes=4, virtual_secs=HORIZON_US / 1e6, loss_rate=0.0,
+        plan=plan, occ_off={"disk": 0b1},
+    )
+    assert r["nemesis"]["applied"] == [
+        e for e in kept if e.kind != "skew"
+    ]
+
+    # device face: the suppressed lane's chaos stream equals the filtered
+    # schedule event-for-event
+    from madsim_tpu.nemesis import OCC_ROW
+    from madsim_tpu.tpu import BatchedSim, SimConfig, default_ctl
+    from madsim_tpu.tpu import nemesis as tn
+    from madsim_tpu.tpu.spec import pool_kw_for
+    from madsim_tpu.tpu.wal import make_wal_spec
+
+    spec = make_wal_spec(4)
+    cfg = tn.compile_plan(plan, SimConfig(
+        horizon_us=HORIZON_US,
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+    ))
+    sim = BatchedSim(spec, cfg, triage=True)
+    full_ctl = default_ctl(1, HORIZON_US)
+    supp_ctl = full_ctl._replace(
+        occ=full_ctl.occ.at[:, OCC_ROW["disk"]].set(0b1)
+    )
+    compared = tn.assert_device_matches_schedule(
+        sim, plan, 7, horizon_us=HORIZON_US,
+        ctl=supp_ctl, occ_off={"disk": 0b1},
+    )
+    assert compared > 0
+
+    # purity across clauses: the surviving streams are bit-identical to
+    # the full run's — suppression did not shift anyone's draws
+    full = tn.device_chaos_events(
+        sim, 7, max_steps=40_000, horizon_us=HORIZON_US, ctl=full_ctl
+    )
+    supp = tn.device_chaos_events(
+        sim, 7, max_steps=40_000, horizon_us=HORIZON_US, ctl=supp_ctl
+    )
+    assert [t for t in supp if t[1] in ("crash", "restart")] == [
+        t for t in full if t[1] in ("crash", "restart")
+    ]
+    assert [t for t in supp if t[1] in DISK_KINDS] == tn.schedule_tuples(
+        [e for e in evs if e.kind in DISK_KINDS and e.k != 0],
+        HORIZON_US,
+    )
+
+
 def test_atom_universe_enumeration():
     from madsim_tpu.tpu import SimConfig
     from madsim_tpu.tpu import nemesis as tn

@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke reconfig-smoke durability-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke devloop-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke reconfig-smoke durability-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -36,6 +36,9 @@ campaign-smoke:  ## mini campaign: kill -> resume fingerprint match, dedup, merg
 
 refill-smoke:    ## continuous batching: >=90% occupancy on a 10x horizon-spread mix, dispatch budget, bit-identity (<60s)
 	$(PY) benches/refill_smoke.py
+
+devloop-smoke:   ## device-resident search (r19): host/device fingerprint bit-identity, <=1 sync per window, dispatch budget (<60s)
+	$(PY) benches/devloop_smoke.py
 
 multichip-smoke: ## multi-chip fleet on the virtual 8-device mesh: refill bit-identity across device counts, >=0.9 per-device occupancy, >=6x lane-step scaling, federation fingerprint (<60s warm)
 	$(PY) -m pytest tests/test_multichip.py -q -m "chaos and not slow"

@@ -476,6 +476,30 @@ def bench_explore(lanes: int = 256, dispatches: int = 8) -> dict:
         sys.path.pop(0)
 
 
+def bench_devloop(lanes: int = 16, gens: int = 4, window: int = 2) -> dict:
+    """Host loop vs device-resident generation loop (r19): the same
+    search both ways on one shared sim — generations/s, blocking syncs
+    per generation (device budget: <= 1, one per window), total dispatch
+    counts, and report fingerprint equality (see
+    benches/explore_bench.devloop_ab, docs/explore.md)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "benches"))
+    try:
+        import explore_bench
+        import ttfb as ttfb_mod
+
+        factory, _ = ttfb_mod.PLANTED["raft_restamp"]
+        return explore_bench.devloop_ab(
+            factory(), lanes=lanes, gens=gens, window=window,
+        )
+    except Exception as e:  # noqa: BLE001 - diagnostics must not kill BENCH
+        return {"devloop_error": str(e)[:200]}
+    finally:
+        sys.path.pop(0)
+
+
 def bench_paxos(lanes: int, virtual_secs: float) -> dict:
     """Fourth device protocol: single-decree Paxos agreement under the
     full chaos battery (dueling proposers as the steady state)."""
@@ -679,6 +703,11 @@ def main() -> None:
         "--skip-tune", action="store_true",
         help="skip the default-vs-tuned A/B (BENCH `tuned` key)",
     )
+    parser.add_argument(
+        "--skip-devloop", action="store_true",
+        help="skip the host-vs-device generation-loop A/B "
+        "(BENCH `generations_per_s` key)",
+    )
     args = parser.parse_args()
 
     cpu = bench_cpu_baseline(args.cpu_seeds, args.virtual_secs, args.client_rate)
@@ -707,6 +736,7 @@ def main() -> None:
     )
     ttfb = {} if args.skip_ttfb else bench_ttfb()
     explore = {} if args.skip_explore else bench_explore()
+    devloop = {} if args.skip_devloop else bench_devloop()
     tuned = (
         {} if args.skip_tune
         else bench_tuned_ab(args.lanes, args.virtual_secs)
@@ -840,6 +870,19 @@ def main() -> None:
         # autotuner's win carried as a number — Tier-A dispatch knobs
         # only, per-seed results bit-identical across the A/B
         "tuned": tuned,
+        # host-vs-device generation loop (r19): the same search both
+        # ways — device budget is <= 1 blocking sync per generation
+        # (one per window) vs the host loop's decode every generation,
+        # report fingerprints bit-identical
+        "generations_per_s": devloop,
+        "devloop_dispatch_ratio": (
+            devloop.get("dispatch_ratio")
+            if isinstance(devloop, dict) else None
+        ),
+        "devloop_device_syncs_per_gen": (
+            devloop.get("device", {}).get("syncs_per_gen")
+            if isinstance(devloop, dict) else None
+        ),
         # telemetry span-site cost: wrapped vs bare dispatch loop on the
         # smoke workload (<2% pinned by tests/test_telemetry.py)
         "telemetry_overhead": telemetry_overhead,

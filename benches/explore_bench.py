@@ -150,6 +150,88 @@ def explore_vs_uniform(
     return row
 
 
+def devloop_ab(
+    workload, lanes: int = 16, gens: int = 4, window: int = 2,
+    meta_seed: int = 0, seen_cap: int = 1 << 12,
+) -> dict:
+    """Host loop vs device-resident loop (r19, docs/explore.md) on ONE
+    shared sim: the same search run both ways, reporting the
+    hardware-independent dispatch economics —
+
+      * host syncs (blocking decodes): 1/generation on the host loop
+        (`refill_results`) vs 1/WINDOW on the device loop
+        (`devloop_results`, `syncs_per_gen <= 1` by construction);
+      * device dispatches (init + segments + early-stop reductions,
+        `sim.dispatch_count`): the device loop runs whole windows as one
+        chain, so its total is strictly below the host loop's;
+      * `generations_per_s`, warm (each side runs once cold for compile,
+        then once timed) — wall follows the sync count once the tunnel
+        RTT dominates, so on CPU this is a sanity number, on TPU the
+        claim;
+
+    and `fingerprint_match`: the two faces' reports must be
+    bit-identical (the tentpole's acceptance contract)."""
+    from madsim_tpu.explore import Explorer
+    from madsim_tpu.tpu import engine as eng
+    from madsim_tpu.tpu.engine import BatchedSim, make_devloop_plan
+
+    plan = make_devloop_plan(
+        workload.config, pop=lanes, top_k=16, seen_cap=seen_cap,
+    )
+    sim = BatchedSim(
+        workload.spec, workload.config, triage=True, coverage=True,
+        devloop=plan,
+    )
+
+    def run(device: bool) -> dict:
+        decodes = [0]
+        real_r, real_d = eng.refill_results, eng.devloop_results
+
+        def counted(real):
+            def f(st):
+                decodes[0] += 1
+                return real(st)
+            return f
+
+        eng.refill_results = counted(real_r)
+        eng.devloop_results = counted(real_d)
+        try:
+            ex = Explorer(
+                workload, meta_seed=meta_seed, lanes=lanes, chunk=lanes,
+                shrink_violations=False, seen_cap=seen_cap, sim=sim,
+                device_loop=device, device_window=window,
+            )
+            d0 = sim.dispatch_count
+            t0 = time.perf_counter()
+            rep = ex.run(gens)
+            wall = time.perf_counter() - t0
+        finally:
+            eng.refill_results, eng.devloop_results = real_r, real_d
+        return {
+            "dispatches": sim.dispatch_count - d0,
+            "syncs": decodes[0],
+            "syncs_per_gen": round(decodes[0] / gens, 3),
+            "generations_per_s": round(gens / max(wall, 1e-9), 2),
+            "wall_s": round(wall, 3),
+            "fingerprint": rep.fingerprint(),
+        }
+
+    run(False), run(True)  # cold pass: compiles land outside the timing
+    host, dev = run(False), run(True)
+    fp_match = host.pop("fingerprint") == dev.pop("fingerprint")
+    return {
+        "lanes": lanes,
+        "generations": gens,
+        "window": window,
+        "host": host,
+        "device": dev,
+        "fingerprint_match": fp_match,
+        "dispatch_ratio": round(
+            host["dispatches"] / max(dev["dispatches"], 1), 2
+        ),
+    }
+
+
 def explore_all(
     lanes: int = 256, dispatches: int = 8, meta_seed: int = 0,
     shrink: bool = True, max_shrinks: "int | None" = 8,
@@ -178,7 +260,25 @@ def main() -> None:
     parser.add_argument("--meta-seed", type=int, default=0)
     parser.add_argument("--no-shrink", action="store_true")
     parser.add_argument("--max-shrinks", type=int, default=8)
+    parser.add_argument(
+        "--devloop", action="store_true",
+        help="run the host-vs-device generation-loop A/B instead "
+        "(dispatch counts, syncs/gen, generations/s — docs/explore.md)",
+    )
+    parser.add_argument("--window", type=int, default=2)
     args = parser.parse_args()
+    if args.devloop:
+        import ttfb
+
+        factory, _ = ttfb.PLANTED["raft_restamp"]
+        print(
+            json.dumps(devloop_ab(
+                factory(), lanes=args.lanes, gens=args.dispatches,
+                window=args.window, meta_seed=args.meta_seed,
+            )),
+            flush=True,
+        )
+        return
     print(
         json.dumps(explore_all(
             args.lanes, args.dispatches, meta_seed=args.meta_seed,

@@ -72,9 +72,19 @@ ALL_RULES = JAXPR_RULES + LINT_RULES
 # them, and the key funnel must not leak into them) and lane
 # independence of the edge-ring bookkeeping (the eid prefix count runs
 # over the NODE axis, never lanes).
+# "raft-devloop" traces the device-resident search partition (r19,
+# docs/explore.md): the refill step PLUS the in-jit generation boundary
+# — corpus-ring fold/rank, MetaRng mutation, dedup, respawn — so every
+# rule gates the mutator too. Notably rng-taint (the boundary's meta-key
+# draws and ring scatters must never fold a lane's schedule-key chain —
+# the `leaky_ring` planted fixture pins the detector), lane independence
+# (the fire predicate's reduce_and is the ONLY new lane coupling,
+# allowlisted by exact primitive name), donation (const must be EMPTY:
+# the boundary rewrites even the admission queue), and range (ring/seen
+# cursor bounds via engine.interval_hints(devloop=True)).
 WORKLOADS = (
     "raft", "kv", "paxos", "twopc", "chain", "isr", "lease", "wal",
-    "raft-refill", "raft-refill-sharded", "raft-lineage",
+    "raft-refill", "raft-refill-sharded", "raft-lineage", "raft-devloop",
 )
 
 

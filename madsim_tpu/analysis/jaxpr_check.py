@@ -87,6 +87,14 @@ REFILL_ADMISSIONS = 29
 # refill step remains subject to the lane rule.
 REFILL_LANE_ALLOW = ("cumsum", "reduce_sum", "reduce_or")
 
+# the device-loop step adds ONE lane-axis primitive on top of the refill
+# set: the generation-boundary fire predicate `jnp.all(done)` (engine
+# `_devloop_apply`) lowers to reduce_and. Like the refill reductions it
+# couples lanes only in WHEN the boundary fires — never inside any
+# admission's trajectory, which the devloop bit-identity tests pin
+# against the host loop lane by lane.
+DEVLOOP_LANE_ALLOW = REFILL_LANE_ALLOW + ("reduce_and",)
+
 # cross-device collective primitives: the multi-chip determinism contract
 # (docs/multichip.md) says the shard_map'd refill segment contains ZERO of
 # these — each device owns its sub-queue/lanes/result buffers and gathers
@@ -174,18 +182,23 @@ def spec_factories() -> Dict[str, object]:
 
 def build_verified_sim(
     name: str, lanes: int = LANES, refill: bool = False,
-    lineage: bool = False,
+    lineage: bool = False, devloop: bool = False,
 ):
     """(sim, state, hot, cold, const) — all abstract (ShapeDtypeStructs).
 
     `state` is the eval_shape of the real `_init` (or, with `refill`, of
     the real `init_refill` with a REFILL_ADMISSIONS-deep queue — the
-    continuous-batching carry partition; with `lineage`, of the causal-
-    lineage carry); hot/cold/const the real `split_state` partition.
-    Nothing touches a device."""
+    continuous-batching carry partition; with `devloop`, of the real
+    `init_devloop` — the device-resident search partition, whose step
+    additionally contains the whole generation boundary: fold, rank,
+    mutate, respawn; with `lineage`, of the causal-lineage carry);
+    hot/cold/const the real `split_state` partition. Nothing touches a
+    device."""
     from ..nemesis import OCC_CLAUSES, RATE_CLAUSES
     from ..tpu import nemesis as tpun
-    from ..tpu.engine import BatchedSim, TriageCtl, split_state
+    from ..tpu.engine import (
+        BatchedSim, TriageCtl, make_devloop_plan, split_state,
+    )
     from ..tpu.spec import SimConfig
 
     factories = spec_factories()
@@ -202,9 +215,21 @@ def build_verified_sim(
             buggify_delay_rate=0.01,  # straggler side pool in the program
         ),
     )
-    sim = BatchedSim(spec, cfg, triage=True, coverage=True, lineage=lineage)
+    plan = None
+    if devloop:
+        # trace capacities: the population reuses the REFILL_ADMISSIONS
+        # prime (same queue axis, same role); ring/seen/window sizes are
+        # small distinct values none of which equals LANES, so the lane
+        # rule keeps identifying the lane axis by shape alone
+        plan = make_devloop_plan(
+            cfg, pop=REFILL_ADMISSIONS, top_k=7, seen_cap=64,
+        )
+    sim = BatchedSim(
+        spec, cfg, triage=True, coverage=True, lineage=lineage,
+        devloop=plan,
+    )
     seeds = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
-    if refill:
+    if refill or devloop:
         A = REFILL_ADMISSIONS
         qseeds = jax.ShapeDtypeStruct((A,), jnp.uint32)
         qctl = TriageCtl(
@@ -216,9 +241,17 @@ def build_verified_sim(
             h_epoch=jax.ShapeDtypeStruct((A,), jnp.int32),
             h_off=jax.ShapeDtypeStruct((A,), jnp.int32),
         )
-        state = jax.eval_shape(
-            lambda s, c: sim.init_refill(s, lanes, c), qseeds, qctl,
-        )
+        if devloop:
+            # window G=2: the smallest shape that exercises BOTH boundary
+            # branches (next_gen on gen 0, window_done on gen G-1)
+            state = jax.eval_shape(
+                lambda s, c: sim.init_devloop(s, lanes, c, window=2),
+                qseeds, qctl,
+            )
+        else:
+            state = jax.eval_shape(
+                lambda s, c: sim.init_refill(s, lanes, c), qseeds, qctl,
+            )
     else:
         state = jax.eval_shape(sim._init, seeds)
     hot, cold, const = split_state(state)
@@ -251,6 +284,26 @@ REFILL_NEUTRAL = frozenset({
     "const.queue.seeds", "cold.refill.cursor", "cold.refill.admitted",
 })
 
+# device-loop search cursors: the same schedule-root argument extended to
+# the in-jit generation boundary. The queue seed column now RIDES THE
+# CARRY (the boundary rewrites it from the mutated ring, so it is
+# hot.queue.seeds on this partition), and the boundary derives the next
+# generation's seeds from the MetaRng cursor (meta_key/counter — the
+# host MetaRng's murmur chain, deliberately disjoint from every lane's
+# schedule key), the fresh-seed counter, and the corpus ring's seed
+# column + row count (parent picks gather through them). All of these
+# decide WHICH work runs next, never how any admission's trajectory
+# unfolds — exactly the refill-queue argument. Everything else in the
+# DevLoop carry (ring ctl rows, novelty bits, coverage union, dedup
+# hashes, archives) stays STATE: those values flow into ctl rows and
+# result buffers, and the rng-taint rule must keep proving they never
+# reach a schedule mix.
+DEVLOOP_NEUTRAL = frozenset({
+    "hot.queue.seeds",
+    "cold.loop.meta_key", "cold.loop.counter", "cold.loop.next_fresh",
+    "cold.loop.ring_n", "cold.loop.ring_seed",
+})
+
 
 def _invar_masks(names: Sequence[str], time_leaves: Set[str]) -> List[int]:
     masks = []
@@ -259,7 +312,7 @@ def _invar_masks(names: Sequence[str], time_leaves: Set[str]) -> List[int]:
             masks.append(KEY)
         elif n in KEYCHAIN_LEAVES:
             masks.append(KEY2)
-        elif n in NEUTRAL_LEAVES or n in REFILL_NEUTRAL:
+        elif n in NEUTRAL_LEAVES or n in REFILL_NEUTRAL or n in DEVLOOP_NEUTRAL:
             masks.append(0)
         elif n in time_leaves:
             masks.append(STATE | TIME)
@@ -613,14 +666,19 @@ def check_run_carry(
 def check_donation(sim, state, hot, cold, const, where: str = "step") -> RuleResult:
     """Donated/aliased carry coverage + the hot/cold/const structural split.
 
-    Two partitions are legal (engine.split_state): the plain sweep's
-    const = {key0, ctl, skew_ppm}, and the refill sweep's inverted split
-    — key0/ctl/skew IN the carry (a refilled lane rewrites them from its
-    new admission) with the admission queue as the only const. Which one
-    applies is read off the state's own structure."""
+    Three partitions are legal (engine.split_state): the plain sweep's
+    const = {key0, ctl, skew_ppm}; the refill sweep's inverted split —
+    key0/ctl/skew IN the carry (a refilled lane rewrites them from its
+    new admission) with the admission queue as the only const; and the
+    device-loop sweep, where NOTHING is loop-invariant — the generation
+    boundary rewrites even the admission queue from the mutated corpus
+    ring, so the queue rides the carry, the DevLoop search state rides
+    cold, and const is EMPTY. Which one applies is read off the state's
+    own structure."""
     from ..tpu.engine import carry_partition
 
     res = RuleResult("donation")
+    devloop = getattr(state, "loop", None) is not None
     refill = state.refill is not None
     # the engine's own introspection hook IS the name source: if the
     # split and the hook ever disagree, this rule is checking the wrong
@@ -631,7 +689,35 @@ def check_donation(sim, state, hot, cold, const, where: str = "step") -> RuleRes
     const_names = [f"const.{n}" for n in part["const"]]
 
     res.checked += 1
-    if refill:
+    if devloop:
+        # (1'') device-loop structural split: const is EMPTY (everything
+        # the boundary can rewrite must be donated), the queue seed/ctl
+        # rows ride hot (the boundary respawns them from the ring), and
+        # the DevLoop search carry rides cold
+        if const_names:
+            res.add(
+                where,
+                "device-loop const must be empty — the generation "
+                f"boundary rewrites everything, but found {const_names}",
+            )
+        if "hot.queue.seeds" not in hot_names:
+            res.add(
+                where,
+                "device-loop carry without hot.queue.seeds — the "
+                "boundary cannot respawn the next generation's queue",
+            )
+        if "hot.key0" not in hot_names:
+            res.add(
+                where,
+                "device-loop carry without hot.key0 — a respawned lane "
+                "cannot adopt its admission's schedule root",
+            )
+        if not any(n.startswith("cold.loop.") for n in cold_names):
+            res.add(
+                where,
+                "device-loop state without cold.loop.* DevLoop leaves",
+            )
+    elif refill:
         # (1') refill structural split: the queue is const, the (now
         # per-admission) key0/ctl ride the carry, and no queue leaf may
         # leak into the donated carry
@@ -726,6 +812,7 @@ class WorkloadTrace:
     invars_avals: List[Any]
     time_leaves: Set[str]
     refill: bool = False  # tracing the continuous-batching partition?
+    devloop: bool = False  # tracing the device-resident search partition?
     sharded: bool = False  # also tracing the shard_map'd segment?
     closed_sharded: Any = None  # jaxpr of the multi-chip segment program
 
@@ -750,6 +837,8 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
     base = name[: -len("-sharded")] if sharded else name
     refill = base.endswith("-refill")
     base = base[: -len("-refill")] if refill else base
+    devloop = base.endswith("-devloop")
+    base = base[: -len("-devloop")] if devloop else base
     lineage = base.endswith("-lineage")
     base = base[: -len("-lineage")] if lineage else base
     if sharded and not refill:
@@ -759,7 +848,7 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
     if log:
         log(f"[analysis] tracing {name} step program (L={lanes}) ...")
     sim, state, hot, cold, const = build_verified_sim(
-        base, lanes=lanes, refill=refill, lineage=lineage,
+        base, lanes=lanes, refill=refill, lineage=lineage, devloop=devloop,
     )
     closed_sharded = None
     if sharded:
@@ -778,7 +867,8 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
         )(stacked)
     trace = _finish_trace(
         sim, state, hot, cold, const, name=name, lanes=lanes,
-        refill=refill, sharded=sharded, closed_sharded=closed_sharded,
+        refill=refill, devloop=devloop, sharded=sharded,
+        closed_sharded=closed_sharded,
     )
     _TRACE_CACHE[key] = trace
     return trace
@@ -786,7 +876,8 @@ def get_trace(name: str, lanes: int = LANES, log=None) -> WorkloadTrace:
 
 def _finish_trace(
     sim, state, hot, cold, const, name: str, lanes: int,
-    refill: bool = False, sharded: bool = False, closed_sharded=None,
+    refill: bool = False, devloop: bool = False, sharded: bool = False,
+    closed_sharded=None,
 ) -> WorkloadTrace:
     """The shared trace-construction tail (abstract jaxprs + leaf-name
     registries) over an already-built sim/state partition — split out of
@@ -819,6 +910,7 @@ def _finish_trace(
         ),
         time_leaves=_time_leaves(sim),
         refill=refill,
+        devloop=devloop,
         sharded=sharded,
         closed_sharded=closed_sharded,
     )
@@ -885,7 +977,11 @@ def verify_workload(
     if on("lane-independence"):
         results.append(check_lane_independence(
             closed, trace.lanes, where,
-            allow=REFILL_LANE_ALLOW if trace.refill else (),
+            allow=(
+                DEVLOOP_LANE_ALLOW if trace.devloop
+                else REFILL_LANE_ALLOW if trace.refill
+                else ()
+            ),
         ))
         if trace.sharded:
             # the multi-chip face of the same rule: the whole shard_map'd

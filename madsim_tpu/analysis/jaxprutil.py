@@ -145,9 +145,13 @@ class TaintMap:
     map back to the call's outvars (joined across cond branches). The
     cond PREDICATE deliberately does not fold into the outputs: control
     dependence does not launder data taint, the same principle as the
-    TIME strip at bools. Loop primitives (while/scan) keep the
-    conservative union seeding iterated to a fixpoint — their carries
-    genuinely re-enter. All sub-jaxpr equations are visited too.
+    TIME strip at bools. `while` carries are seeded per-slot and
+    iterated to a fixpoint against the body's own outputs (r19 — the
+    device-loop boundary's sequential fold/mutate loops carry schedule
+    roots next to ctl rows, and the old whole-carry union drowned them);
+    `scan` (what a static-trip-count fori_loop lowers to) is handled the
+    same way, with the stacked ys joined across fixpoint passes. All
+    sub-jaxpr equations are visited too.
     """
 
     def __init__(
@@ -264,6 +268,83 @@ class TaintMap:
                     ]
                 self._set_outs(eqn, outs or [])
                 continue
+            # precise while handling (r19): 1:1 carry seeding iterated
+            # to a fixpoint. The old conservative union made every carry
+            # slot of a sequential loop carry every OTHER slot's taint —
+            # sound, but it damned the device-loop generation boundary,
+            # whose corpus-fold/mutate fori_loops legitimately carry
+            # schedule-root seeds NEXT TO ctl rows and coverage words in
+            # one carry. Per-slot masks joined with the body's own
+            # outputs per pass model exactly how a while carry re-enters;
+            # real cross-slot flows still propagate (they appear in the
+            # body's dataflow), so nothing is laundered. The cond jaxpr
+            # produces only the loop predicate (a bool — control, not
+            # value, flow) but is still walked for visit() coverage.
+            if name == "while" and {
+                "cond_nconsts", "body_nconsts", "cond_jaxpr", "body_jaxpr",
+            } <= set(eqn.params):
+                cn = eqn.params["cond_nconsts"]
+                bn = eqn.params["body_nconsts"]
+                cj = eqn.params["cond_jaxpr"]
+                bj = eqn.params["body_jaxpr"]
+                in_masks = [self.read(iv) for iv in eqn.invars]
+                cconsts = in_masks[:cn]
+                bconsts = in_masks[cn:cn + bn]
+                carry = in_masks[cn + bn:]
+                if len(bj.jaxpr.invars) == bn + len(carry) and len(
+                    bj.jaxpr.outvars
+                ) == len(carry):
+                    # bounded: masks only grow in a 5-bit lattice
+                    for _ in range(8):
+                        outs = self._call_sub(
+                            bj.jaxpr, tuple(bj.consts),
+                            bconsts + carry, visit,
+                        )
+                        new = [a | b for a, b in zip(carry, outs)]
+                        if new == carry:
+                            break
+                        carry = new
+                    if len(cj.jaxpr.invars) == cn + len(carry):
+                        self._call_sub(
+                            cj.jaxpr, tuple(cj.consts),
+                            cconsts + carry, visit,
+                        )
+                    self._set_outs(eqn, carry)
+                    continue
+            # scan gets the same precise treatment (a static-trip-count
+            # fori_loop lowers to scan, so the device-loop boundary's
+            # sequential fold/mutate loops arrive HERE): consts stay
+            # fixed, the carry slots iterate to a fixpoint against the
+            # body's carry outputs, the stacked ys join across passes
+            if name == "scan" and {
+                "num_consts", "num_carry", "jaxpr",
+            } <= set(eqn.params):
+                nc = eqn.params["num_consts"]
+                nk = eqn.params["num_carry"]
+                bj = eqn.params["jaxpr"]
+                in_masks = [self.read(iv) for iv in eqn.invars]
+                consts = in_masks[:nc]
+                carry = in_masks[nc:nc + nk]
+                xs = in_masks[nc + nk:]
+                if len(bj.jaxpr.invars) == len(in_masks) and len(
+                    bj.jaxpr.outvars
+                ) >= nk:
+                    ys: Optional[List[int]] = None
+                    for _ in range(8):
+                        outs = self._call_sub(
+                            bj.jaxpr, tuple(bj.consts),
+                            consts + carry + xs, visit,
+                        )
+                        youts = outs[nk:]
+                        ys = youts if ys is None else [
+                            a | b for a, b in zip(ys, youts)
+                        ]
+                        new = [a | b for a, b in zip(carry, outs[:nk])]
+                        if new == carry:
+                            break
+                        carry = new
+                    self._set_outs(eqn, carry + (ys or []))
+                    continue
             m = 0
             for iv in eqn.invars:
                 m |= self.read(iv)

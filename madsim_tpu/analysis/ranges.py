@@ -1132,7 +1132,11 @@ def step_seeds(
     from ..tpu.spec import HardCap, RateFloor
 
     sim = trace.sim
-    hints = interval_hints(sim, refill=getattr(trace, "refill", False))
+    hints = interval_hints(
+        sim,
+        refill=getattr(trace, "refill", False),
+        devloop=getattr(trace, "devloop", False),
+    )
     kinds = classify_narrow(sim.spec)
     floors = dict(sim.spec.rate_floors or {})
 

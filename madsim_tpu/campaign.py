@@ -544,6 +544,13 @@ def explorer_params(ex: Explorer) -> Dict[str, Any]:
         "top_k": ex.top_k,
         "swarm_group": ex.swarm_group,
         "pipeline": ex.pipeline,
+        # device-resident search (r19): dispatch-shape knobs like
+        # pipeline — corpus/fingerprints are bit-identical across them,
+        # but resume replays the mode so throughput (and the dispatch
+        # budget) matches the uninterrupted run
+        "device_loop": ex.device_loop,
+        "device_window": ex.device_window,
+        "seen_cap": ex.seen_cap,
     }
 
 
@@ -918,7 +925,8 @@ class Campaign:
             tuning=man_tuning,
             explorer_kwargs={
                 k: params[k] for k in
-                ("fresh_frac", "mutant_frac", "top_k", "swarm_group")
+                ("fresh_frac", "mutant_frac", "top_k", "swarm_group",
+                 "device_loop", "device_window", "seen_cap")
                 if k in params
             },
         )

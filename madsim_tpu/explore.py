@@ -54,6 +54,16 @@ import numpy as np
 
 from . import telemetry
 from .nemesis import (
+    GENOME_H1,
+    GENOME_H2,
+    # the explorer's single meta-draw site on the shared murmur3 chain
+    # (a site is a namespace — unique across nemesis.py/engine draw
+    # sites) and the island-seed derivation site: canonical in
+    # nemesis.py since r19 so the device-loop mirror (tpu/engine.py)
+    # imports them without importing this host-side module; re-exported
+    # here under their historical names
+    META_SITE_DRAW,
+    META_SITE_ISLAND,
     OCC_CLAUSES,
     OCC_ROW,
     RATE_CLAUSES,
@@ -64,15 +74,8 @@ from .nemesis import (
     fold32,
     key_from_seed,
     mix32,
+    mutation_vocab,
 )
-
-# the explorer's single meta-draw site on the shared murmur3 chain (a site
-# is a namespace — keep unique across nemesis.py/engine draw sites)
-META_SITE_DRAW = 301
-# island-seed derivation site (Federation): island i's MetaRng root is
-# bits32(key_from_seed(meta_seed), META_SITE_ISLAND, i) — the whole
-# federation stays a pure function of ONE meta-seed
-META_SITE_ISLAND = 302
 
 
 def island_meta_seed(meta_seed: int, island: int) -> int:
@@ -134,6 +137,29 @@ def canon_genome(key) -> tuple:
         int(seed), int(off), tuple(int(v) for v in occ),
         tuple(float(v) for v in rs), int(h),
     )
+
+
+def genome_hash64(key) -> Tuple[int, int]:
+    """(h1, h2) — the 64-bit genome-dedup hash, HOST face.
+
+    Two independent fold chains (nemesis.GENOME_H1/H2) over the genome's
+    canonical u32 words: seed, clause-off mask, each occ row, each rate
+    scale's IEEE-754 f32 bit pattern, raw horizon. Bit-exact with the
+    device face (`tpu.nemesis.genome_hash64`) — the device loop's
+    seen-table membership and the host `_seen_h` set must make the SAME
+    dedup decision for every genome, so a hash collision (the only
+    divergence a hash set can introduce vs the exact-key set) hits both
+    faces identically. The both-faces mirror test pins this."""
+    seed, off, occ, rs, h = canon_genome(key)
+    words = [seed & 0xFFFFFFFF, off & 0xFFFFFFFF]
+    words += [v & 0xFFFFFFFF for v in occ]
+    words += [int(np.float32(v).view(np.uint32)) for v in rs]
+    words.append(h & 0xFFFFFFFF)
+    h1, h2 = GENOME_H1, GENOME_H2
+    for w in words:
+        h1 = fold32(h1, w)
+        h2 = fold32(h2, w)
+    return mix32(h1), mix32(h2)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -497,6 +523,9 @@ class Explorer:
         refill: bool = True,
         refill_lanes: Optional[int] = None,
         dispatch_steps: Optional[int] = None,
+        device_loop: bool = False,
+        device_window: int = 8,
+        seen_cap: int = 1 << 17,
         sim=None,
         log: Optional[Callable[[str], None]] = None,
         tuning: Any = None,
@@ -567,6 +596,16 @@ class Explorer:
         # the chunked reference loop.
         self.refill = bool(refill)
         self.refill_lanes = None if refill_lanes is None else int(refill_lanes)
+        # device-resident search (r19, docs/explore.md): run() executes
+        # WINDOWS of up to `device_window` generations as one dispatch
+        # chain — ranking, mutation and admission all in-jit — and syncs
+        # the host corpus once per window from the decoded archives. The
+        # search identity is UNCHANGED: corpus contents, curves and
+        # fingerprints are bit-identical to the host loop (tested), the
+        # host replays each window's populations as a standing oracle.
+        self.device_loop = bool(device_loop)
+        self.device_window = max(1, int(device_window))
+        self.seen_cap = int(seen_cap)
         self.say = log or (lambda msg: None)
 
         # ONE sim serves search, shrink and replay: triage threads the ctl
@@ -574,13 +613,45 @@ class Explorer:
         # `sim` accepts a pre-built BatchedSim(triage=True, coverage=True)
         # so a campaign resume (or a test suite) amortizes the compile.
         if sim is None:
+            devloop_plan = None
+            if self.device_loop:
+                from .tpu.engine import make_devloop_plan
+
+                devloop_plan = make_devloop_plan(
+                    self.cfg, pop=self.lanes, top_k=int(top_k),
+                    seen_cap=self.seen_cap,
+                    fresh_frac=float(fresh_frac),
+                    mutant_frac=float(mutant_frac),
+                    swarm_group=max(1, int(swarm_group)),
+                    fresh_stride=max(1, int(fresh_stride)),
+                )
             sim = BatchedSim(
-                workload.spec, self.cfg, triage=True, coverage=True
+                workload.spec, self.cfg, triage=True, coverage=True,
+                devloop=devloop_plan,
             )
         elif not (sim.triage and sim.coverage):
             raise ValueError(
                 "Explorer needs a BatchedSim(..., triage=True, coverage=True)"
             )
+        if self.device_loop:
+            plan = getattr(sim, "devloop", None)
+            if plan is None:
+                raise ValueError(
+                    "device_loop=True needs a BatchedSim built with "
+                    "devloop=make_devloop_plan(...)"
+                )
+            if (
+                plan.pop != self.lanes
+                or plan.top_k != int(top_k)
+                or plan.fresh_stride != max(1, int(fresh_stride))
+            ):
+                raise ValueError(
+                    "devloop plan disagrees with the explorer: plan "
+                    f"(pop={plan.pop}, top_k={plan.top_k}, "
+                    f"fresh_stride={plan.fresh_stride}) vs explorer "
+                    f"(lanes={self.lanes}, top_k={int(top_k)}, "
+                    f"fresh_stride={max(1, int(fresh_stride))})"
+                )
         self.sim = sim
         self._rng = MetaRng(self.meta_seed)
         self._next_fresh = int(first_seed)
@@ -591,33 +662,23 @@ class Explorer:
         self._fresh_stride = max(1, int(fresh_stride))
         self._full_h = int(self.cfg.horizon_us)
 
-        # the mutation vocabulary this config supports
-        cfg = self.cfg
-        self._sched = [
-            n for n in OCC_CLAUSES if getattr(cfg, f"nem_{n}_enabled")
-        ]
-        self._rate = [
-            n for n, on in (
-                ("loss", cfg.nem_loss_rate > 0),
-                ("dup", cfg.nem_dup_enabled),
-                ("reorder", cfg.nem_reorder_rate > 0),
-            ) if on
-        ]
-        self._togglable = list(self._sched) + list(self._rate)
-        if cfg.nem_skew_enabled:
-            self._togglable.append("skew")
-        if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
-            self._togglable.append("wipe")
-        # legacy trajectory-coupled chaos: clause-level toggles only
-        if cfg.chaos_enabled and "crash" not in self._togglable:
-            self._togglable.append("crash")
-        if cfg.partition_enabled and "partition" not in self._togglable:
-            self._togglable.append("partition")
+        # the mutation vocabulary this config supports — ONE derivation
+        # (nemesis.mutation_vocab) shared with the device-loop plan
+        # builder (engine.make_devloop_plan), so the two faces can never
+        # disagree about which clauses are mutable
+        self._sched, self._rate, self._togglable = mutation_vocab(self.cfg)
 
         # search state
         self.union = np.zeros((self._cov_words(),), np.uint32)
         self.corpus: List[CorpusEntry] = []
         self._seen: set = set()  # candidate genomes ever dispatched
+        # the CANONICAL dedup membership: 64-bit genome-hash pairs
+        # (genome_hash64). `_population` checks THIS set, not `_seen` —
+        # the device loop can only compare hashes, so the host must make
+        # the identical (hash-based) dedup decision for both paths to
+        # stay draw-for-draw aligned. `_seen` keeps the exact keys for
+        # snapshots and provenance.
+        self._seen_h: set = set()
         self._violated_seeds: set = set()
         self.violations: List[Dict[str, Any]] = []
         self.coverage_curve: List[int] = []
@@ -689,10 +750,28 @@ class Explorer:
                 off |= TRIAGE_BIT[name]
         return off
 
+    def _claim(self, cand: Candidate) -> None:
+        """Record a genome as dispatched in BOTH dedup faces: the exact
+        key set (snapshots/provenance) and the canonical hash-pair set
+        (the membership `_population` and the device loop check)."""
+        self._seen.add(cand.key())
+        self._seen_h.add(genome_hash64(cand.key()))
+
     def _population(self, gen: int) -> List[Candidate]:
         """The next generation's lanes. Generation 0 is ALL fresh seeds —
         identical to the uniform sweep's first chunk, so the explorer
-        never pays a steering tax before it has a signal to steer by."""
+        never pays a steering tax before it has a signal to steer by.
+
+        The mutant block is ONE draw schedule per slot: parent choice +
+        one `_mutate`, then the seen-check, then a draw-free fresh
+        fallback on a duplicate. No retry loop — a retry would consume a
+        data-dependent number of meta draws per slot, which is exactly
+        what the device loop cannot mirror with a fixed advance table
+        (engine `adv_of`); the counter-alignment test pins this. Exactly
+        ONE genome is claimed per slot (mutants at choice time — two
+        mutants of the same parent can draw identical ops WITHIN a
+        generation — fresh and swarm at population end), so the host
+        seen-set and the device seen-table grow in lockstep."""
         L = self.lanes
         parents = sorted(
             (e for e in self.corpus if e.new_bits > 0),
@@ -709,15 +788,11 @@ class Explorer:
             for _ in range(n_mut):
                 parent = self._rng.choice(parents).cand
                 cand = self._mutate(parent)
-                for _ in range(4):  # a duplicate genome re-runs nothing new
-                    if cand.key() not in self._seen:
-                        break
-                    cand = self._mutate(cand)
-                if cand.key() in self._seen:
+                if genome_hash64(cand.key()) in self._seen_h:
+                    # duplicate genome re-runs nothing new: fall back to
+                    # the next fresh seed (no draws consumed)
                     cand = self._fresh()
-                # claim the genome immediately: two mutants of the same
-                # parent can draw identical ops WITHIN this generation
-                self._seen.add(cand.key())
+                self._claim(cand)
                 pop.append(cand)
             while len(pop) < L:
                 off = self._swarm_off()
@@ -726,7 +801,7 @@ class Explorer:
                         self._fresh(), off=off, origin="swarm"
                     ))
         for c in pop:
-            self._seen.add(c.key())
+            self._claim(c)
         return pop
 
     # ------------------------------------------------------------ dispatch
@@ -908,15 +983,179 @@ class Explorer:
                 rec["shrink_error"] = f"{type(e).__name__}: {str(e)[:160]}"
         return rec
 
+    # ----------------------------------------------------- device window
+
+    def _run_device_window(self, window: int) -> None:
+        """Run `window` generations as ONE device-resident dispatch
+        chain (r19, docs/explore.md): the host builds the window's FIRST
+        population (sharing `_population` as the entry point), uploads
+        the search state — corpus top-K ring, coverage union, seen-hash
+        table, MetaRng cursor — and the jitted step folds, ranks,
+        mutates and re-admits every subsequent generation in-jit. The
+        window's single host sync decodes the per-generation archives,
+        which fold through the SAME `_fold_generation` path as the host
+        loop, so corpus contents, curves and fingerprints are
+        bit-identical.
+
+        The host then REPLAYS each interior generation's population from
+        its own MetaRng chain and asserts the device archived exactly
+        those genomes — plus final counter / fresh-cursor / union /
+        seen-count agreement — so any divergence between the two search
+        faces (a drifted mutation table, a dedup disagreement) fails
+        loudly at the first window instead of silently forking the
+        search. The replay is pure host arithmetic on a few hundred
+        candidates: no device work, no extra sync."""
+        from .tpu.engine import DEVLOOP_ORIGINS, devloop_results
+
+        window = int(window)
+        if not 1 <= window <= self.device_window:
+            raise ValueError(
+                f"window must be in [1, {self.device_window}], got {window}"
+            )
+        gen0 = self._gen
+        pop0 = self._population(gen0)
+
+        # upload faces of the host search state
+        parents = sorted(
+            (e for e in self.corpus if e.new_bits > 0),
+            key=lambda e: (-e.new_bits, e.dispatch),
+        )[: self.top_k]
+        ring = {
+            "n": len(parents),
+            "bits": [e.new_bits for e in parents],
+            "seed": [e.cand.seed for e in parents],
+            "off": [e.cand.off for e in parents],
+            "occ": [list(e.cand.occ_off) for e in parents],
+            "rate": [list(e.cand.rate_scale) for e in parents],
+            "h": [e.cand.horizon_us for e in parents],
+        }
+        # sorted upload: device membership is an order-independent masked
+        # compare over the valid prefix, so any enumeration order works —
+        # sorted makes the upload itself deterministic
+        seen_rows = sorted(self._seen_h)
+        seen = {
+            "n": len(seen_rows),
+            "h1": [h1 for h1, _ in seen_rows],
+            "h2": [h2 for _, h2 in seen_rows],
+        }
+        origin_of = {name: i for i, name in enumerate(DEVLOOP_ORIGINS)}
+        with telemetry.span("dispatch", site="explore-devloop", gen=gen0):
+            st = self.sim.init_devloop(
+                np.asarray([c.seed for c in pop0], np.uint32),
+                lanes=min(self.refill_lanes or self.chunk, len(pop0)),
+                ctl=self._ctl_for(pop0),
+                window=self.device_window,
+                step_cap=self.workload.max_steps,
+                meta_seed=self.meta_seed,
+                meta_counter=self._rng.counter,
+                next_fresh=self._next_fresh,
+                target_gens=window,
+                gen_h_raw=[c.horizon_us for c in pop0],
+                gen_origin=[origin_of[c.origin] for c in pop0],
+                ring=ring, union=self.union, seen=seen,
+            )
+            st = self.sim.run_devloop(
+                st, dispatch_steps=self.dispatch_steps
+            )
+        with telemetry.span("decode", site="explore-devloop", gen=gen0):
+            # devloop_results is the window's ONE host sync
+            res = devloop_results(st)
+        if res["gens_done"] != window:
+            raise RuntimeError(
+                f"device loop retired {res['gens_done']} generations, "
+                f"window asked for {window}"
+            )
+
+        pop = pop0
+        for g in range(window):
+            row = res["gens"][g]
+            self._check_window_gen(gen0 + g, pop, row)
+            self._fold_generation(gen0 + g, [(
+                pop,
+                np.asarray(row["bitmap"], np.uint32),
+                row["hiwater"], row["transitions"], row["violated"],
+            )])
+            self._gen += 1
+            if g + 1 < window:
+                # replay the device's next population from the host
+                # chain — fold FIRST (the device ranked gen g's novelty
+                # before mutating), then draw
+                pop = self._population(self._gen)
+        if telemetry.enabled():
+            telemetry.record_explore_devloop(self, res, window)
+        self._check_window_end(res)
+
+    def _check_window_gen(self, gen: int, pop: List[Candidate], row) -> None:
+        """Oracle: the device archived EXACTLY the population the host
+        (re)built for this generation — genomes, origins, row order."""
+        from .tpu.engine import DEVLOOP_ORIGINS
+
+        got = [
+            (
+                int(row["seed"][i]), int(row["off"][i]),
+                tuple(int(v) for v in row["occ"][i]),
+                tuple(round(float(v), 6) for v in row["rate"][i]),
+                int(row["h"][i]),
+                DEVLOOP_ORIGINS[int(row["origin"][i])],
+            )
+            for i in range(len(pop))
+        ]
+        want = [
+            (
+                c.seed, c.off, tuple(int(v) for v in c.occ_off),
+                tuple(round(float(v), 6) for v in c.rate_scale),
+                c.horizon_us, c.origin,
+            )
+            for c in pop
+        ]
+        for i, (g, w) in enumerate(zip(got, want)):
+            if g != w:
+                raise RuntimeError(
+                    f"device-loop divergence at generation {gen}, "
+                    f"admission {i}: device archived {g}, host replay "
+                    f"built {w} — the two search faces drifted"
+                )
+
+    def _check_window_end(self, res: Dict[str, Any]) -> None:
+        """Oracle: after the window, the device cursors and coverage
+        union landed exactly where the host replay did."""
+        checks = (
+            ("meta counter", res["counter"], self._rng.counter),
+            ("next_fresh", res["next_fresh"],
+             self._next_fresh & 0xFFFFFFFF),
+            ("seen rows", res["seen_n"], len(self._seen_h)),
+        )
+        for name, dev, host in checks:
+            if int(dev) != int(host):
+                raise RuntimeError(
+                    f"device-loop divergence: {name} is {dev} on device, "
+                    f"{host} on the host replay"
+                )
+        if not np.array_equal(res["union"], self.union):
+            raise RuntimeError(
+                "device-loop divergence: coverage union mismatch after "
+                "the window"
+            )
+
     # ----------------------------------------------------------------- run
 
     def run(self, dispatches: int) -> ExploreReport:
-        """Run `dispatches` generations (cumulative across calls)."""
+        """Run `dispatches` generations (cumulative across calls). With
+        `device_loop=True` the generations run in device-resident
+        windows of up to `device_window` (one dispatch chain + one host
+        sync each); otherwise one host-ranked dispatch per generation."""
         t0 = time.perf_counter()
-        for _ in range(int(dispatches)):
-            gen = self._gen
-            self._run_generation(gen, self._population(gen))
-            self._gen += 1
+        if self.device_loop:
+            remaining = int(dispatches)
+            while remaining > 0:
+                w = min(remaining, self.device_window)
+                self._run_device_window(w)
+                remaining -= w
+        else:
+            for _ in range(int(dispatches)):
+                gen = self._gen
+                self._run_generation(gen, self._population(gen))
+                self._gen += 1
         self._wall_s += time.perf_counter() - t0
         return self.report()
 
@@ -1011,6 +1250,9 @@ class Explorer:
         self.violation_curve = [int(v) for v in snap["violation_curve"]]
         self.corpus = [CorpusEntry.from_dict(d) for d in snap["corpus"]]
         self._seen = {canon_genome(g) for g in snap["seen"]}
+        # the hash-pair face is derived state: rebuild it from the exact
+        # keys (snapshots never carry it, so old checkpoints stay loadable)
+        self._seen_h = {genome_hash64(g) for g in self._seen}
         self._violated_seeds = {int(s) for s in snap["violated_seeds"]}
         self.violations = [dict(v) for v in snap["violations"]]
         for v in self.violations:
@@ -1061,6 +1303,8 @@ class Federation:
         shrink_violations: bool = False,
         max_shrinks: Optional[int] = None,
         shrink_kwargs: Optional[Dict[str, Any]] = None,
+        device_loop: bool = False,
+        device_window: int = 8,
         sim=None,
         log: Optional[Callable[[str], None]] = None,
         **island_kwargs,
@@ -1083,10 +1327,37 @@ class Federation:
         self.refill_lanes = (
             self.lanes if refill_lanes is None else int(refill_lanes)
         )
+        # device-resident islands (r19): each island runs its
+        # generations in in-jit windows (Explorer.device_loop), windows
+        # CLIPPED to exchange boundaries so an exchange always sees
+        # fully folded corpora. Windows dispatch sequentially per island
+        # through the one shared sim — an exchange is host work between
+        # windows either way, and per-island results are bit-identical
+        # to the host loop, so the federation fingerprint stays pinned
+        # across device counts exactly like the refill paths.
+        self.device_loop = bool(device_loop)
+        self.device_window = max(1, int(device_window))
         self.say = log or (lambda msg: None)
         if sim is None:
+            devloop_plan = None
+            if self.device_loop:
+                from .tpu.engine import make_devloop_plan
+
+                devloop_plan = make_devloop_plan(
+                    workload.config, pop=self.lanes,
+                    top_k=int(island_kwargs.get("top_k", 16)),
+                    seen_cap=int(island_kwargs.get("seen_cap", 1 << 17)),
+                    fresh_frac=float(island_kwargs.get("fresh_frac", 0.5)),
+                    mutant_frac=float(
+                        island_kwargs.get("mutant_frac", 0.3)
+                    ),
+                    swarm_group=int(island_kwargs.get("swarm_group", 8)),
+                    # island i's fresh sub-queue: first_seed=i, stride=n
+                    fresh_stride=self.n_islands,
+                )
             sim = BatchedSim(
                 workload.spec, workload.config, triage=True, coverage=True,
+                devloop=devloop_plan,
             )
         elif not (sim.triage and sim.coverage):
             raise ValueError(
@@ -1108,6 +1379,8 @@ class Federation:
                 shrink_violations=shrink_violations,
                 max_shrinks=max_shrinks,
                 shrink_kwargs=shrink_kwargs,
+                device_loop=self.device_loop,
+                device_window=self.device_window,
                 sim=self.sim,
                 log=None,
                 **island_kwargs,
@@ -1206,14 +1479,17 @@ class Federation:
                 union |= e.bitmap
         bits = int(popcount_rows(union[None, :])[0]) if entries else 0
         seen = set()
+        seen_h = set()
         violated = set()
         for ex in self.islands:
             seen |= ex._seen
+            seen_h |= ex._seen_h
             violated |= ex._violated_seeds
         for ex in self.islands:
             ex.corpus = list(kept)
             ex.union = union.copy()
             ex._seen = set(seen)
+            ex._seen_h = set(seen_h)
             ex._violated_seeds = set(violated)
         self.exchanges.append({
             "generation": self._gen,
@@ -1230,11 +1506,27 @@ class Federation:
 
     def run(self, generations: int) -> Dict[str, Any]:
         """Run `generations` federated generations (cumulative across
-        calls), exchanging coverage every `exchange_every`."""
+        calls), exchanging coverage every `exchange_every`. Device-loop
+        islands run their generations in in-jit windows clipped to the
+        next exchange boundary, so exchanges land at the same
+        generations as the host loop — the exchange log (part of the
+        fingerprint) is identical between the two modes."""
         t0 = time.perf_counter()
-        for _ in range(int(generations)):
-            self._run_generation()
-            self._gen += 1
+        remaining = int(generations)
+        while remaining > 0:
+            if self.device_loop:
+                until = self.exchange_every - (
+                    self._gen % self.exchange_every
+                )
+                w = min(remaining, self.device_window, until)
+                for ex in self.islands:
+                    ex._run_device_window(w)
+                self._gen += w
+                remaining -= w
+            else:
+                self._run_generation()
+                self._gen += 1
+                remaining -= 1
             if self._gen % self.exchange_every == 0:
                 self._exchange()
         self._wall_s += time.perf_counter() - t0
@@ -1411,6 +1703,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         "chunk width); smaller = more refills per generation",
     )
     parser.add_argument(
+        "--device-loop", action="store_true",
+        help="run the generation loop DEVICE-RESIDENT (docs/explore.md):"
+        " novelty ranking, mutation and admission happen in-jit, the "
+        "host syncs once per window — same corpus, curves and "
+        "fingerprint as the host loop, bit for bit",
+    )
+    parser.add_argument(
+        "--device-window", type=int, default=8,
+        help="generations per device-resident window (the one host sync "
+        "amortizes over this many generations)",
+    )
+    parser.add_argument(
         "--islands", type=int, default=0,
         help="run an island-model FEDERATION of this many explorers "
         "(docs/multichip.md): per-island corpora + disjoint fresh-seed "
@@ -1450,6 +1754,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             mesh=mesh, refill_lanes=args.refill_lanes,
             shrink_violations=not args.no_shrink,
             max_shrinks=args.max_shrinks, shrink_kwargs=shrink_kwargs,
+            device_loop=args.device_loop,
+            device_window=args.device_window,
             log=None if args.json else lambda m: print(m, flush=True),
         )
         rep = fed.run(args.dispatches)
@@ -1474,6 +1780,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         max_shrinks=args.max_shrinks,
         shrink_kwargs=shrink_kwargs, pipeline=not args.no_pipeline,
         refill=not args.no_refill, refill_lanes=args.refill_lanes,
+        device_loop=args.device_loop, device_window=args.device_window,
         log=None if args.json else lambda m: print(m, flush=True),
     )
     report = ex.run(args.dispatches)

@@ -151,6 +151,25 @@ NET_SITE_NEM_LOSS = 8
 # the draw like any other ScheduleCoins value)
 NET_SITE_DISK_EXTENT = 9
 
+# the explorer's meta-rng sites (madsim_tpu/explore.py re-exports these;
+# they live HERE because the device-resident search loop draws the SAME
+# counter chain in-jit — tpu/engine.py's devloop mutation kernel and the
+# host MetaRng must agree on the site the way every nemesis draw does)
+META_SITE_DRAW = 301    # MetaRng draw i = bits32(key_from_seed(s), 301, i)
+META_SITE_ISLAND = 302  # federation island-seed derivation
+
+# genome-hash chain roots (explorer dedup, r19 device loop). The 64-bit
+# genome hash is TWO independent fold32 chains over the genome words,
+# seeded from these literals — one chain per half. Both faces (the host
+# `explore.genome_hash64` and the in-jit `tpu.nemesis.genome_hash64`)
+# fold the same words from the same roots, so a hash COLLISION (the only
+# way dedup can diverge from exact set membership) hits both loops
+# identically and bit-identity survives. Distinct from COV_SALT: these
+# chains are dedup identity, not coverage, and the both-faces lint must
+# not conflate them.
+GENOME_H1 = 0x9E2AB744
+GENOME_H2 = 0x3C6EF372
+
 # --------------------------------------------------------------------------
 # fire-count vocabulary (engine fires tensor + host registries use indices)
 # --------------------------------------------------------------------------
@@ -198,6 +217,36 @@ CLAUSE_OF_EVENT: Dict[str, str] = {
     "remove": "reconfig", "join": "reconfig",
     "disk_slow": "disk", "disk_crash": "disk", "disk_recover": "disk",
 }
+
+
+def mutation_vocab(config) -> Tuple[List[str], List[str], List[str]]:
+    """(sched, rate, togglable) — the explorer's mutation vocabulary for
+    a compiled SimConfig (duck-typed via getattr, so this module never
+    imports the engine). THE single source both search faces build from:
+    `explore.Explorer.__init__` (host loop) and
+    `tpu.engine.make_devloop_plan` (device loop) both call this, so the
+    in-jit mutator can never disagree with the host mirror about which
+    clauses are schedulable, togglable or rate-scalable."""
+    cfg = config
+    sched = [n for n in OCC_CLAUSES if getattr(cfg, f"nem_{n}_enabled")]
+    rate = [
+        n for n, on in (
+            ("loss", cfg.nem_loss_rate > 0),
+            ("dup", cfg.nem_dup_enabled),
+            ("reorder", cfg.nem_reorder_rate > 0),
+        ) if on
+    ]
+    togglable = list(sched) + list(rate)
+    if cfg.nem_skew_enabled:
+        togglable.append("skew")
+    if cfg.nem_crash_enabled and cfg.nem_crash_wipe_rate > 0:
+        togglable.append("wipe")
+    # legacy trajectory-coupled chaos: clause-level toggles only
+    if cfg.chaos_enabled and "crash" not in togglable:
+        togglable.append("crash")
+    if cfg.partition_enabled and "partition" not in togglable:
+        togglable.append("partition")
+    return sched, rate, togglable
 
 
 # --------------------------------------------------------------------------

@@ -694,6 +694,39 @@ def record_explore_generation(ex, **labels: Any) -> None:
     )
 
 
+def record_explore_devloop(ex, res: Dict[str, Any], window: int,
+                           **labels: Any) -> None:
+    """One decoded device-resident window (r19) → registry: ring
+    occupancy, in-jit generations per dispatch, novelty acceptance.
+    Called at the window's DECODE boundary only — the one host sync —
+    so it observes values the host already holds; it never forces an
+    extra device transfer (observe-only, pinned by the goldens test)."""
+    reg = _STATE.registry
+    if reg is None:
+        return
+    labels = {"meta_seed": ex.meta_seed, **labels}
+    reg.gauge(
+        "explore_devloop_ring_occupancy",
+        "corpus-ring valid rows / capacity",
+    ).set(res["ring"]["n"] / max(ex.top_k, 1), **labels)
+    reg.gauge(
+        "explore_devloop_window_generations",
+        "in-jit generations retired by the last window",
+    ).set(res["gens_done"], **labels)
+    reg.counter(
+        "explore_devloop_generations",
+        "generations run device-resident",
+    ).inc(res["gens_done"], **labels)
+    reg.counter(
+        "explore_devloop_accepts",
+        "corpus-ring admissions (novelty acceptances) in-jit",
+    ).inc(res["accepts"], **labels)
+    reg.gauge(
+        "explore_devloop_seen_rows",
+        "genome-dedup table rows in use",
+    ).set(res["seen_n"], **labels)
+
+
 def record_shrink(result, **labels: Any) -> None:
     """Triage ShrinkResult → registry: atoms before/after, dispatches."""
     reg = _STATE.registry

@@ -85,6 +85,9 @@ from ..nemesis import (
     COIN_DENOM,
     FIRE_INDEX,
     FIRE_KINDS,
+    key_from_seed,
+    META_SITE_DRAW,
+    mutation_vocab,
     OCC_CLAUSES,
     OCC_ROW,
     RATE_CLAUSES,
@@ -399,6 +402,147 @@ class RefillLog(NamedTuple):
     cov_transitions: Any  # i32 [A] | None
 
 
+class DevLoopPlan(NamedTuple):
+    """STATIC shape/vocabulary parameters of the device-resident search
+    loop (r19, docs/explore.md): everything the traced generation-boundary
+    program bakes in as Python constants. Fixed at `BatchedSim(...,
+    devloop=plan)` construction — the jitted step caches on the sim, so a
+    plan change needs a new sim (exactly like triage/coverage flags).
+
+    The population split and mutation vocabulary MIRROR the host
+    `Explorer` field-for-field (build both through `make_devloop_plan` so
+    they cannot drift): `ops` is the weighted op menu `Explorer._mutate`
+    draws from, `sched_rows`/`tog_bits`/`rate_rows` the per-op choice
+    tables, and the fresh/mutant/swarm counts use the Explorer's exact
+    integer-truncation arithmetic."""
+
+    pop: int  # A — candidates per generation (== the admission queue)
+    top_k: int  # K — corpus-ring capacity (the host's top_k)
+    seen_cap: int  # S — dedup-table capacity (append-only rows)
+    n_fresh: int
+    n_mut: int
+    n_swarm: int
+    swarm_group: int
+    fresh_stride: int
+    full_h: int  # the config horizon (genome horizon 0 decodes to this)
+    ops: Tuple[str, ...]  # weighted mutation-op menu, host order
+    sched_rows: Tuple[int, ...]  # OCC_ROW of each enabled schedule clause
+    tog_bits: Tuple[int, ...]  # TRIAGE_BIT of each togglable clause
+    rate_rows: Tuple[int, ...]  # RATE_ROW of each scalable message clause
+
+
+def make_devloop_plan(
+    config: SimConfig, pop: int, top_k: int = 16,
+    seen_cap: int = 1 << 17, fresh_frac: float = 0.5,
+    mutant_frac: float = 0.3, swarm_group: int = 8,
+    fresh_stride: int = 1,
+) -> DevLoopPlan:
+    """Derive the device-loop plan from a compiled SimConfig with the
+    SAME vocabulary source (`nemesis.mutation_vocab`) and split
+    arithmetic as `explore.Explorer.__init__` / `_population`, so the
+    in-jit mutator and the host mirror can never disagree about which
+    clauses are togglable or how a generation splits."""
+    cfg = config
+    sched, rate, togglable = mutation_vocab(cfg)
+    ops: list = []
+    if sched:
+        ops += ["occ"] * 3
+    if togglable:
+        ops += ["clause"] * 2
+    if rate:
+        ops.append("rate")
+    ops.append("horizon")
+    L = int(pop)
+    n_mut = int(L * float(mutant_frac))
+    n_fresh = int(L * float(fresh_frac))
+    n_swarm = L - n_mut - n_fresh if togglable else 0
+    n_fresh = L - n_mut - n_swarm
+    if seen_cap & (seen_cap - 1):
+        raise ValueError(f"seen_cap must be a power of two, got {seen_cap}")
+    return DevLoopPlan(
+        pop=L,
+        top_k=int(top_k),
+        seen_cap=int(seen_cap),
+        n_fresh=n_fresh,
+        n_mut=n_mut,
+        n_swarm=n_swarm,
+        swarm_group=max(1, int(swarm_group)),
+        fresh_stride=max(1, int(fresh_stride)),
+        full_h=int(cfg.horizon_us),
+        ops=tuple(ops),
+        sched_rows=tuple(OCC_ROW[n] for n in sched),
+        tog_bits=tuple(TRIAGE_BIT[n] for n in togglable),
+        rate_rows=tuple(RATE_ROW[n] for n in rate),
+    )
+
+
+class DevLoop(NamedTuple):
+    """Device-resident search-loop carry (r19): the corpus ring, the
+    global coverage union, the genome-dedup table, the MetaRng cursor and
+    the per-generation result archives — everything the host explorer
+    used to rebuild between generations, now donated cold carry so a
+    whole WINDOW of generations runs as one dispatch chain with zero
+    host sync (decode happens once, in `devloop_results`).
+
+    Capacities are array shapes (A = plan.pop admissions, K = plan.top_k
+    ring rows, S = plan.seen_cap dedup rows, G = the window's generation
+    count), so they are jit cache keys like every other shape.
+
+    DETERMINISM: every value here is a pure function of (uploaded search
+    state, meta-seed counter chain, admission results) — the boundary
+    folds admissions in ADMISSION ORDER (the same order the host
+    `_fold_part` replays), the ring is the host corpus's stable
+    top-K-by-novelty exactly (insertion keeps ties in admission order),
+    and dedup compares the SAME 64-bit genome hash both faces compute
+    (nemesis.GENOME_H1/H2), so a hash collision — the only divergence a
+    hash-based set can introduce — hits both loops identically."""
+
+    # meta-rng cursor (the host MetaRng's (seed-key, counter) pair)
+    meta_key: Any  # u32 [] key_from_seed(meta_seed)
+    counter: Any  # i32 [] next MetaRng draw index
+    next_fresh: Any  # u32 [] next fresh-seed value (advances by stride)
+    gens_done: Any  # i32 [] generations fully executed + archived
+    target_gens: Any  # i32 [] generations this window must run (== G)
+    accepts: Any  # i32 [] corpus-ring admissions this window (telemetry)
+    # corpus ring: top-K genomes by novelty, sorted desc, stable ties
+    ring_n: Any  # i32 [] valid rows
+    ring_bits: Any  # i32 [K] new_bits at admission (the sort key)
+    ring_seed: Any  # u32 [K]
+    ring_off: Any  # i32 [K]
+    ring_occ: Any  # i32 [K, len(OCC_CLAUSES)]
+    ring_rate: Any  # f32 [K, len(RATE_CLAUSES)]
+    ring_h: Any  # i32 [K] raw genome horizon (0 = full)
+    # global coverage union (the novelty reference)
+    union: Any  # u32 [COV_WORDS]
+    # genome-dedup table: append-only (h1, h2) rows; membership is an
+    # exact masked compare over the valid prefix, so row ORDER never
+    # affects a dedup decision — only set contents do
+    seen_h1: Any  # u32 [S]
+    seen_h2: Any  # u32 [S]
+    seen_n: Any  # i32 []
+    # current generation's provenance (the queue holds the ctl ENCODING,
+    # which is lossy: genome horizon 0 encodes as the full horizon)
+    gen_h_raw: Any  # i32 [A] raw genome horizons of the live generation
+    gen_origin: Any  # i32 [A] 0 = fresh, 1 = mutant, 2 = swarm
+    # per-generation archives, written at each generation boundary —
+    # the ONE host sync per window decodes these
+    arch_seed: Any  # u32 [G, A]
+    arch_off: Any  # i32 [G, A]
+    arch_occ: Any  # i32 [G, A, len(OCC_CLAUSES)]
+    arch_rate: Any  # f32 [G, A, len(RATE_CLAUSES)]
+    arch_h: Any  # i32 [G, A] raw genome horizons
+    arch_origin: Any  # i32 [G, A]
+    arch_violated: Any  # bool [G, A]
+    arch_bitmap: Any  # u32 [G, A, COV_WORDS]
+    arch_hiwater: Any  # i32 [G, A]
+    arch_transitions: Any  # i32 [G, A]
+
+
+# origin enum shared by DevLoop.gen_origin / arch_origin and the host
+# decode (explore.Candidate.origin strings, in enum order)
+DEVLOOP_ORIGINS = ("fresh", "mutant", "swarm")
+
+
 def default_ctl(L: int, horizon_us: int) -> TriageCtl:
     """The no-op ctl: every clause and occurrence on, full horizon."""
     eh, oh = divmod(int(horizon_us), REBASE_US)
@@ -580,6 +724,12 @@ class SimState(NamedTuple):
     #           docs/continuous_batching.md)
     refill: Any  # RefillLog | None — refill carry: queue cursor, per-lane
     #           admission ids, occupancy counters, per-admission results
+    loop: Any = None  # DevLoop | None — device-resident search carry
+    #           (None unless the state was built by init_devloop; r19,
+    #           docs/explore.md). Trailing with a default so every
+    #           existing positional/keyword construction site stays
+    #           valid. Requires refill mode: the generation boundary
+    #           rides _refill_apply's retire path.
 
     @property
     def alive(self):
@@ -617,6 +767,9 @@ class ColdState(NamedTuple):
     cov: Any
     refill: Any  # RefillLog | None (refill mode only): the result
     #            buffers accumulate, the cursor advances rarely — cold
+    loop: Any  # DevLoop | None (device-loop mode only): corpus ring,
+    #            union bitmap, seen table, generation archives — touched
+    #            once per generation boundary, cold by construction
 
 
 COLD_FIELDS = ColdState._fields
@@ -649,9 +802,17 @@ def split_state(state: SimState):
       * plain sweeps: const = (key0, ctl, skew_ppm) — the r8 split;
       * refill sweeps (state.refill is not None): key0/ctl/skew_ppm
         STAY IN THE CARRY (a refilled lane rewrites them from its new
-        admission), and const = the admission queue alone."""
+        admission), and const = the admission queue alone;
+      * device-loop sweeps (state.loop is not None): NOTHING is loop-
+        invariant — the generation boundary rewrites even the admission
+        queue from the mutated corpus ring, so the queue rides the
+        carry and const is empty."""
     nem = state.nem
     cold = ColdState(*(getattr(state, f) for f in COLD_FIELDS))
+    if state.loop is not None:
+        hot = state._replace(**{f: None for f in COLD_FIELDS})
+        const = ConstState(key0=None, ctl=None, skew_ppm=None, queue=None)
+        return hot, cold, const
     if state.refill is not None:
         hot = state._replace(
             queue=None, **{f: None for f in COLD_FIELDS},
@@ -675,6 +836,9 @@ def split_state(state: SimState):
 
 def merge_state(hot: SimState, cold: ColdState, const: ConstState) -> SimState:
     """(hot, cold, const) -> flat SimState (inverse of split_state)."""
+    if cold.loop is not None:  # device-loop partition: const is empty,
+        # the queue never left the hot carry — just graft cold back on
+        return hot._replace(**dict(zip(COLD_FIELDS, cold)))
     if const.queue is not None:  # refill partition: key0/ctl/skew in hot
         return hot._replace(
             queue=const.queue, **dict(zip(COLD_FIELDS, cold)),
@@ -730,7 +894,9 @@ def carry_partition(state: SimState) -> dict:
     }
 
 
-def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
+def interval_hints(
+    sim: "BatchedSim", refill: bool = False, devloop: bool = False,
+) -> dict:
     """{carry leaf name -> (lo, hi, may_inf)} seed intervals for the
     ENGINE-OWNED leaves, keyed by the `named_leaves` hot/cold/const paths.
 
@@ -738,6 +904,12 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
     ctl / skew_ppm live under `hot.`, the queue under `const.queue.`)
     and adds the RefillLog leaves — notably the queue cursor and the
     per-admission `retired` step rows the range certifier must bound.
+
+    `devloop=True` (implies refill) keys the device-loop partition: the
+    queue ALSO rides the carry (`hot.queue.*` — the generation boundary
+    rewrites it from the mutated ring), and the `cold.loop.*` DevLoop
+    leaves gain rows — notably the ring/seen cursors every dynamic
+    ring-scatter index is clipped against.
 
     The introspection hook behind the Layer-3 range certifier
     (analysis/ranges.py): these are the engine's own documented value
@@ -845,7 +1017,7 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
     # so it inherits the node field's interval — the certifier seeds
     # hot.dur.* from the same spec declarations as hot.node.* and these
     # engine-owned hints only exist for fields the engine itself bounds
-    if refill:
+    if refill or devloop:
         # the refill carry partition: key0/ctl/skew ride in hot (a
         # refilled lane rewrites them), only the queue is const
         ren = {
@@ -892,6 +1064,51 @@ def interval_hints(sim: "BatchedSim", refill: bool = False) -> dict:
             "const.queue.rate_scale": (0, 1, False),
             "const.queue.h_epoch": (0, ep_hi, False),
             "const.queue.h_off": (0, REBASE_US - 1, False),
+        })
+    if devloop:
+        # device-loop partition: const is EMPTY — the boundary rewrites
+        # the queue from the mutated ring, so its rows ride the carry
+        hints = {
+            k.replace("const.queue.", "hot.queue."): v
+            for k, v in hints.items()
+        }
+        plan = sim.devloop
+        K, S = plan.top_k, plan.seen_cap
+        full_h = plan.full_h
+        ctr = (0, ctr_hi, False)
+        hints.update({
+            "cold.loop.meta_key": u32,
+            "cold.loop.counter": ctr,
+            "cold.loop.next_fresh": u32,
+            "cold.loop.gens_done": ctr,
+            "cold.loop.target_gens": ctr,
+            "cold.loop.accepts": ctr,
+            # ring/seen cursors: the invariants every dynamic ring index
+            # is clipped against (ring_n <= K, seen_n <= S by the host
+            # pre-dispatch headroom check in Explorer._run_device_window)
+            "cold.loop.ring_n": (0, K, False),
+            "cold.loop.ring_bits": (0, COV_BITS, False),
+            "cold.loop.ring_seed": u32,
+            "cold.loop.ring_off": (0, (1 << 31) - 1, False),
+            "cold.loop.ring_occ": (0, (1 << 31) - 1, False),
+            "cold.loop.ring_rate": (0, 1, False),
+            "cold.loop.ring_h": (0, full_h, False),
+            "cold.loop.union": u32,
+            "cold.loop.seen_h1": u32,
+            "cold.loop.seen_h2": u32,
+            "cold.loop.seen_n": (0, S, False),
+            "cold.loop.gen_h_raw": (0, full_h, False),
+            "cold.loop.gen_origin": (0, 2, False),
+            "cold.loop.arch_seed": u32,
+            "cold.loop.arch_off": (0, (1 << 31) - 1, False),
+            "cold.loop.arch_occ": (0, (1 << 31) - 1, False),
+            "cold.loop.arch_rate": (0, 1, False),
+            "cold.loop.arch_h": (0, full_h, False),
+            "cold.loop.arch_origin": (0, 2, False),
+            "cold.loop.arch_violated": (0, 1, False),
+            "cold.loop.arch_bitmap": u32,
+            "cold.loop.arch_hiwater": ctr,
+            "cold.loop.arch_transitions": ctr,
         })
     return hints
 
@@ -951,7 +1168,7 @@ class BatchedSim:
     def __init__(
         self, spec: ProtocolSpec, config: Optional[SimConfig] = None,
         triage: bool = False, coverage: bool = False,
-        lineage: bool = False,
+        lineage: bool = False, devloop: Optional[DevLoopPlan] = None,
     ) -> None:
         """`triage=True` threads a per-lane `TriageCtl` through the state:
         the same compiled step program then evaluates shrink candidates
@@ -971,6 +1188,18 @@ class BatchedSim:
         self.triage = bool(triage)
         self.coverage = bool(coverage)
         self.lineage = bool(lineage)
+        # `devloop` arms the device-resident search loop (r19,
+        # docs/explore.md): a DevLoopPlan whose STATIC vocabulary/split
+        # parameters the generation-boundary program bakes in. The loop
+        # mutates TriageCtl genomes and ranks coverage novelty in-jit,
+        # so both planes must be threaded.
+        if devloop is not None and not (triage and coverage):
+            raise ValueError(
+                "devloop needs BatchedSim(..., triage=True, coverage=True) "
+                "— the device loop mutates ctl genomes and ranks coverage "
+                "novelty in-jit"
+            )
+        self.devloop = devloop
         cfg = self.config
         N = spec.n_nodes
         # fail loudly at construction, not as shape errors deep inside jit
@@ -3189,6 +3418,7 @@ class BatchedSim:
             ),
             queue=state.queue,
             refill=state.refill,
+            loop=state.loop,
         )
         # -- 9. continuous batching: retire finished lanes, admit the next
         # queued seed/genome in-jit (docs/continuous_batching.md). A no-op
@@ -3196,6 +3426,13 @@ class BatchedSim:
         # steps pay one lane-axis any() and nothing else.
         if state.refill is not None:
             new_state = self._refill_apply(state, new_state, active)
+        # -- 10. device-resident search (r19, docs/explore.md): when the
+        # whole generation has retired, fold its coverage into the corpus
+        # ring, mutate the next population from the meta-rng chain, and
+        # rewrite the admission queue — all under a lax.cond that stays a
+        # no-op until the LAST admission of a generation retires.
+        if state.loop is not None:
+            new_state = self._devloop_apply(new_state)
         record = TraceRecord(
             clock=clock,
             epoch=epoch,
@@ -3348,8 +3585,11 @@ class BatchedSim:
             # select: non-refilled lanes keep their post-step state
             # bit-for-bit — the schedule-purity half of the contract
             fresh = self._init(seeds_new, ctl_new)
-            base = ns._replace(queue=None, refill=None)
-            fresh = fresh._replace(queue=None, refill=None)
+            # strip the non-lane planes before the masked merge (loop too:
+            # _init builds loop=None, and the devloop carry is per-window,
+            # not per-lane — reattached below untouched)
+            base = ns._replace(queue=None, refill=None, loop=None)
+            fresh = fresh._replace(queue=None, refill=None, loop=None)
 
             def sel(f, b):
                 m = take.reshape(take.shape + (1,) * (f.ndim - 1))
@@ -3360,13 +3600,414 @@ class BatchedSim:
                 cursor=rf.cursor + n_take,
                 admitted=jnp.where(take, adm, rf.admitted),
             )
-            return merged._replace(queue=q, refill=rf2)
+            return merged._replace(queue=q, refill=rf2, loop=ns.loop)
 
         def tick_only(ns: SimState, rf: RefillLog) -> SimState:
             return ns._replace(refill=rf)
 
         return jax.lax.cond(jnp.any(just), retire_and_admit, tick_only,
                             ns, rf)
+
+    # ------------------------------------------- device-resident search
+
+    def _devloop_apply(self, ns: SimState) -> SimState:
+        """Fire the generation boundary once the live generation has
+        fully retired (r19, docs/explore.md).
+
+        Runs at the end of every device-loop step, AFTER `_refill_apply`
+        (so the final retirements of a generation are already harvested
+        into the RefillLog result buffers). The `lax.cond` is a no-op on
+        every other step: the predicate — queue drained AND every lane
+        done AND the window unfinished — holds exactly once per
+        generation, on the step its last admission retires, and the
+        boundary both folds the finished generation and (if the window
+        has generations left) respawns all lanes on the next population,
+        so the sweep never spends an idle step between generations."""
+        dl: DevLoop = ns.loop
+        rf: RefillLog = ns.refill
+        A = int(ns.queue.seeds.shape[0])
+        fire = (
+            jnp.all(ns.done)
+            & (rf.cursor >= jnp.int32(A))
+            & (dl.gens_done < dl.target_gens)
+        )
+        return jax.lax.cond(
+            fire, self._devloop_boundary, lambda s: s, ns
+        )
+
+    def _devloop_boundary(self, ns: SimState) -> SimState:
+        """One in-jit generation boundary: archive -> fold -> mutate ->
+        respawn. The traced mirror of what `Explorer` does on the host
+        between dispatches, drawing the SAME murmur3 counter chain at
+        META_SITE_DRAW so the two faces are draw-for-draw identical
+        (explore._run_device_window replays the host face per window and
+        asserts exactly that).
+
+          1. ARCHIVE: the finished generation's genomes + per-admission
+             results land in the DevLoop arch_* row `gens_done` (the one
+             host decode per window reads these).
+          2. FOLD (admission order — the order `_fold_part` replays):
+             novelty = popcount(bitmap & ~union); a novel admission ORs
+             its bitmap into the union and stable-inserts into the
+             corpus ring at position = #{rows with bits >= new_bits},
+             which keeps the ring equal to the host's
+             sorted-by-(-new_bits, dispatch) top-K exactly (ties keep
+             admission order; a displaced row has >= K permanent
+             dominators, so it can never re-enter on either face).
+          3. MUTATE/RESPAWN (only when the window has generations left):
+             build the next population with the host `_population`'s
+             exact draw schedule — fresh block (no draws), mutants
+             (parent choice + `_mutate`'s op draws, genome-hash dedup
+             against the seen table with single fresh fallback), swarm
+             groups (one coin per togglable clause per group) — then
+             encode it into the admission queue and re-`_init` every
+             lane on the head rows.
+
+        The seen-table append discipline matches the host claim order
+        (mutants at choice time, fresh/swarm at population end; exactly
+        one append per candidate), so `seen_n` tracks `len(_seen)` and
+        membership — an EXACT masked compare over the valid prefix, not
+        a probabilistic filter — diverges from the host only on a 64-bit
+        hash collision, which by construction both faces resolve the
+        same way."""
+        from . import nemesis as tpun
+
+        plan: DevLoopPlan = self.devloop
+        dl: DevLoop = ns.loop
+        rf: RefillLog = ns.refill
+        q: RefillQueue = ns.queue
+        L = int(ns.done.shape[0])
+        A, K, S = plan.pop, plan.top_k, plan.seen_cap
+        G = int(dl.arch_seed.shape[0])
+        n_occ = len(OCC_CLAUSES)
+        n_rate = len(RATE_CLAUSES)
+        meta_key = dl.meta_key
+
+        # -- 1. archive the finished generation at row gens_done (clipped
+        # so the dynamic row index is provably in-bounds)
+        g = jnp.clip(dl.gens_done, 0, G - 1)
+
+        def arch(dst, src):
+            return jax.lax.dynamic_update_slice(
+                dst, src[None].astype(dst.dtype),
+                (g,) + (jnp.int32(0),) * src.ndim,
+            )
+
+        # -- 2. fold admissions into union + ring, in admission order
+        kidx = jnp.arange(K, dtype=jnp.int32)
+
+        def fold_body(i, carry):
+            union, rb, rs, ro, rocc, rrate, rh, rn, acc = carry
+            bm = rf.cov_bitmap[i]
+            new = bm & ~union
+            nb = jnp.sum(jax.lax.population_count(new).astype(jnp.int32))
+            accept = nb > 0
+            union2 = jnp.where(accept, union | bm, union)
+            # stable top-K insert: after every row with bits >= nb
+            pos = jnp.sum((rb >= nb).astype(jnp.int32))
+            do = accept & (pos < K)
+
+            def ins(dst, val):
+                shifted = jnp.roll(dst, 1, axis=0)
+                m = kidx.reshape((K,) + (1,) * (dst.ndim - 1))
+                out = jnp.where(
+                    m < pos, dst, jnp.where(m == pos, val, shifted)
+                )
+                return jnp.where(do, out, dst)
+
+            return (
+                union2,
+                ins(rb, nb),
+                ins(rs, q.seeds[i]),
+                ins(ro, q.off[i]),
+                ins(rocc, q.occ[i]),
+                ins(rrate, q.rate_scale[i]),
+                ins(rh, dl.gen_h_raw[i]),
+                jnp.where(do, jnp.minimum(rn + 1, K), rn),
+                acc + accept.astype(jnp.int32),
+            )
+
+        (union, ring_bits, ring_seed, ring_off, ring_occ, ring_rate,
+         ring_h, ring_n, accepts) = jax.lax.fori_loop(
+            0, A, fold_body,
+            (dl.union, dl.ring_bits, dl.ring_seed, dl.ring_off,
+             dl.ring_occ, dl.ring_rate, dl.ring_h, dl.ring_n,
+             dl.accepts),
+        )
+        gens_done = dl.gens_done + jnp.int32(1)
+        folded_loop = dl._replace(
+            gens_done=gens_done, accepts=accepts, union=union,
+            ring_n=ring_n, ring_bits=ring_bits, ring_seed=ring_seed,
+            ring_off=ring_off, ring_occ=ring_occ, ring_rate=ring_rate,
+            ring_h=ring_h,
+            arch_seed=arch(dl.arch_seed, q.seeds),
+            arch_off=arch(dl.arch_off, q.off),
+            arch_occ=arch(dl.arch_occ, q.occ),
+            arch_rate=arch(dl.arch_rate, q.rate_scale),
+            arch_h=arch(dl.arch_h, dl.gen_h_raw),
+            arch_origin=arch(dl.arch_origin, dl.gen_origin),
+            arch_violated=arch(dl.arch_violated, rf.violated),
+            arch_bitmap=arch(dl.arch_bitmap, rf.cov_bitmap),
+            arch_hiwater=arch(dl.arch_hiwater, rf.cov_hiwater),
+            arch_transitions=arch(
+                dl.arch_transitions, rf.cov_transitions
+            ),
+        )
+
+        # -- 3. next population (only when the window continues)
+        stride = jnp.uint32(plan.fresh_stride)
+        sarange = jnp.arange(S, dtype=jnp.int32)
+        op_code = {"occ": 0, "clause": 1, "rate": 2, "horizon": 3}
+        menu = jnp.asarray([op_code[o] for o in plan.ops], jnp.int32)
+        # meta draws consumed per op (parent choice + op choice + the
+        # op's own draws — Explorer._mutate's exact schedule)
+        adv_of = jnp.asarray([4, 3, 4, 3], jnp.int32)
+        n_sched = max(1, len(plan.sched_rows))
+        n_tog = max(1, len(plan.tog_bits))
+        n_rateops = max(1, len(plan.rate_rows))
+        sched_rows = jnp.asarray(plan.sched_rows or (0,), jnp.int32)
+        tog_bits = jnp.asarray(plan.tog_bits or (0,), jnp.int32)
+        rate_rows = jnp.asarray(plan.rate_rows or (0,), jnp.int32)
+        scale_menu = jnp.asarray([0.25, 0.5, 1.0], jnp.float32)
+        full_h = jnp.int32(plan.full_h)
+        occ_cols = jnp.arange(n_occ, dtype=jnp.int32)
+        rate_cols = jnp.arange(n_rate, dtype=jnp.int32)
+
+        def fresh_hash(seed):
+            return tpun.genome_hash64(
+                seed, jnp.int32(0), jnp.zeros((n_occ,), jnp.int32),
+                jnp.ones((n_rate,), jnp.float32), jnp.int32(0),
+            )
+
+        def build_mixed(c0, nf0, sh1, sh2, sn):
+            nF, nM, nS_ = plan.n_fresh, plan.n_mut, plan.n_swarm
+            seeds = jnp.zeros((A,), jnp.uint32)
+            offs = jnp.zeros((A,), jnp.int32)
+            occs = jnp.zeros((A, n_occ), jnp.int32)
+            rates = jnp.ones((A, n_rate), jnp.float32)
+            hs = jnp.zeros((A,), jnp.int32)
+            origins = jnp.zeros((A,), jnp.int32)
+            # fresh block: sequential seeds, NO meta draws
+            if nF:
+                seeds = seeds.at[:nF].set(
+                    nf0 + stride * jnp.arange(nF, dtype=jnp.uint32)
+                )
+            nf = nf0 + stride * jnp.uint32(nF)
+
+            def mut_body(i, carry):
+                (c, nf, sh1, sh2, sn,
+                 seeds, offs, occs, rates, hs, origins) = carry
+                d0 = prng.bits(meta_key, META_SITE_DRAW, c)
+                pidx = jnp.clip(
+                    (d0 % jnp.maximum(ring_n, 1).astype(jnp.uint32))
+                    .astype(jnp.int32),
+                    0, K - 1,
+                )
+                p_seed = ring_seed[pidx]
+                p_off = ring_off[pidx]
+                p_occ = ring_occ[pidx]
+                p_rate = ring_rate[pidx]
+                p_h = ring_h[pidx]
+                d1 = prng.bits(meta_key, META_SITE_DRAW, c + 1)
+                op = menu[
+                    (d1 % jnp.uint32(len(plan.ops))).astype(jnp.int32)
+                ]
+                d2 = prng.bits(meta_key, META_SITE_DRAW, c + 2)
+                d3 = prng.bits(meta_key, META_SITE_DRAW, c + 3)
+                # occ: flip window bit k of one schedule clause's row
+                occ_row = sched_rows[
+                    (d2 % jnp.uint32(n_sched)).astype(jnp.int32)
+                ]
+                k = (d3 % jnp.uint32(10)).astype(jnp.int32)
+                m_occ = jnp.where(
+                    occ_cols == occ_row, p_occ ^ (jnp.int32(1) << k),
+                    p_occ,
+                )
+                # clause: toggle one togglable clause's disable bit
+                m_off = p_off ^ tog_bits[
+                    (d2 % jnp.uint32(n_tog)).astype(jnp.int32)
+                ]
+                # rate: set one message clause's scale from the menu
+                rate_row = rate_rows[
+                    (d2 % jnp.uint32(n_rateops)).astype(jnp.int32)
+                ]
+                sc = scale_menu[(d3 % jnp.uint32(3)).astype(jnp.int32)]
+                m_rate = jnp.where(rate_cols == rate_row, sc, p_rate)
+                # horizon: bisect toward the prefix, or restore full
+                h_eff = jnp.where(p_h == 0, full_h, p_h)
+                alt = jnp.maximum(h_eff // 2, full_h // 8)
+                m_h = jnp.where(
+                    (d2 % jnp.uint32(2)) == jnp.uint32(0),
+                    jnp.int32(0), alt,
+                )
+                cand_occ = jnp.where(op == 0, m_occ, p_occ)
+                cand_off = jnp.where(op == 1, m_off, p_off)
+                cand_rate = jnp.where(op == 2, m_rate, p_rate)
+                cand_h = jnp.where(op == 3, m_h, p_h)
+                c2 = c + adv_of[op]
+                h1m, h2m = tpun.genome_hash64(
+                    p_seed, cand_off, cand_occ, cand_rate, cand_h
+                )
+                dup = jnp.any(
+                    (sarange < sn) & (sh1 == h1m) & (sh2 == h2m)
+                )
+                # dup -> single fresh fallback (consumes the next fresh
+                # seed, no extra meta draws — the restructured host path)
+                f_seed = nf
+                h1f, h2f = fresh_hash(f_seed)
+                seed_i = jnp.where(dup, f_seed, p_seed)
+                off_i = jnp.where(dup, jnp.int32(0), cand_off)
+                occ_i = jnp.where(dup, jnp.zeros_like(cand_occ), cand_occ)
+                rate_i = jnp.where(
+                    dup, jnp.ones_like(cand_rate), cand_rate
+                )
+                h_i = jnp.where(dup, jnp.int32(0), cand_h)
+                org_i = jnp.where(dup, jnp.int32(0), jnp.int32(1))
+                nf2 = jnp.where(dup, nf + stride, nf)
+                # claim immediately: a second mutant drawing this genome
+                # within THIS generation must fall back too
+                sh1b = sh1.at[sn].set(
+                    jnp.where(dup, h1f, h1m), mode="drop"
+                )
+                sh2b = sh2.at[sn].set(
+                    jnp.where(dup, h2f, h2m), mode="drop"
+                )
+                sn2 = jnp.minimum(sn + 1, S)
+                at = nF + i
+                return (
+                    c2, nf2, sh1b, sh2b, sn2,
+                    seeds.at[at].set(seed_i),
+                    offs.at[at].set(off_i),
+                    occs.at[at].set(occ_i),
+                    rates.at[at].set(rate_i),
+                    hs.at[at].set(h_i),
+                    origins.at[at].set(org_i),
+                )
+
+            (c, nf, sh1, sh2, sn,
+             seeds, offs, occs, rates, hs, origins) = jax.lax.fori_loop(
+                0, nM, mut_body,
+                (c0, nf, sh1, sh2, sn,
+                 seeds, offs, occs, rates, hs, origins),
+            )
+            # swarm groups: one coin per togglable clause per group,
+            # statically unrolled (group layout is plan arithmetic)
+            base = nF + nM
+            for start in range(0, nS_, plan.swarm_group):
+                gsz = min(plan.swarm_group, nS_ - start)
+                off_g = jnp.int32(0)
+                for b in plan.tog_bits:
+                    coin = (
+                        prng.bits(meta_key, META_SITE_DRAW, c)
+                        % jnp.uint32(COIN_DENOM)
+                    ) < jnp.uint32(COIN_DENOM // 2)
+                    off_g = jnp.where(coin, off_g | jnp.int32(b), off_g)
+                    c = c + jnp.int32(1)
+                p0 = base + start
+                seeds = seeds.at[p0:p0 + gsz].set(
+                    nf + stride * jnp.arange(gsz, dtype=jnp.uint32)
+                )
+                offs = offs.at[p0:p0 + gsz].set(off_g)
+                origins = origins.at[p0:p0 + gsz].set(jnp.int32(2))
+                nf = nf + stride * jnp.uint32(gsz)
+            # claim fresh + swarm genomes (mutants claimed in-loop):
+            # exactly one append per pop candidate, so seen_n tracks the
+            # host len(_seen) — fresh/swarm seeds are brand-new, so each
+            # append is genuinely a new genome
+            claim = list(range(nF)) + list(range(base, A))
+            if claim:
+                ci = jnp.asarray(claim, jnp.int32)
+                hh1, hh2 = tpun.genome_hash64(
+                    seeds[ci], offs[ci], occs[ci], rates[ci], hs[ci]
+                )
+                slots = sn + jnp.arange(len(claim), dtype=jnp.int32)
+                sh1 = sh1.at[slots].set(hh1, mode="drop")
+                sh2 = sh2.at[slots].set(hh2, mode="drop")
+                sn = jnp.minimum(sn + len(claim), S)
+            return (seeds, offs, occs, rates, hs, origins,
+                    c, nf, sh1, sh2, sn)
+
+        def build_fresh(c0, nf0, sh1, sh2, sn):
+            # empty ring (host: `not parents`): ALL fresh, no meta draws
+            seeds = nf0 + stride * jnp.arange(A, dtype=jnp.uint32)
+            offs = jnp.zeros((A,), jnp.int32)
+            occs = jnp.zeros((A, n_occ), jnp.int32)
+            rates = jnp.ones((A, n_rate), jnp.float32)
+            hs = jnp.zeros((A,), jnp.int32)
+            origins = jnp.zeros((A,), jnp.int32)
+            h1a, h2a = tpun.genome_hash64(seeds, offs, occs, rates, hs)
+            slots = sn + jnp.arange(A, dtype=jnp.int32)
+            return (
+                seeds, offs, occs, rates, hs, origins, c0,
+                nf0 + stride * jnp.uint32(A),
+                sh1.at[slots].set(h1a, mode="drop"),
+                sh2.at[slots].set(h2a, mode="drop"),
+                jnp.minimum(sn + A, S),
+            )
+
+        def next_gen(_):
+            (seeds_new, off_new, occ_new, rate_new, h_new, origin_new,
+             c_next, nf_next, sh1n, sh2n, sn_next) = jax.lax.cond(
+                ring_n > 0, build_mixed, build_fresh,
+                dl.counter, dl.next_fresh,
+                dl.seen_h1, dl.seen_h2, dl.seen_n,
+            )
+            h_ep, h_of = tpun.genome_ctl_rows(h_new, plan.full_h)
+            queue2 = RefillQueue(
+                seeds=seeds_new, off=off_new, occ=occ_new,
+                rate_scale=rate_new, h_epoch=h_ep, h_off=h_of,
+            )
+            head_ctl = TriageCtl(
+                off=off_new[:L], occ=occ_new[:L],
+                rate_scale=rate_new[:L],
+                h_epoch=h_ep[:L], h_off=h_of[:L],
+            )
+            # whole-state respawn: at a boundary EVERY lane re-inits on
+            # the new head admissions (no masked merge — the refill path
+            # handles partial retirement; a boundary is total)
+            fresh = self._init(seeds_new[:L], head_ctl)
+            zi = functools.partial(jnp.zeros, dtype=jnp.int32)
+            rf2 = rf._replace(
+                # step_cap, iters and busy carry over (cumulative
+                # occupancy accounting across the whole window)
+                cursor=jnp.int32(L),
+                admitted=jnp.arange(L, dtype=jnp.int32),
+                retired=jnp.full((A,), -1, jnp.int32),
+                violated=jnp.zeros((A,), jnp.bool_),
+                deadlocked=jnp.zeros((A,), jnp.bool_),
+                violation_at=jnp.full((A,), INF_US, jnp.int32),
+                violation_epoch=zi((A,)),
+                violation_step=jnp.full((A,), -1, jnp.int32),
+                steps=zi((A,)),
+                events=zi((A,)),
+                overflow=zi((A,)),
+                dead_drops=zi((A,)),
+                nonmember_drops=zi((A,)),
+                unsynced_loss=zi((A,)),
+                clock=zi((A,)),
+                epoch=zi((A,)),
+                fires=zi((A, len(FIRE_KINDS))),
+                occ_fired=(
+                    None if rf.occ_fired is None
+                    else jnp.zeros((A, n_occ), jnp.uint32)
+                ),
+                cov_bitmap=jnp.zeros((A, COV_WORDS), jnp.uint32),
+                cov_hiwater=zi((A,)),
+                cov_transitions=zi((A,)),
+            )
+            loop2 = folded_loop._replace(
+                counter=c_next, next_fresh=nf_next,
+                seen_h1=sh1n, seen_h2=sh2n, seen_n=sn_next,
+                gen_h_raw=h_new, gen_origin=origin_new,
+            )
+            return fresh._replace(queue=queue2, refill=rf2, loop=loop2)
+
+        def window_done(_):
+            return ns._replace(loop=folded_loop)
+
+        return jax.lax.cond(
+            gens_done < dl.target_gens, next_gen, window_done, None
+        )
 
     def init_refill(
         self, seeds, lanes: int, ctl=None,
@@ -3488,6 +4129,170 @@ class BatchedSim:
         A = int(state.queue.seeds.shape[0])
         if total_steps is None:
             total_steps = int(max_steps) * A
+        return self.run_state(state, total_steps, dispatch_steps)
+
+    def init_devloop(
+        self, seeds, lanes: int, ctl, window: int,
+        step_cap: int = 100_000,
+        meta_seed: int = 0, meta_counter: int = 0, next_fresh: int = 0,
+        target_gens: Optional[int] = None,
+        gen_h_raw=None, gen_origin=None,
+        ring: Optional[dict] = None, union=None,
+        seen: Optional[dict] = None,
+    ) -> SimState:
+        """Build a device-loop state: a refill sweep whose generation
+        boundary — fold, rank, mutate, respawn — runs IN-JIT, so a
+        window of up to `window` generations is one dispatch chain with
+        zero host sync (r19, docs/explore.md).
+
+        `seeds`/`ctl` are generation 0's population, exactly as the host
+        `Explorer._population` built it (the host runs the first
+        population itself so both faces share the same entry point);
+        `meta_seed`/`meta_counter`/`next_fresh` resume the MetaRng
+        cursor at the point the host left it. `gen_h_raw`/`gen_origin`
+        carry generation 0's raw genome horizons and origin codes (the
+        ctl encode is lossy: genome horizon 0 encodes as full horizon).
+        `ring`/`union`/`seen` upload the explorer's current corpus
+        top-K, coverage union, and genome-hash dedup set — all optional
+        (a cold start begins empty). `window` (G) is a SHAPE: the
+        archive capacity and jit cache key; `target_gens` <= G lets a
+        final partial window reuse the compiled program."""
+        import numpy as np
+
+        plan = self.devloop
+        if plan is None:
+            raise ValueError(
+                "init_devloop needs BatchedSim(..., devloop=plan)"
+            )
+        if ctl is None:
+            raise ValueError("init_devloop requires a ctl queue (triage)")
+        seeds = jnp.asarray(seeds, jnp.uint32)
+        A = plan.pop
+        if int(seeds.shape[0]) != A:
+            raise ValueError(
+                f"devloop population is {A} admissions per generation, "
+                f"got {int(seeds.shape[0])} seeds"
+            )
+        G = int(window)
+        if G < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        tg = G if target_gens is None else int(target_gens)
+        if not 1 <= tg <= G:
+            raise ValueError(
+                f"target_gens must be in [1, {G}], got {target_gens}"
+            )
+        K, S = plan.top_k, plan.seen_cap
+        n_occ = len(OCC_CLAUSES)
+        n_rate = len(RATE_CLAUSES)
+        state = self.init_refill(seeds, lanes, ctl, step_cap=step_cap)
+
+        # -- ring upload (the host corpus's current top-K, sorted)
+        ring = dict(ring or {})
+        rn = int(ring.get("n", 0))
+        if not 0 <= rn <= K:
+            raise ValueError(f"ring has {rn} rows, capacity {K}")
+
+        def buf(key, shape, dtype, fill=0):
+            src = ring.get(key)
+            out = np.full(shape, fill, dtype=dtype)
+            if src is not None and rn:
+                out[:rn] = np.asarray(src, dtype=dtype)[:rn]
+            return jnp.array(out)
+
+        ring_bits = buf("bits", (K,), np.int32)
+        ring_seed = buf("seed", (K,), np.uint32)
+        ring_off = buf("off", (K,), np.int32)
+        ring_occ = buf("occ", (K, n_occ), np.int32)
+        ring_rate = buf("rate", (K, n_rate), np.float32, fill=1.0)
+        ring_h = buf("h", (K,), np.int32)
+
+        # -- dedup-table upload + headroom: the window appends at most
+        # one row per candidate, so a full window must fit
+        seen = dict(seen or {})
+        sn = int(seen.get("n", 0))
+        if sn + G * A > S:
+            raise ValueError(
+                f"seen table has {sn} rows + window appends {G * A} "
+                f"> capacity {S}; raise seen_cap or shrink the window"
+            )
+        s1 = np.zeros((S,), np.uint32)
+        s2 = np.zeros((S,), np.uint32)
+        if sn:
+            s1[:sn] = np.asarray(seen["h1"], np.uint32)[:sn]
+            s2[:sn] = np.asarray(seen["h2"], np.uint32)[:sn]
+
+        un = (
+            np.zeros((COV_WORDS,), np.uint32) if union is None
+            else np.asarray(union, np.uint32)
+        )
+        if un.shape != (COV_WORDS,):
+            raise ValueError(
+                f"union bitmap must be [{COV_WORDS}] u32, got {un.shape}"
+            )
+        gh = (
+            np.zeros((A,), np.int32) if gen_h_raw is None
+            else np.asarray(gen_h_raw, np.int32)
+        )
+        go = (
+            np.zeros((A,), np.int32) if gen_origin is None
+            else np.asarray(gen_origin, np.int32)
+        )
+        zi = functools.partial(jnp.zeros, dtype=jnp.int32)
+        # jnp.array COPIES throughout (donation safety — the loop carry
+        # is donated every segment, same rule as the refill queue)
+        loop = DevLoop(
+            meta_key=jnp.uint32(key_from_seed(int(meta_seed))),
+            counter=jnp.int32(int(meta_counter)),
+            next_fresh=jnp.uint32(int(next_fresh) & 0xFFFFFFFF),
+            gens_done=jnp.int32(0),
+            target_gens=jnp.int32(tg),
+            accepts=jnp.int32(0),
+            ring_n=jnp.int32(rn),
+            ring_bits=ring_bits,
+            ring_seed=ring_seed,
+            ring_off=ring_off,
+            ring_occ=ring_occ,
+            ring_rate=ring_rate,
+            ring_h=ring_h,
+            union=jnp.array(un),
+            seen_h1=jnp.array(s1),
+            seen_h2=jnp.array(s2),
+            seen_n=jnp.int32(sn),
+            gen_h_raw=jnp.array(gh),
+            gen_origin=jnp.array(go),
+            arch_seed=jnp.zeros((G, A), jnp.uint32),
+            arch_off=zi((G, A)),
+            arch_occ=zi((G, A, n_occ)),
+            arch_rate=jnp.ones((G, A, n_rate), jnp.float32),
+            arch_h=zi((G, A)),
+            arch_origin=zi((G, A)),
+            arch_violated=jnp.zeros((G, A), jnp.bool_),
+            arch_bitmap=jnp.zeros((G, A, COV_WORDS), jnp.uint32),
+            arch_hiwater=zi((G, A)),
+            arch_transitions=zi((G, A)),
+        )
+        return state._replace(loop=loop)
+
+    def run_devloop(
+        self, state: SimState,
+        dispatch_steps: int = DEFAULT_DISPATCH_STEPS,
+        total_steps: Optional[int] = None,
+    ) -> SimState:
+        """Run a device-loop window to completion: segments of the SAME
+        jitted step as every other mode, with the generation boundary
+        firing inside the step whenever a generation fully retires. The
+        default `total_steps` bound (step_cap * A * G) can never bind —
+        even fully serialized admissions across every generation fit —
+        and the speculative early-stop exits once the final generation
+        drains, so the generous bound costs at most one no-op segment.
+        Decode ONCE with `devloop_results` — that single transfer is the
+        window's only host sync."""
+        if state.loop is None:
+            raise ValueError("run_devloop needs an init_devloop state")
+        A = int(state.queue.seeds.shape[0])
+        G = int(state.loop.arch_seed.shape[0])
+        if total_steps is None:
+            total_steps = int(state.refill.step_cap) * A * G
         return self.run_state(state, total_steps, dispatch_steps)
 
     # --------------------------------------------------- sharded refill
@@ -4094,6 +4899,56 @@ def refill_results(state: SimState) -> dict:
     out["total_lane_steps"] = iters * L
     out["occupancy"] = busy / max(iters * L, 1)
     out["truncated"] = int(live.sum())
+    return out
+
+
+def devloop_results(state: SimState) -> dict:
+    """Decode a finished device-loop window — the ONE host sync the
+    window pays (r19, docs/explore.md). Returns the search cursors
+    (meta counter, next_fresh, seen_n), the corpus ring + coverage
+    union as upload-ready dicts (feed them straight back into
+    `init_devloop` for the next window), and one dict per executed
+    generation with the archived genomes and per-admission results in
+    admission order — exactly what the host `Explorer._fold_part`
+    replays to rebuild its corpus."""
+    import numpy as np
+
+    dl = state.loop
+    if dl is None:
+        raise ValueError("devloop_results needs a run_devloop final state")
+    rn = int(np.asarray(dl.ring_n))
+    gens_done = int(np.asarray(dl.gens_done))
+    rf = state.refill
+    out = {
+        "gens_done": gens_done,
+        "target_gens": int(np.asarray(dl.target_gens)),
+        "counter": int(np.asarray(dl.counter)),
+        "next_fresh": int(np.asarray(dl.next_fresh)),
+        "accepts": int(np.asarray(dl.accepts)),
+        "seen_n": int(np.asarray(dl.seen_n)),
+        "union": np.array(dl.union),
+        "ring": {
+            "n": rn,
+            "bits": np.array(dl.ring_bits)[:rn],
+            "seed": np.array(dl.ring_seed)[:rn],
+            "off": np.array(dl.ring_off)[:rn],
+            "occ": np.array(dl.ring_occ)[:rn],
+            "rate": np.array(dl.ring_rate)[:rn],
+            "h": np.array(dl.ring_h)[:rn],
+        },
+        "iters": int(np.asarray(rf.iters)),
+        "busy_lane_steps": int(np.asarray(rf.busy, np.int64).sum()),
+    }
+    arch = {
+        f: np.array(getattr(dl, "arch_" + f))
+        for f in (
+            "seed", "off", "occ", "rate", "h", "origin", "violated",
+            "bitmap", "hiwater", "transitions",
+        )
+    }
+    out["gens"] = [
+        {f: a[g] for f, a in arch.items()} for g in range(gens_done)
+    ]
     return out
 
 

@@ -29,6 +29,8 @@ from ..nemesis import (
     Duplicate,
     FaultPlan,
     FIRE_KINDS,
+    GENOME_H1,
+    GENOME_H2,
     LatencySpike,
     LinkClog,
     MsgLoss,
@@ -38,7 +40,7 @@ from ..nemesis import (
     Reconfig,
     Reorder,
 )
-from .spec import SimConfig
+from .spec import REBASE_US, SimConfig
 
 
 def compile_plan(plan: FaultPlan, base: Optional[SimConfig] = None) -> SimConfig:
@@ -343,3 +345,61 @@ def coverage_report(summary: Dict[str, Any], cfg: SimConfig) -> str:
                 f"k{k} {ks[k]}" for k in sorted(ks)
             )
     return line
+
+
+# --------------------------------------------------------------------------
+# device-loop genome faces (r19, docs/explore.md)
+# --------------------------------------------------------------------------
+
+
+def genome_hash64(seed, off, occ, rate_scale, horizon_us):
+    """(h1, h2) — the 64-bit genome-dedup hash, DEVICE face.
+
+    Two independent fold chains over the genome words (seed, off, the
+    occ rows, the f32 BIT PATTERNS of the rate rows, the raw horizon)
+    from the shared `nemesis.GENOME_H1`/`GENOME_H2` roots. Bit-exact
+    mirror of the host `explore.genome_hash64`: both faces fold the same
+    words from the same roots through the same murmur3 chain, so a hash
+    collision — the only way hashed dedup can diverge from exact set
+    membership — hits the host loop and the device loop identically.
+    Broadcasts over leading axes (occ: [..., n_occ], rate_scale:
+    [..., n_rate])."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import prng
+
+    words = [
+        jnp.asarray(seed, jnp.uint32),
+        jnp.asarray(off, jnp.int32).astype(jnp.uint32),
+    ]
+    occ = jnp.asarray(occ, jnp.int32)
+    for i in range(occ.shape[-1]):
+        words.append(occ[..., i].astype(jnp.uint32))
+    rs = jnp.asarray(rate_scale, jnp.float32)
+    for i in range(rs.shape[-1]):
+        words.append(lax.bitcast_convert_type(rs[..., i], jnp.uint32))
+    words.append(jnp.asarray(horizon_us, jnp.int32).astype(jnp.uint32))
+    h1 = jnp.uint32(GENOME_H1)
+    h2 = jnp.uint32(GENOME_H2)
+    for w in words:
+        h1 = prng.fold(h1, w)
+        h2 = prng.fold(h2, w)
+    return prng.mix(h1), prng.mix(h2)
+
+
+def genome_ctl_rows(horizon_raw, full_horizon_us: int):
+    """(h_epoch, h_off) — the lossy genome->TriageCtl horizon encode,
+    DEVICE face of `explore.ctl_for`'s `c.horizon_us or full_h` rows: a
+    raw genome horizon of 0 decodes to the config's full horizon, then
+    splits into the engine's epoch-rebased (h_epoch, h_off) pair. The
+    off/occ/rate genome columns pass through to ctl rows unchanged, so
+    this is the only encode arithmetic the device boundary needs."""
+    import jax.numpy as jnp
+
+    h_eff = jnp.where(
+        jnp.asarray(horizon_raw, jnp.int32) == 0,
+        jnp.int32(int(full_horizon_us)),
+        jnp.asarray(horizon_raw, jnp.int32),
+    )
+    return h_eff // jnp.int32(REBASE_US), h_eff % jnp.int32(REBASE_US)

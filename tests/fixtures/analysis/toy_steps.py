@@ -92,6 +92,44 @@ def leaky_refill(key, key0, done, qseeds, cursor):
     return new_key, new_key0, victim
 
 
+# ------------------------------------------------ leaky device-loop ring
+
+def clean_devloop_ring(key, meta_key, counter, ring_seed, ring_n, done):
+    # the legal device-loop generation boundary (r19): a mutant's new
+    # schedule root derives from a corpus-ring PARENT seed alone, picked
+    # by a MetaRng draw — (meta_key, counter) is the host MetaRng's
+    # murmur cursor, deliberately disjoint from every lane's schedule
+    # key, and survivors' running chains never enter the ring
+    d0 = prng.bits(meta_key, 301, index=counter)
+    pidx = jnp.clip(
+        (d0 % jnp.maximum(ring_n, 1).astype(jnp.uint32)).astype(jnp.int32),
+        0, ring_seed.shape[0] - 1,
+    )
+    root = prng.key_from(ring_seed[pidx])
+    new_key = jnp.where(done, root, prng.fold(key, 1))
+    victim = prng.randint(root, 203, 0, 5)  # schedule draw: ring seed only
+    return new_key, victim
+
+
+def leaky_ring(key, meta_key, counter, ring_seed, ring_n, done):
+    # the planted device-loop leak: the corpus-ring scatter FOLDS A
+    # SURVIVOR LANE'S RUNNING KEY CHAIN into the stored seed — every
+    # mutant descended from that row then runs a fault schedule that is
+    # a function of how far other lanes happened to have run, not of
+    # (seed, clause, occurrence); rng-taint must catch the ring-rooted
+    # draw mixing chain (KEY2) material
+    leaked = ring_seed.at[0].set(prng.fold(ring_seed[0], key[0]))
+    d0 = prng.bits(meta_key, 301, index=counter)
+    pidx = jnp.clip(
+        (d0 % jnp.maximum(ring_n, 1).astype(jnp.uint32)).astype(jnp.int32),
+        0, ring_seed.shape[0] - 1,
+    )
+    root = prng.key_from(leaked[pidx])
+    new_key = jnp.where(done, root, prng.fold(key, 1))
+    victim = prng.randint(root, 203, 0, 5)  # a schedule draw off it
+    return new_key, victim
+
+
 # ------------------------------------------------- sharded collectives
 
 def clean_sharded_segment(mesh):

@@ -169,6 +169,47 @@ def test_rng_taint_passes_clean_refill():
     assert res.checked > 0
 
 
+# the device-loop toy signature: (key, meta_key, counter, ring_seed,
+# ring_n, done) — the ring's seed column is a key ROOT (the same _init
+# verification convention as the refill queue's seed column), the
+# MetaRng cursor and ring row count are neutral schedule-root inputs
+# (jaxpr_check.DEVLOOP_NEUTRAL), and `done` is control material
+_DEVLOOP_TOY_NAMES = [
+    "hot.key", "cold.loop.meta_key", "cold.loop.counter", "const.key0",
+    "cold.loop.ring_n", "hot.done",
+]
+
+
+def _devloop_toy_args():
+    return (
+        _sds((LANES,), jnp.uint32), _sds((), jnp.uint32),
+        _sds((), jnp.int32), _sds((7,), jnp.uint32),
+        _sds((), jnp.int32), _sds((LANES,), jnp.bool_),
+    )
+
+
+def test_rng_taint_fires_on_leaky_ring():
+    """The planted device-loop leak (r19): the corpus-ring scatter folds
+    a SURVIVOR LANE'S running key chain into a stored seed — every
+    mutant descended from that ring row then runs a fault schedule that
+    depends on how far other lanes happened to have run. rng-taint must
+    flag the ring-rooted draw mixing chain (KEY2) material."""
+    closed = jax.make_jaxpr(toys.leaky_ring)(*_devloop_toy_args())
+    res = check_rng_taint(closed, _DEVLOOP_TOY_NAMES, set(), "toy")
+    assert not res.ok
+    assert any("schedule-purity" in v.detail for v in res.violations)
+
+
+def test_rng_taint_passes_clean_devloop_ring():
+    """The legal twin: the mutant root derives from the ring parent's
+    seed alone, picked by a MetaRng draw off the (neutral) meta cursor —
+    survivors' chains never reach the ring."""
+    closed = jax.make_jaxpr(toys.clean_devloop_ring)(*_devloop_toy_args())
+    res = check_rng_taint(closed, _DEVLOOP_TOY_NAMES, set(), "toy")
+    assert res.ok, [v.render() for v in res.violations]
+    assert res.checked > 0
+
+
 def _toy_mesh():
     import numpy as np
 

@@ -1,0 +1,399 @@
+"""Device-resident search (r19, docs/explore.md): the explorer's
+generation loop runs in-jit — ranking, mutation and admission on device,
+one host sync per window — and the acceptance contract is bit-identity:
+corpus contents, curves, violations and fingerprints equal the host loop
+exactly, window partition and dispatch shape notwithstanding.
+
+`chaos`-marked tests run in the explore-smoke tier; the cross-process
+CLI sweep is `slow` (nightly) because each subprocess pays a cold
+compile. The in-process tests run under conftest's 8 forced host
+devices; the subprocess runs under the default single device, so the
+two together pin device-count independence of the fingerprint.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from madsim_tpu import campaign, telemetry
+from madsim_tpu.explore import (
+    Candidate,
+    CorpusEntry,
+    Explorer,
+    ExploreReport,
+    Federation,
+    genome_hash64,
+)
+
+from tests.test_explore import _planted_workload
+
+LANES = 16
+CHUNK = 8
+SEEN_CAP = 512  # power of two; headroom for every window in the suite
+META_SEED = 11
+GENS = 3
+
+
+@pytest.fixture(scope="module")
+def planted():
+    """The planted workload + ONE devloop-plan sim shared by every
+    in-process test (host AND device explorers — a devloop plan is
+    inert outside `init_devloop`), so the engine compiles once."""
+    from madsim_tpu.tpu.engine import BatchedSim, make_devloop_plan
+
+    wl = _planted_workload()
+    plan = make_devloop_plan(
+        wl.config, pop=LANES, top_k=16, seen_cap=SEEN_CAP
+    )
+    sim = BatchedSim(
+        wl.spec, wl.config, triage=True, coverage=True, devloop=plan
+    )
+    return wl, sim
+
+
+def _explorer(wl, sim, **kw):
+    base = dict(
+        meta_seed=META_SEED, lanes=LANES, chunk=CHUNK,
+        shrink_violations=False, seen_cap=SEEN_CAP, sim=sim,
+    )
+    base.update(kw)
+    return Explorer(wl, **base)
+
+
+@pytest.fixture(scope="module")
+def host_baseline(planted):
+    """The host-loop reference run every device variant must match, plus
+    its dispatch cost (deltas on the shared sim's counter)."""
+    wl, sim = planted
+    d0 = sim.dispatch_count
+    rep = _explorer(wl, sim).run(GENS)
+    return rep, sim.dispatch_count - d0
+
+
+def _count_syncs(monkeypatch):
+    """Count the device loop's host syncs (devloop_results decodes) —
+    the budget the tentpole buys down to one per window."""
+    from madsim_tpu.tpu import engine
+
+    calls = []
+    real = engine.devloop_results
+    monkeypatch.setattr(
+        engine, "devloop_results",
+        lambda st: calls.append(1) or real(st),
+    )
+    return calls
+
+
+def _assert_bit_identical(dev: ExploreReport, host: ExploreReport):
+    assert dev.fingerprint() == host.fingerprint()
+    assert dev.coverage_curve == host.coverage_curve
+    assert dev.corpus_curve == host.corpus_curve
+    assert dev.violation_curve == host.violation_curve
+    assert dev.corpus_digest == host.corpus_digest
+    assert dev.violations == host.violations
+    assert dev.seeds_run == host.seeds_run
+
+
+# ----------------------------------------------------- host/device identity
+
+
+@pytest.mark.chaos
+def test_device_loop_matches_host_loop_bit_for_bit(
+    planted, host_baseline, monkeypatch
+):
+    """The tentpole contract: device_window=2 over 3 generations (a full
+    window then a partial one) produces the host loop's exact corpus,
+    curves and fingerprint — in strictly fewer dispatches, with ONE host
+    sync per window."""
+    wl, sim = planted
+    host_rep, host_d = host_baseline
+    syncs = _count_syncs(monkeypatch)
+    d0 = sim.dispatch_count
+    dev_rep = _explorer(
+        wl, sim, device_loop=True, device_window=2
+    ).run(GENS)
+    dev_d = sim.dispatch_count - d0
+    _assert_bit_identical(dev_rep, host_rep)
+    assert dev_d < host_d
+    assert len(syncs) == 2  # windows 2+1: one decode each, <= 1/gen
+
+
+@pytest.mark.chaos
+def test_device_loop_single_window_covers_all_generations(
+    planted, host_baseline, monkeypatch
+):
+    """All 3 generations inside ONE device window: the deepest in-jit
+    chain still lands bit-identical, with a SINGLE host sync for the
+    whole search."""
+    wl, sim = planted
+    host_rep, _ = host_baseline
+    syncs = _count_syncs(monkeypatch)
+    dev_rep = _explorer(
+        wl, sim, device_loop=True, device_window=GENS
+    ).run(GENS)
+    _assert_bit_identical(dev_rep, host_rep)
+    assert len(syncs) == 1  # three generations, one decode
+
+
+@pytest.mark.chaos
+def test_device_loop_pipeline_flag_is_identity(planted, host_baseline):
+    """`pipeline` is a dispatch-shape knob outside the search identity;
+    the device loop must keep that true (it shares run_state with every
+    other mode)."""
+    wl, sim = planted
+    host_rep, _ = host_baseline
+    dev_rep = _explorer(
+        wl, sim, device_loop=True, device_window=2, pipeline=False
+    ).run(GENS)
+    assert dev_rep.fingerprint() == host_rep.fingerprint()
+
+
+# --------------------------------------------------------- kill / resume
+
+
+@pytest.mark.chaos
+def test_campaign_kill_resume_mid_ring(tmp_path, planted):
+    """Kill/resume bit-identity THROUGH the device loop: checkpoint at
+    generation 1 (the corpus ring is live, mid-window-schedule), resume
+    into a fresh Campaign, run 2 more — fingerprint equals the
+    uninterrupted 3-generation device-loop run even though the window
+    partition differs (2+1 uninterrupted vs 1 then 2 resumed). The
+    resume reconstructs device_loop/device_window/seen_cap from the
+    persisted explorer_params."""
+    wl, sim = planted
+    kw = dict(
+        meta_seed=META_SEED, lanes=LANES, chunk=CHUNK, shrink=False,
+        sim=sim, explorer_kwargs=dict(
+            device_loop=True, device_window=2, seen_cap=SEEN_CAP,
+        ),
+    )
+    full = campaign.Campaign(wl, str(tmp_path / "full"), **kw)
+    rep_full = full.run(GENS)
+
+    part = campaign.Campaign(wl, str(tmp_path / "part"), **kw)
+    part.run(1)
+    part.checkpoint()
+    del part  # the "kill": only the checkpoint survives
+
+    resumed = campaign.Campaign.resume(
+        str(tmp_path / "part"), workload=wl, sim=sim
+    )
+    assert resumed.generation == 1
+    assert resumed.ex.device_loop
+    assert resumed.ex.device_window == 2
+    rep_res = resumed.run(GENS - 1)
+
+    _assert_bit_identical(rep_res, rep_full)
+
+
+# ------------------------------------------------------------- federation
+
+
+@pytest.mark.chaos
+def test_federation_device_loop_matches_host_federation():
+    """Island federation with device-resident islands: windows clip to
+    exchange boundaries, and the federation fingerprint AND exchange log
+    equal the host-loop federation exactly — which is what keeps the
+    fingerprint pinned across device counts (the host-loop federation's
+    own invariance is pinned in test_multichip)."""
+    from madsim_tpu.tpu.engine import BatchedSim, make_devloop_plan
+
+    wl = _planted_workload()
+    # island fresh sub-queues: first_seed=i, stride=n_islands — the plan
+    # must carry the federation's stride
+    plan = make_devloop_plan(
+        wl.config, pop=8, top_k=16, seen_cap=SEEN_CAP, fresh_stride=2
+    )
+    sim = BatchedSim(
+        wl.spec, wl.config, triage=True, coverage=True, devloop=plan
+    )
+    kw = dict(
+        n_islands=2, meta_seed=7, lanes=8, exchange_every=2,
+        mesh=None, sim=sim, seen_cap=SEEN_CAP,
+    )
+    host = Federation(wl, **kw).run(4)
+    # device_window=3 > exchange_every forces the clip
+    dev = Federation(
+        wl, device_loop=True, device_window=3, **kw
+    ).run(4)
+    assert dev["fingerprint"] == host["fingerprint"]
+    assert dev["exchanges"] == host["exchanges"]
+    assert dev["coverage_bits"] == host["coverage_bits"]
+    assert dev["violations"] == host["violations"]
+
+
+# ------------------------------------------- counter alignment (mutation)
+
+
+def _plant_parents(ex, n=3):
+    """Synthesize corpus parents with novelty (no device work): ranking
+    only reads (new_bits, dispatch, cand)."""
+    from madsim_tpu.tpu.engine import COV_WORDS
+
+    for i in range(n):
+        bm = np.zeros((COV_WORDS,), np.uint32)
+        bm[i] = 1
+        cand = Candidate(seed=10_000 + i)
+        ex._claim(cand)
+        ex.corpus.append(CorpusEntry(
+            cand=cand, new_bits=n - i, bitmap=bm, hiwater=0,
+            transitions=0, violated=False, dispatch=0,
+        ))
+
+
+@pytest.mark.chaos
+def test_population_counter_alignment_and_draw_free_fallback(planted):
+    """The satellite-1 pin: a mutant slot is ONE fixed draw schedule
+    (parent + op + params, 3 or 4 meta draws by op — the device's
+    adv_of table), and a seen-duplicate falls back to the next fresh
+    seed WITHOUT consuming any draw. Host-only: no dispatch."""
+    from madsim_tpu.nemesis import mutation_vocab
+
+    wl, sim = planted
+    a = _explorer(wl, sim)
+    _plant_parents(a)
+    c0, s0 = a._rng.counter, len(a._seen_h)
+    pop_a = a._population(1)
+    delta_a = a._rng.counter - c0
+
+    assert len(pop_a) == LANES
+    # the population layout is plan arithmetic (the device mirrors it):
+    # fresh block, then the mutant slots, then swarm groups
+    n_mut = int(LANES * a.mutant_frac)
+    n_fresh0 = int(LANES * a.fresh_frac)
+    n_swarm = LANES - n_mut - n_fresh0 if a._togglable else 0
+    n_fresh = LANES - n_mut - n_swarm
+    mslots = range(n_fresh, n_fresh + n_mut)
+    # a mutant slot is origin "mutant", or "fresh" when its drawn genome
+    # was already claimed (the draw-free fallback)
+    assert all(pop_a[i].origin in ("mutant", "fresh") for i in mslots)
+    assert all(pop_a[i].origin == "fresh" for i in range(n_fresh))
+    # exactly ONE new genome claimed per slot — the host seen-set and
+    # the device seen-table grow in lockstep
+    assert len(a._seen_h) - s0 == LANES
+    # the advance table's bounds: 3..4 draws per mutant (parent + op +
+    # params, whether or not it falls back), plus one coin per togglable
+    # clause per swarm group
+    sched, rate, togglable = mutation_vocab(a.cfg)
+    n_groups = (n_swarm + a.swarm_group - 1) // a.swarm_group
+    swarm_draws = n_groups * len(togglable)
+    assert 3 * n_mut + swarm_draws <= delta_a <= 4 * n_mut + swarm_draws
+
+    # now the SAME search, but one surviving mutant's genome is
+    # pre-claimed: the slot must fall back fresh with an IDENTICAL
+    # counter advance (the fallback consumes no draws)
+    target = next(i for i in mslots if pop_a[i].origin == "mutant")
+    b = _explorer(wl, sim)
+    _plant_parents(b)
+    b._seen_h.add(genome_hash64(pop_a[target].key()))
+    c0 = b._rng.counter
+    pop_b = b._population(1)
+    assert b._rng.counter - c0 == delta_a  # draw-free fallback
+    assert pop_b[target].origin == "fresh"  # the device's org code 0
+    assert pop_b[target].off == 0 and pop_b[target].horizon_us == 0
+    # every other surviving mutant slot drew the same schedule
+    for i in mslots:
+        if i != target and pop_a[i].origin == "mutant":
+            assert pop_b[i] == pop_a[i]
+
+
+# --------------------------------------------------------------- telemetry
+
+
+@pytest.mark.chaos
+def test_telemetry_devloop_is_observe_only(tmp_path, planted, host_baseline):
+    """The satellite-6 pin: record_explore_devloop observes the window's
+    decoded values at the one host sync — gauges move, the fingerprint
+    (the golden) does not."""
+    wl, sim = planted
+    host_rep, _ = host_baseline
+    telemetry.enable(out_dir=str(tmp_path))
+    try:
+        dev_rep = _explorer(
+            wl, sim, device_loop=True, device_window=2
+        ).run(GENS)
+        reg = telemetry.get_registry()
+        total = reg.counter(
+            "explore_devloop_generations"
+        ).value(meta_seed=META_SEED)
+        assert total == GENS
+        # last window of the 2+1 partition retired one generation
+        assert reg.gauge(
+            "explore_devloop_window_generations"
+        ).value(meta_seed=META_SEED) == 1
+        occ = reg.gauge(
+            "explore_devloop_ring_occupancy"
+        ).value(meta_seed=META_SEED)
+        assert 0.0 <= occ <= 1.0
+        # one genome claimed per lane per generation, both faces
+        assert reg.gauge(
+            "explore_devloop_seen_rows"
+        ).value(meta_seed=META_SEED) == GENS * LANES
+    finally:
+        telemetry.disable()
+    assert dev_rep.fingerprint() == host_rep.fingerprint()
+    events = telemetry.read_events(str(tmp_path / "events.jsonl"))
+    assert any(
+        e["name"] == "explore_devloop_ring_occupancy" for e in events
+    )
+
+
+# ---------------------------------------------------------------- the CLI
+
+
+@pytest.mark.chaos
+def test_cli_device_loop_in_process(planted, host_baseline, monkeypatch,
+                                    capsys):
+    """`--device-loop --device-window` through main(): the JSON report
+    fingerprints identically to the host baseline."""
+    from madsim_tpu import explore
+
+    wl, sim = planted
+    host_rep, _ = host_baseline
+    monkeypatch.setattr(explore, "_named_workload", lambda *a: wl)
+    orig_init = Explorer.__init__
+    monkeypatch.setattr(
+        Explorer, "__init__",
+        lambda self, *a, **k: orig_init(self, *a, **{**k, "sim": sim}),
+    )
+    explore.main([
+        "--workload", "raft", "--meta-seed", str(META_SEED),
+        "--lanes", str(LANES), "--chunk", str(CHUNK),
+        "--dispatches", str(GENS), "--no-shrink",
+        "--device-loop", "--device-window", "2", "--json",
+    ])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rep = ExploreReport.from_json(line)
+    assert rep.fingerprint() == host_rep.fingerprint()
+
+
+@pytest.mark.slow
+def test_cli_device_loop_cross_process_bit_identity(tmp_path):
+    """Two COLD processes — default device topology (one host device,
+    unlike conftest's forced 8), zero shared state — agree bit-for-bit
+    across the host/device loop boundary."""
+    base = [
+        sys.executable, "-m", "madsim_tpu.explore",
+        "--workload", "raft", "--virtual-secs", "0.5",
+        "--meta-seed", "3", "--lanes", "8", "--chunk", "8",
+        "--dispatches", "3", "--no-shrink", "--json",
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+
+    def run(extra):
+        out = subprocess.run(
+            base + extra, env=env, capture_output=True, text=True,
+            timeout=900, check=True,
+        )
+        return ExploreReport.from_json(out.stdout.strip().splitlines()[-1])
+
+    host = run([])
+    dev = run(["--device-loop", "--device-window", "2"])
+    assert dev.fingerprint() == host.fingerprint()
+    assert dev.coverage_curve == host.coverage_curve
+    assert dev.device_dispatches < host.device_dispatches

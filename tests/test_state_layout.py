@@ -139,6 +139,9 @@ LAYOUT = {
     # outside refill mode — plain sweeps carry zero refill bytes
     "queue": None,
     "refill": None,
+    # device-resident search (r19, docs/explore.md): None outside
+    # device-loop mode — plain and refill sweeps carry zero DevLoop bytes
+    "loop": None,
 }
 
 # the refill-mode additions (BatchedSim.init_refill with A admissions

@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke devloop-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke reconfig-smoke durability-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
+.PHONY: test deep test-all lint analyze check chaos-smoke triage-smoke explore-smoke campaign-smoke refill-smoke devloop-smoke multichip-smoke telemetry-smoke explain-smoke oracle-smoke reconfig-smoke durability-smoke speclang-smoke tune tune-smoke regression real native bench bench-smoke campaign-bench compaction-ab ttfb explore-bench dryrun demo clean
 
 test:            ## fast tier (< ~3.5 min; what CI runs per-commit)
 	$(PY) -m pytest tests/ -q
@@ -61,6 +61,12 @@ reconfig-smoke:  ## <60s CPU: membership as a fault axis end to end — the plan
 durability-smoke: ## <80s CPU: durability as a fault axis end to end — the planted ack-before-fsync WAL bug under a disk-ONLY plan is found by the explorer, ddmin-shrinks to disk occurrence atoms, campaign-dedups to ONE BugRecord, and the cross-witness anatomy names the ACK delivery fsync never covered; then the wal/fs spec suites
 	$(PY) benches/durability_smoke.py
 	$(PY) -m pytest tests/test_tpu_wal.py tests/test_fs_durability.py -q -m "not slow"
+
+speclang-smoke:  ## <60s CPU warm: single-source specs end to end — regenerate and diff the emitted modules against the checked-in files, verifier+certifier gate on the speclang-native backup protocol, golden-digest identity for the twopc re-derivation, planted stale-read bug fires/shrinks to its message axis/replays from the ReproBundle on both faces; then the speclang spec suite
+	$(PY) -m madsim_tpu.speclang emit --check
+	$(PY) -m madsim_tpu.analysis --quiet --rule range --workload backup
+	$(PY) benches/speclang_smoke.py
+	$(PY) -m pytest tests/test_speclang.py -q
 
 tune:            ## measured autotune over every workload's throughput knobs; winners cached per (device_kind, workload, config, lane bucket) and consumed via tuning="auto" (docs/tuning.md)
 	$(PY) -m madsim_tpu.tune --workload all --virtual-secs 10 --lanes 32768

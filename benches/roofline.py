@@ -757,6 +757,11 @@ def roofline(lanes: int = 32768, scan: int = 300, variants: bool = True) -> dict
                 jnp.where(kind == -1, now + 50_000, jnp.int32(-1)),
             )
 
+        # the ablated trio is internally consistent (same identity
+        # behavior); the stale-wrapper guard requires it to be visible
+        id_on_message.__wraps_event__ = id_on_event
+        id_on_timer.__wraps_event__ = id_on_event
+
         ablations = {
             "no_handlers": dataclasses.replace(
                 spec, on_message=id_on_message, on_timer=id_on_timer,

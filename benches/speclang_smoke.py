@@ -1,0 +1,199 @@
+"""speclang-smoke: <60s single-source-spec gate for CI (warm cache).
+
+Speclang's pitch is that ONE spec source is the whole protocol: both
+faces are emitted from it, nothing hand-restated, and the emitted spec
+is gated by the same prove-don't-trust machinery as the hand modules.
+This smoke walks that claim end to end on CPU:
+
+  * DRIFT: the checked-in `speclang/generated/` modules are exactly
+    what the current spec sources render to (in-process `emit --check`)
+    and every SPECLANG_DIGEST pins its source's sha256 (`make
+    speclang-smoke` also runs the CLI form before this script);
+  * IDENTITY: the twopc re-derivation's chaotic 16-lane trajectory
+    hashes to the SAME pinned golden constant the hand module is held
+    to — the compiler added zero operations to the dataflow;
+  * BUG: the speclang-native primary-backup protocol's planted
+    stale-read bug (apply guard `!=` instead of `>`) violates monotone
+    reads on many lanes under its dup/reorder workload, and the
+    correct build stays silent under the identical plan;
+  * SHRINK+REPLAY: the first violating seed ddmin-shrinks to a
+    ReproBundle whose minimal plan keeps the message-clause axis
+    (Duplicate/Reorder — crash alone cannot deliver a stale REPL after
+    a newer apply), and the bundle replays bit-identically
+    (repro.replay, repeats=2) still violating at the recorded step;
+  * HOST: the generated host twin reproduces the same bug at a pinned
+    seed under a plan-mode Duplicate+Reorder schedule, and the correct
+    twin survives the identical plan and seed.
+
+The verifier+certifier leg (`python -m madsim_tpu.analysis --quiet
+--rule range --workload backup`) runs as its own Makefile line.
+
+Wall times are printed for eyes only. Usage:
+python benches/speclang_smoke.py  (or `make speclang-smoke`)
+Exit code != 0 on any assertion failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LANES = 64
+STEPS = 2000
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from madsim_tpu import nemesis, repro, triage
+    from madsim_tpu.speclang import device, emit
+    from madsim_tpu.speclang.generated import backup_host
+    from madsim_tpu.speclang.specs import PROTOCOLS
+    from madsim_tpu.speclang.specs import backup as s_backup
+    from madsim_tpu.speclang.specs import twopc as s_twopc
+    from madsim_tpu.tpu import nemesis as tpu_nemesis
+    from madsim_tpu.tpu.engine import BatchedSim
+    from madsim_tpu.tpu.spec import SimConfig
+    from tests import test_state_layout as tsl
+
+    t0 = time.perf_counter()
+
+    # -- drift: generated modules == a fresh render of their sources ----
+    clean, drifted = emit.emit(check=True)
+    assert not drifted, (
+        f"generated modules drifted from their spec sources: {drifted} — "
+        "run `python -m madsim_tpu.speclang emit`"
+    )
+    assert len(clean) == 2 * len(PROTOCOLS)
+    for src in PROTOCOLS:
+        want = emit.source_digest(src)
+        for face in ("device", "host"):
+            mod = __import__(
+                f"madsim_tpu.speclang.generated.{src}_{face}",
+                fromlist=["SPECLANG_DIGEST"],
+            )
+            assert mod.SPECLANG_DIGEST == want, (
+                f"{src}_{face}.py digest does not pin specs/{src}.py"
+            )
+    t_drift = time.perf_counter() - t0
+
+    # -- identity: re-derived twopc == the pinned golden trajectory -----
+    t1 = time.perf_counter()
+    cfg = tpu_nemesis.compile_plan(
+        tsl.CHAOS_PLAN, SimConfig(horizon_us=30_000_000)
+    )
+    st = BatchedSim(device.build(s_twopc.PROTOCOL), cfg).run(
+        jnp.arange(16, dtype=jnp.uint32), max_steps=1500,
+        dispatch_steps=1500,
+    )
+    assert tsl.canonical_digest(st) == tsl.GOLDEN["twopc"], (
+        "speclang twopc re-derivation diverged from the hand module's "
+        "golden digest"
+    )
+    t_identity = time.perf_counter() - t1
+
+    # -- bug: the planted stale read fires only when planted ------------
+    t2 = time.perf_counter()
+    wl = device.build_workload(s_backup.PROTOCOL, buggy=True)
+    seeds = jnp.arange(LANES, dtype=jnp.uint32)
+    stb = BatchedSim(wl.spec, wl.config).run(
+        seeds, max_steps=STEPS, dispatch_steps=STEPS
+    )
+    violated = np.asarray(stb.violated)
+    n_bug = int(violated.sum())
+    assert n_bug >= 5, (
+        f"planted stale-read bug fired on only {n_bug}/{LANES} lanes — "
+        "the dup/reorder axis is not delivering stale REPLs"
+    )
+    wl0 = device.build_workload(s_backup.PROTOCOL)
+    st0 = BatchedSim(wl0.spec, wl0.config).run(
+        seeds, max_steps=STEPS, dispatch_steps=STEPS
+    )
+    n_ok = int(np.asarray(st0.violated).sum())
+    assert n_ok == 0, (
+        f"correct backup spec violated on {n_ok} lanes under its own plan"
+    )
+    assert int(np.asarray(st0.events).sum()) > 0
+    t_bug = time.perf_counter() - t2
+
+    # -- shrink + replay: bundle keeps the message axis and reproduces --
+    t3 = time.perf_counter()
+    seed = int(np.nonzero(violated)[0][0])
+    root = tempfile.mkdtemp(prefix="speclang-smoke-")
+    try:
+        shrunk = triage.shrink_seed(
+            wl, seed, out_dir=root,
+            spec_ref="madsim_tpu.speclang.generated.backup_device:make_spec",
+            spec_kwargs={"buggy": True},
+        )
+        bundle = triage.ReproBundle.load(shrunk.bundle_path)
+        assert bundle.violation_step > 0
+        kept = {
+            type(c).__name__
+            for c in triage.plan_from_json(bundle.plan).clauses
+        }
+        assert kept & {"Duplicate", "Reorder"}, (
+            f"shrunk plan {sorted(kept)} lost the message-clause axis "
+            "the stale-read bug requires"
+        )
+        rep = repro.replay(
+            bundle, backend="tpu", repeats=2, out=lambda *_: None
+        )
+        assert rep.get("violated"), (
+            f"ReproBundle replay of the planted bug did not violate: {rep}"
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    t_shrink = time.perf_counter() - t3
+
+    # -- host face: the generated twin reproduces the same bug ----------
+    t4 = time.perf_counter()
+    plan = nemesis.FaultPlan(
+        name="backup-bug",
+        clauses=(
+            nemesis.Duplicate(rate=0.15),
+            nemesis.Reorder(rate=0.3, window_us=250_000),
+        ),
+    )
+    try:
+        backup_host.fuzz_one_seed(
+            0, virtual_secs=8.0, chaos=False, plan=plan, buggy=True
+        )
+    except backup_host.InvariantViolation:
+        host_hit = True
+    else:
+        host_hit = False
+    assert host_hit, (
+        "planted bug did not reproduce on the generated host twin at "
+        "the pinned seed"
+    )
+    r = backup_host.fuzz_one_seed(0, virtual_secs=8.0, chaos=False,
+                                  plan=plan)
+    assert r["checks"] > 0, "correct host twin never ran its oracle"
+    t_host = time.perf_counter() - t4
+
+    print(json.dumps({
+        "speclang_smoke": "ok",
+        "buggy_lanes": n_bug,
+        "shrunk_kept": sorted(kept),
+        "secs": {
+            "drift": round(t_drift, 2),
+            "identity": round(t_identity, 2),
+            "bug": round(t_bug, 2),
+            "shrink_replay": round(t_shrink, 2),
+            "host": round(t_host, 2),
+            "total": round(time.perf_counter() - t0, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

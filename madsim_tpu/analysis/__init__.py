@@ -82,8 +82,18 @@ ALL_RULES = JAXPR_RULES + LINT_RULES
 # allowlisted by exact primitive name), donation (const must be EMPTY:
 # the boundary rewrites even the admission queue), and range (ring/seen
 # cursor bounds via engine.interval_hints(devloop=True)).
-WORKLOADS = (
-    "raft", "kv", "paxos", "twopc", "chain", "isr", "lease", "wal",
+def _registry_targets() -> tuple:
+    # the per-protocol targets come from the consolidated workload
+    # registry (madsim_tpu.workloads) — speclang-generated entries
+    # (twopc-gen, lease-gen, backup) are gated exactly like hand-written
+    # ones; the registry import is jax-free, so building the CLI choices
+    # costs nothing
+    from .. import workloads as registry
+
+    return registry.names(analysis=True)
+
+
+WORKLOADS = _registry_targets() + (
     "raft-refill", "raft-refill-sharded", "raft-lineage", "raft-devloop",
 )
 

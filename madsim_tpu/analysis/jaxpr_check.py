@@ -157,27 +157,14 @@ def full_fault_plan():
 
 
 def spec_factories() -> Dict[str, object]:
-    from ..tpu.chain import make_chain_spec
-    from ..tpu.isr import make_isr_spec
-    from ..tpu.kv import make_kv_spec
-    from ..tpu.lease import make_lease_spec
-    from ..tpu.paxos import make_paxos_spec
-    from ..tpu.raft import make_raft_spec
-    from ..tpu.twopc import make_twopc_spec
-    from ..tpu.wal import make_wal_spec
+    # one map, derived from the consolidated workload registry
+    # (madsim_tpu.workloads) — includes wal (the one hand spec with a
+    # durable plane: its hot.dur.* watermark leaves and recovery
+    # copy-back are range-certified here) and every speclang-generated
+    # entry, which is gated by the same rules as the hand-written specs
+    from .. import workloads as registry
 
-    return {
-        "raft": make_raft_spec,
-        "kv": make_kv_spec,
-        "paxos": make_paxos_spec,
-        "twopc": make_twopc_spec,
-        "chain": make_chain_spec,
-        "isr": make_isr_spec,
-        "lease": make_lease_spec,
-        # the one spec with a durable plane: its hot.dur.* watermark
-        # leaves and recovery copy-back are range-certified here
-        "wal": make_wal_spec,
-    }
+    return registry.spec_factories(analysis=True)
 
 
 def build_verified_sim(

@@ -16,7 +16,14 @@ where no single test's assertions can see them:
                      schedule, host NemesisDriver, device `nem_*` knobs —
                      cross-checked against the enumerable registries in
                      `madsim_tpu/nemesis.py` (SCHEDULE_CLAUSES,
-                     MESSAGE_CLAUSES, CLAUSE_EVENT_KINDS, ...).
+                     MESSAGE_CLAUSES, CLAUSE_EVENT_KINDS, ...). The same
+                     rule also covers the workload registry mirror
+                     (`check_workload_registry`): every `WorkloadEntry`
+                     row resolves to real factories and host twins, the
+                     consumer modules actually read the registry instead
+                     of re-growing private lists, and speclang-generated
+                     rows' `SPECLANG_DIGEST` pins match the current spec
+                     sources (with `emit --check` run in-process).
   both-faces         every field folded into the device coverage bitmap
                      is also folded by the pure trace mirror
                      (`explore.cov_index`), counted against the
@@ -919,6 +926,151 @@ def check_marker_hygiene(
     return res
 
 
+# ------------------------------------------------------- workload registry
+
+# modules whose factory tables are DERIVED from the workload registry —
+# each must textually import `workloads` (the consolidation contract:
+# no consumer re-grows a private protocol list)
+REGISTRY_CONSUMERS = (
+    "madsim_tpu/explore.py",
+    "madsim_tpu/tune.py",
+    "madsim_tpu/oracle.py",
+    "madsim_tpu/analysis/__init__.py",
+    "madsim_tpu/analysis/jaxpr_check.py",
+)
+
+_REGISTRY_IMPORT_RE = re.compile(
+    r"(?:from\s+\.{1,2}\s+import\s+workloads"
+    r"|import\s+madsim_tpu\.workloads)"
+)
+
+
+def check_workload_registry(root: Optional[str] = None) -> RuleResult:
+    """The consolidated workload registry (madsim_tpu/workloads) is the
+    single wiring table, and it is LIVE:
+
+      (a) every row's device face resolves — the module imports and the
+          spec/workload factory attributes (plus `knobs_attr` when
+          declared) exist and are callable;
+      (b) every row's host face (when declared) exposes `fuzz_one_seed`
+          and `InvariantViolation`; rows flagged `oracle_twin` must
+          declare a host face (the comparator needs a plan-mode twin);
+      (c) the consumer modules whose tables were folded into the
+          registry actually import it — re-grown private lists would
+          silently drop new rows from those faces;
+      (d) speclang-generated rows name their spec source, both emitted
+          faces carry a `SPECLANG_DIGEST` equal to the current sha256
+          of that source, and `emit --check` is clean in-process — an
+          edited spec with stale generated modules fails HERE, not at
+          3am in a chaos sweep.
+    """
+    import importlib
+
+    res = RuleResult("mirror")
+    root = root or repo_root()
+    from .. import workloads as registry
+    from ..speclang import emit as speclang_emit
+
+    # (a) + (b): every row resolves on every declared face
+    for e in registry.ENTRIES:
+        res.checked += 1
+        where = f"workloads registry [{e.name}]"
+        try:
+            mod = importlib.import_module(e.module)
+        except Exception as exc:  # pragma: no cover - wiring error
+            res.add(where, f"device module {e.module} fails to import: "
+                           f"{exc!r}")
+            continue
+        for attr in filter(None, (e.spec_attr, e.workload_attr,
+                                  e.knobs_attr)):
+            fn = getattr(mod, attr, None)
+            if not callable(fn):
+                res.add(
+                    where,
+                    f"{e.module}.{attr} is missing or not callable — the "
+                    "row's device face does not resolve",
+                )
+        if e.oracle_twin and e.host_module is None:
+            res.add(
+                where,
+                "flagged oracle_twin but declares no host_module — the "
+                "differential oracle has no plan-mode twin to run",
+            )
+        if e.host_module is not None:
+            try:
+                hmod = importlib.import_module(e.host_module)
+            except Exception as exc:  # pragma: no cover - wiring error
+                res.add(where, f"host module {e.host_module} fails to "
+                               f"import: {exc!r}")
+                continue
+            if not callable(getattr(hmod, "fuzz_one_seed", None)):
+                res.add(
+                    where,
+                    f"{e.host_module} exposes no callable fuzz_one_seed",
+                )
+            if getattr(hmod, "InvariantViolation", None) is None:
+                res.add(
+                    where,
+                    f"{e.host_module} exposes no InvariantViolation — "
+                    "fuzz drivers cannot classify its failures",
+                )
+
+    # (c): the consumers read the registry, not private lists
+    for rel in REGISTRY_CONSUMERS:
+        res.checked += 1
+        path = os.path.join(root, *rel.split("/"))
+        if not os.path.exists(path):
+            res.add(rel, "registry consumer file is missing")
+            continue
+        src, _ = _read(path)
+        if not _REGISTRY_IMPORT_RE.search(src):
+            res.add(
+                rel,
+                "never imports the workload registry — its factory "
+                "table has de-consolidated into a private list",
+            )
+
+    # (d): generated rows pin their spec source by digest, and the
+    # checked-in generated modules match a fresh in-process render
+    for e in registry.ENTRIES:
+        if not e.generated:
+            continue
+        res.checked += 1
+        where = f"workloads registry [{e.name}]"
+        if e.source_module is None:
+            res.add(where, "generated=True but source_module is unset")
+            continue
+        src_name = e.source_module.rsplit(".", 1)[-1]
+        try:
+            want = speclang_emit.source_digest(src_name)
+        except OSError as exc:
+            res.add(where, f"spec source {e.source_module} unreadable: "
+                           f"{exc!r}")
+            continue
+        for face_mod in (e.module, e.host_module):
+            if face_mod is None:
+                continue
+            got = getattr(importlib.import_module(face_mod),
+                          "SPECLANG_DIGEST", None)
+            if got != want:
+                res.add(
+                    where,
+                    f"{face_mod}.SPECLANG_DIGEST {str(got)[:12]}... != "
+                    f"sha256({e.source_module}) {want[:12]}... — the "
+                    "spec source changed without `python -m "
+                    "madsim_tpu.speclang emit`",
+                )
+    res.checked += 1
+    _, drifted = speclang_emit.emit(check=True)
+    for fname in drifted:
+        res.add(
+            f"madsim_tpu/speclang/generated/{fname}",
+            "drifts from an in-process re-render of its spec source — "
+            "re-run `python -m madsim_tpu.speclang emit`",
+        )
+    return res
+
+
 # -------------------------------------------------------------------- runner
 
 
@@ -930,6 +1082,7 @@ def run_source_lints(root: Optional[str] = None, log=print) -> List[RuleResult]:
         check_entropy(root),
         check_both_faces(root=root),
         check_mirror(root=root),
+        check_workload_registry(root=root),
         check_layout_agreement(root=root),
         check_marker_hygiene(root),
     ]

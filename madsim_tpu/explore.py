@@ -1642,21 +1642,14 @@ def storm_plan(horizon_us: int):
 def _named_workload(name: str, virtual_secs: float, storm: bool):
     import dataclasses as dc
 
-    from .tpu import (
-        chain_workload, isr_workload, kv_workload, lease_workload,
-        paxos_workload, raft_workload, twopc_workload,
-    )
+    from . import workloads as registry
 
-    factories = {
-        "raft": raft_workload, "kv": kv_workload, "twopc": twopc_workload,
-        "paxos": paxos_workload, "chain": chain_workload,
-        "isr": isr_workload, "lease": lease_workload,
-    }
-    if name not in factories:
+    choices = registry.names(explorable=True)
+    if name not in choices:
         raise SystemExit(
-            f"unknown workload {name!r} (choose from {sorted(factories)})"
+            f"unknown workload {name!r} (choose from {sorted(choices)})"
         )
-    wl = factories[name](virtual_secs=virtual_secs)
+    wl = registry.workload_factory(name)(virtual_secs=virtual_secs)
     wl = dc.replace(wl, host_repro=None)
     if storm:
         from .tpu import nemesis as tn

@@ -53,35 +53,19 @@ from . import telemetry
 # --------------------------------------------------------------------------
 
 
-def _raft_twin(seed, plan, occ_off, n_nodes, virtual_secs, loss_rate):
-    from .workloads.raft_host import fuzz_one_seed
+# spec-name prefix -> schedule-matched host twin runner, derived from
+# the consolidated workload registry (entries flagged oracle_twin). A
+# twin runs ONE lane with `plan=`/`occ_off=` (NemesisDriver mode) and
+# lineage on, and returns the workload dict whose "nemesis" key is the
+# artifact bundle the comparator consumes. Specs without an entry are
+# skipped (counted, never silently).
+from . import workloads as _workload_registry
 
-    return fuzz_one_seed(
-        seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
-        loss_rate=loss_rate, chaos=False, plan=plan, occ_off=occ_off,
-        lineage=True,
-    )
+HOST_TWINS: Dict[str, Callable[..., dict]] = _workload_registry.oracle_twins()
 
-
-def _chain_twin(seed, plan, occ_off, n_nodes, virtual_secs, loss_rate):
-    from .workloads.chain_host import fuzz_one_seed
-
-    return fuzz_one_seed(
-        seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
-        loss_rate=loss_rate, chaos=False, plan=plan, occ_off=occ_off,
-        lineage=True,
-    )
-
-
-# spec-name prefix -> schedule-matched host twin runner. A twin runs ONE
-# lane with `plan=`/`occ_off=` (NemesisDriver mode) and lineage on, and
-# returns the workload dict whose "nemesis" key is the artifact bundle
-# the comparator consumes. Specs without an entry are skipped (counted,
-# never silently).
-HOST_TWINS: Dict[str, Callable[..., dict]] = {
-    "raft": _raft_twin,
-    "chain": _chain_twin,
-}
+# direct handles for the two standing twins (tests drive them one-off)
+_raft_twin = HOST_TWINS["raft"]
+_chain_twin = HOST_TWINS["chain"]
 
 
 def twin_for(spec_name: str) -> Optional[Callable[..., dict]]:

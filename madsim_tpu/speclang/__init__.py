@@ -1,0 +1,50 @@
+"""Speclang — the single-source protocol spec compiler (ROADMAP item 1).
+
+The reference madsim's whole product is that ONE source of user code
+runs unchanged on both the real and the simulated runtime behind the
+`--cfg madsim` boundary. This reproduction had drifted into the
+opposite regime: every protocol was authored twice — a fused device
+`on_event` in `tpu/<x>.py` plus a host-runtime twin in
+`workloads/<x>_host.py` — and then wired by hand through narrow_fields,
+rate_floors, narrow_horizon_us, durable/sync fields, msg_kind_names and
+five scattered registries. Speclang closes the gap: a protocol is ONE
+spec source (`speclang/specs/<x>.py`, written in the restricted
+vocabulary `lang.py` validates) and two thin generated modules
+(`speclang/generated/<x>_device.py` / `<x>_host.py`) that are emitted by
+`python -m madsim_tpu.speclang emit`, checked in, and drift-checked.
+
+  lang.py    the language surface: Field/Rate/Cap/Messages/KnobDecl/
+             DiskPlane declarations + the Protocol container, plus the
+             AST restriction validator (no unbounded loops, literal
+             PRNG sites, no ambient entropy).
+  device.py  the device backend: `build(proto)` derives the state
+             NamedTuple, init, on_restart, narrow_fields, rate_floors,
+             narrow_horizon_us, time_fields, msg_kind_names, the
+             durable plane and Tier-B SpecKnob rows FROM the
+             declarations — never re-stated — and emits the fused
+             masked `ProtocolSpec` the engine runs.
+  hostrt.py  the host backend: a generic host-runtime twin that runs
+             the SAME handler bodies as breakpointable per-node tasks
+             over `net.Endpoint`, with chaos (native or NemesisDriver
+             plan mode) and the spec's own invariant as the oracle.
+  emit.py    the deterministic generated-module emitter + the
+             spec-source digest that pins generated output to source.
+
+Every generated spec is gated by the PR 7 verifier (all jaxpr/lint
+rules) and the PR 8 range certifier exactly like a hand-written one —
+declared bounds are PROVED, not trusted (`python -m madsim_tpu.analysis
+--all` traces twopc-gen/lease-gen/backup). Registration is one row in
+`madsim_tpu/workloads/__init__.py`. See docs/speclang.md.
+"""
+
+from __future__ import annotations
+
+from .lang import (  # noqa: F401
+    Cap,
+    DiskPlane,
+    Field,
+    KnobDecl,
+    Protocol,
+    Rate,
+    validate_protocol,
+)

@@ -1,0 +1,333 @@
+"""The speclang host backend: a generic host-runtime twin.
+
+The hand-written `workloads/<x>_host.py` twins re-implement each
+protocol as bespoke coroutines and hope review keeps the two faces
+agreeing. The speclang twin closes that gap structurally: it runs the
+SAME compiled handler bodies the device face runs — `spec.on_message` /
+`spec.on_timer` from `device.build(proto)`, jitted once — as one
+breakpointable task per node over the host runtime's simulated network
+(`net.Endpoint` raw datagrams, so loss/delay/dup come from the runtime,
+not the engine). There is no second implementation to drift.
+
+Per-node event loop = the device contract, verbatim:
+  * wait for a datagram until the node's timer deadline; deliver it via
+    `on_message` (a negative returned timer KEEPS the deadline),
+  * on deadline, fire `on_timer` (a negative returned timer DISARMS),
+  * send every valid outbox row as a raw datagram to its destination.
+
+Chaos mirrors the hand twins: host-native kill/restart (durable state
+survives through `spec.on_restart`; a wipe fraction rebuilds from
+`spec.init` — the membership epoch), or NemesisDriver plan mode
+(`plan=`) with `on_wipe` doing the rebuild. The oracle is the spec's
+own `check_invariants`, stacked over the per-node states by a periodic
+checker task — the same function, same masks, as the device face.
+
+`fuzz_one_seed(proto, seed, ...)` is the debugging-microscope entry
+the generated `<x>_host.py` modules re-export with the protocol bound.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import madsim_tpu as ms
+from ..net import Endpoint, NetSim
+from ..tpu import prng
+from . import device
+from .lang import Protocol
+
+_PORT = 7900
+_TAG = 0
+WIPE_FRAC = 0.5  # host-native chaos: fraction of restarts that wipe
+CHECK_EVERY = 0.05  # virtual seconds between invariant sweeps
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+# one compiled twin kit per (protocol, overrides): the spec build plus
+# its jitted handlers — a fuzz sweep over many seeds compiles once
+_KITS: dict = {}
+
+
+class _TwinKit:
+    def __init__(self, proto: Protocol, overrides: dict):
+        self.proto = proto
+        self.spec = device.build(proto, **overrides)
+        self.n_nodes = self.spec.n_nodes
+        self.payload_width = self.spec.payload_width
+        self.on_message = jax.jit(self.spec.on_message)
+        self.on_timer = jax.jit(self.spec.on_timer)
+        self.check = jax.jit(self.spec.check_invariants)
+
+    def init(self, key, nid):
+        return self.spec.init(key, jnp.int32(nid))
+
+    def restart(self, state, nid, now_us, key):
+        return self.spec.on_restart(state, jnp.int32(nid),
+                                    jnp.int32(now_us), key)
+
+
+def kit_for(proto: Protocol, **overrides) -> _TwinKit:
+    key = (id(proto), tuple(sorted(overrides.items())))
+    if key not in _KITS:
+        _KITS[key] = _TwinKit(proto, overrides)
+    return _KITS[key]
+
+
+class _TwinNode:
+    """One node: the device state + timer deadline, driven by events."""
+
+    def __init__(self, kit: _TwinKit, nid: int, seed: int,
+                 addrs: List[str], born_us: int):
+        self.kit = kit
+        self.nid = nid
+        self.seed = seed
+        self.addrs = addrs
+        self._draws = 0
+        state, first = kit.init(self._key(), nid)
+        self.state = state
+        # init's deadline is an offset from the node's birth (a fresh
+        # wipe-join init starts its clock at the join, like the engine)
+        self.timer: Optional[int] = born_us + int(first)
+
+    def _key(self):
+        # a private deterministic key chain per (seed, node, draw):
+        # the twin needs determinism, not the engine's lane key stream
+        self._draws += 1
+        return prng.fold(
+            prng.fold(jnp.uint32(self.seed), self.nid + 1),
+            self._draws,
+        )
+
+    def apply_restart(self, now_us: int) -> None:
+        state, t = self.kit.restart(self.state, self.nid, now_us,
+                                    self._key())
+        self.state = state
+        self.timer = int(t)
+
+    async def _deliver(self, out) -> None:
+        valid = np.asarray(out.valid)
+        dst = np.asarray(out.dst)
+        kind = np.asarray(out.kind)
+        payload = np.asarray(out.payload)
+        for row in np.nonzero(valid)[0]:
+            d = int(dst[row])
+            msg = (int(kind[row]), tuple(int(x) for x in payload[row]))
+            try:
+                await self.ep.send_to_raw(
+                    (self.addrs[d], _PORT), _TAG, msg
+                )
+            except (OSError, ms.sync.ChannelClosed):
+                pass
+
+    async def run(self) -> None:
+        self.ep = await Endpoint.bind(f"{self.addrs[self.nid]}:{_PORT}")
+        t = ms.time.current()
+        while True:
+            now_us = int(t.elapsed() * 1e6)
+            if self.timer is not None and self.timer <= now_us:
+                st, out, nt = self.kit.on_timer(
+                    self.state, jnp.int32(self.nid), jnp.int32(now_us),
+                    self._key(),
+                )
+                self.state = st
+                nt = int(nt)
+                self.timer = nt if nt >= 0 else None  # negative disarms
+                await self._deliver(out)
+                continue
+            wait = (
+                (self.timer - now_us) / 1e6 if self.timer is not None
+                else 3600.0
+            )
+            try:
+                data, frm = await ms.time.timeout(
+                    wait, self.ep.recv_from_raw(_TAG)
+                )
+            except ms.time.TimeoutError_:
+                continue  # the timer branch fires on the next pass
+            except (OSError, ms.sync.ChannelClosed):
+                return
+            kind, vals = data
+            src = self.addrs.index(frm[0])
+            now_us = int(t.elapsed() * 1e6)
+            st, out, nt = self.kit.on_message(
+                self.state, jnp.int32(self.nid), jnp.int32(src),
+                jnp.int32(kind), jnp.asarray(vals, jnp.int32),
+                jnp.int32(now_us), self._key(),
+            )
+            self.state = st
+            nt = int(nt)
+            if nt >= 0:  # negative keeps the deadline on a message
+                self.timer = nt
+            await self._deliver(out)
+
+
+def _check_now(kit: _TwinKit, cns: list, alive: list, now_us: int):
+    ns = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[c.state for c in cns]
+    )
+    ok = kit.check(ns, jnp.asarray(alive), jnp.int32(now_us))
+    if not bool(ok):
+        raise InvariantViolation(
+            f"{kit.spec.name}: check_invariants failed at t={now_us}us "
+            "on the host twin (same oracle as the device face)"
+        )
+
+
+def _state_digest(c: "_TwinNode") -> tuple:
+    return tuple(
+        int(np.asarray(leaf).astype(np.int64).sum())
+        for leaf in c.state
+    )
+
+
+async def _fuzz_body(
+    kit: _TwinKit,
+    seed: int,
+    virtual_secs: float,
+    chaos: bool,
+    plan=None,
+    occ_off=None,
+) -> dict:
+    handle = ms.Handle.current()
+    n = kit.n_nodes
+    addrs = [f"10.0.9.{i + 1}" for i in range(n)]
+    cns: list = [None] * n
+    alive = [True] * n
+    t = ms.time.current()
+
+    def make_node(i: int, wipe: bool) -> _TwinNode:
+        now_us = int(t.elapsed() * 1e6)
+        old = cns[i]
+        if old is None or wipe:
+            fresh = _TwinNode(kit, i, seed, addrs, born_us=now_us)
+        else:
+            fresh = old
+            fresh.apply_restart(now_us)
+        cns[i] = fresh
+        return fresh
+
+    nodes = []
+    if plan is not None:
+        def make_init(i: int):
+            def _init():
+                # plan-mode wipes route through on_wipe (below), which
+                # marks the slot; init rebuilds accordingly
+                return make_node(i, wipe=cns[i] is None).run()
+
+            return _init
+
+        for i in range(n):
+            node = (
+                handle.create_node()
+                .name(f"{kit.spec.name}-{i}")
+                .ip(addrs[i])
+                .init(make_init(i))
+                .build()
+            )
+            nodes.append(node)
+    else:
+        for i in range(n):
+            node = handle.create_node().name(
+                f"{kit.spec.name}-{i}"
+            ).ip(addrs[i]).build()
+            node.spawn(make_node(i, wipe=True).run())
+            nodes.append(node)
+
+    async def chaos_task() -> None:
+        while True:
+            await ms.time.sleep(0.5 + ms.rand() * 1.5)
+            victim = ms.randrange(n)
+            alive[victim] = False
+            handle.kill(nodes[victim].id)
+            await ms.time.sleep(0.3 + ms.rand() * 0.6)
+            wipe = ms.rand() < WIPE_FRAC
+            if wipe:
+                cns[victim] = None
+            fresh = make_node(victim, wipe=wipe)
+            alive[victim] = True
+            handle.restart(nodes[victim].id)
+            nodes[victim].spawn(fresh.run())
+
+    if chaos and plan is None:
+        ms.spawn(chaos_task())
+
+    driver = None
+    if plan is not None:
+        from .. import nemesis as nem
+
+        def on_wipe(i: int) -> None:
+            cns[i] = None
+
+        driver = nem.NemesisDriver(
+            plan,
+            handle,
+            node_ids=[nd.id for nd in nodes],
+            horizon_us=int(virtual_secs * 1e6),
+            seed=seed,
+            on_wipe=on_wipe,
+            occ_off=occ_off,
+        )
+        driver.install()
+
+    end = t.elapsed() + virtual_secs
+    checks = 0
+    while t.elapsed() < end:
+        await ms.time.sleep(CHECK_EVERY)
+        if all(c is not None for c in cns):
+            _check_now(kit, cns, alive, int(t.elapsed() * 1e6))
+            checks += 1
+    stats = {
+        "checks": checks,
+        "events": ms.plugin.simulator(NetSim).stat().msg_count,
+        "state": [_state_digest(c) if c is not None else None
+                  for c in cns],
+    }
+    if driver is not None:
+        stats["nemesis"] = {
+            "applied": list(driver.applied),
+            "occ_fired": dict(driver.occ_fired),
+            "node_skew": dict(getattr(handle.time, "node_skew", {}) or {}),
+            "node_ids": [nd.id for nd in nodes],
+            "coins": driver.coins,
+            "fires": driver.fire_counts(),
+            "state": stats["state"],
+        }
+    return stats
+
+
+def fuzz_one_seed(
+    proto: Protocol,
+    seed: int,
+    n_nodes: Optional[int] = None,
+    virtual_secs: float = 10.0,
+    loss_rate: float = 0.1,
+    chaos: bool = True,
+    buggy: bool = False,
+    plan=None,
+    occ_off=None,
+    lineage: bool = False,  # accepted for twin-runner parity; unused
+) -> dict:
+    """One complete fuzzed host execution of a speclang protocol,
+    verified by the spec's own invariant. Raises InvariantViolation."""
+    overrides = {}
+    if n_nodes is not None:
+        overrides["n_nodes"] = n_nodes
+    if buggy:
+        if proto.buggy_param is None:
+            raise ValueError(f"{proto.name}: no planted-bug param declared")
+        overrides[proto.buggy_param] = True
+    kit = kit_for(proto, **overrides)
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = loss_rate
+    rt = ms.Runtime(seed=seed, config=cfg)
+    return rt.block_on(
+        _fuzz_body(kit, seed, virtual_secs, chaos, plan=plan,
+                   occ_off=occ_off)
+    )

@@ -1,0 +1,276 @@
+"""Primary-backup log shipping — the first speclang-NATIVE protocol.
+
+Unlike twopc/lease (hand specs re-derived to prove bit-identity), this
+protocol never existed as a hand module: the whole thing is this one
+spec source, and both faces — the fused device `ProtocolSpec` and the
+host-runtime twin — are generated.
+
+Shape: node 0 is the PRIMARY, nodes 1..N-1 are BACKUPS. The primary's
+timer mints versions and broadcasts REPL(ver, val) to every backup
+(fsync-before-ack: the apply bumps `syncs`, the spec's sync_field, in
+the same step), and occasionally reads from one random backup
+(READ -> RESP(b_ver, b_val)) — the stand-in for a client hitting a
+read replica. A backup applies a REPL iff it is NEWER than what it
+holds (`ver > b_ver`) and ACKs; it answers READs from its local copy.
+
+Safety — monotone reads per replica: the versions one backup serves
+never go backwards. Each backup tracks `served_max` (the highest b_ver
+it has ever answered a READ with) and latches the sticky `regress` flag
+the moment it is about to serve an OLDER version. Detection is local to
+the backup (race-free: no cross-node join), and every reset path moves
+the plane together — a reconfig wipe re-inits b_ver/served_max/regress
+as one, a disk crash rolls all three back to the same watermark
+(they share the durable plane), a plain restart keeps all three.
+
+THE PLANTED BUG (`buggy=True`): the apply guard degrades from
+`ver > b_ver` to `ver != b_ver` — "anything different must be news".
+A DUPLICATED or REORDERED stale REPL then re-applies an old version
+over a newer one, the next READ observes b_ver < served_max, and the
+invariant fires. The bug lives purely on the duplicate/reorder axis
+(the workload arms `nem_dup_rate`/`nem_reorder_rate`), which is what
+lets ddmin shrink a repro down to those clauses — crash/restart alone
+cannot fire it (durable state restarts exactly where it stopped).
+
+PRNG sites: 90 (repl-vs-read coin), 91 (read target), 92 (timer
+re-arm), 93 (first fire), 94 (restart fire).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tpu import prng
+from ...tpu.spec import Outbox, SimConfig, pool_kw_for
+from ..lang import DiskPlane, Field, Protocol, Rate
+
+REPL, ACK, READ, RESP = 0, 1, 2, 3
+PAYLOAD_WIDTH = 3  # (ver, val, spare)
+
+_VER_WHY = (
+    "only the primary mints, at most one ver per timer fire; every "
+    "primary arm (first, re-arm, restart) draws >= tick_us, margin 2 "
+    "for skew derating"
+)
+
+
+def _fields(p):
+    N = p.n_nodes
+    # ver is the one minted counter; b_ver/served_max/ack_ver hold
+    # COPIES of it (REPL / served REPL / ACK payloads), certified by the
+    # range certifier's copy induction
+    def ver_rate(why):
+        return Rate(floor_us=p.tick_us, ratchet=1, inc=1, margin=2,
+                    why=why)
+
+    return (
+        Field("ver", narrow="u16", rate=ver_rate(_VER_WHY),
+              doc="primary: latest minted version"),
+        Field("val", doc="primary: payload of the latest version"),
+        Field("b_ver", narrow="u16", rate=ver_rate("copy: REPL payload"),
+              doc="backup: version held"),
+        Field("b_val", doc="backup: value held"),
+        Field("served_max", narrow="u16",
+              rate=ver_rate("copy: max over served b_ver values"),
+              doc="backup: highest version ever served to a READ"),
+        Field("regress", narrow="u8",
+              doc="backup: sticky monotone-reads violation flag "
+                  "(step-closed in {0,1})"),
+        Field("ack_ver", shape=(N,), durable=False, narrow="u16",
+              rate=ver_rate("copy: ACK payload of minted vers"),
+              doc="primary: highest ver acked per backup (volatile)"),
+        Field("r_seen", durable=False,
+              doc="primary: highest version read back (diagnostics)"),
+        Field("syncs", durable=False,
+              doc="fsync counter — the spec's sync_field"),
+        Field("serves", durable=False,
+              doc="backup: READs answered (diagnostics)"),
+    )
+
+
+def _body(p, State):
+    N = p.n_nodes
+    assert N >= 3
+    tick_us = p.tick_us
+    repl_rate = p.repl_rate
+    buggy = p.buggy
+    peers = jnp.arange(N, dtype=jnp.int32)
+    IDLE_FAR = 2**28  # backups never self-fire
+
+    def first_timer(key, nid):
+        # first fire >= tick_us out: part of the ver rate-floor argument
+        return jnp.where(
+            nid == 0,
+            tick_us + prng.randint(key, 93, 0, tick_us),
+            jnp.int32(IDLE_FAR),
+        )
+
+    def on_event(s, nid, src, kind, payload, now, key):
+        f = payload
+        is_timer = kind == -1
+        is_primary = nid == 0
+
+        # ================= timer path (primary only) ==================
+        coin = prng.uniform(key, 90) < repl_rate
+        do_repl = is_timer & is_primary & coin
+        do_read = is_timer & is_primary & ~coin
+        new_ver = s.ver + 1
+        new_val = new_ver * 7 + 1  # deterministic payload for the ver
+        target = prng.randint(key, 91, 1, N)
+
+        # ================= message path (kind >= 0) ===================
+        is_repl = kind == REPL
+        if buggy:
+            # THE PLANTED BUG: "anything different must be news" — a
+            # duplicated/reordered STALE REPL re-applies an old version
+            news = f[0] != s.b_ver
+        else:
+            news = f[0] > s.b_ver
+        apply = is_repl & ~is_primary & news
+        serve = (kind == READ) & ~is_primary
+        ackin = (kind == ACK) & is_primary
+        respin = (kind == RESP) & is_primary
+
+        state = s._replace(
+            ver=jnp.where(do_repl, new_ver, s.ver),
+            val=jnp.where(do_repl, new_val, s.val),
+            b_ver=jnp.where(apply, f[0], s.b_ver),
+            b_val=jnp.where(apply, f[1], s.b_val),
+            # latch BEFORE folding this serve into served_max
+            regress=jnp.where(serve & (s.b_ver < s.served_max),
+                              1, s.regress),
+            served_max=jnp.where(
+                serve, jnp.maximum(s.served_max, s.b_ver), s.served_max
+            ),
+            ack_ver=jnp.where(ackin & (peers == src),
+                              jnp.maximum(s.ack_ver, f[0]), s.ack_ver),
+            r_seen=jnp.where(respin, jnp.maximum(s.r_seen, f[0]),
+                             s.r_seen),
+            # fsync-before-ack: mint and apply both hit the disk plane
+            syncs=s.syncs + (do_repl | apply).astype(jnp.int32),
+            serves=s.serves + serve.astype(jnp.int32),
+        )
+
+        # ============== merged outbox (E = N rows) ====================
+        # REPL broadcasts on rows 1..N-1; single-message events (READ,
+        # ACK, RESP) put the payload in outbox ROW dst
+        bcast = do_repl
+        single = do_read | apply | serve
+        s_dst = jnp.where(do_read, target, src)
+        s_kind = jnp.where(do_read, READ, jnp.where(apply, ACK, RESP))
+        s_a = jnp.where(do_read, 0, jnp.where(apply, f[0], s.b_ver))
+        s_b = jnp.where(serve, s.b_val, 0)
+        at_row = peers == s_dst  # [N]
+
+        def row(a, b):
+            return jnp.stack([
+                jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32),
+                jnp.int32(0),
+            ])
+
+        out = Outbox(
+            valid=jnp.where(bcast, peers != 0, single & at_row),
+            dst=jnp.where(
+                bcast, peers,
+                jnp.where(single, jnp.full((N,), 1, jnp.int32) * s_dst, 0),
+            ),
+            kind=jnp.where(
+                bcast, REPL, jnp.where(single, s_kind, 0)
+            ) * jnp.ones((N,), jnp.int32),
+            payload=jnp.where(
+                jnp.reshape(bcast, (1, 1)),
+                row(new_ver, new_val)[None, :],
+                jnp.where(
+                    (single & at_row)[:, None], row(s_a, s_b)[None, :], 0,
+                ),
+            ),
+        )
+
+        # primary re-arms every tick (draw >= tick_us: the rate floor);
+        # backups stay unarmed; message events keep their deadline
+        timer_t = jnp.where(
+            is_primary,
+            now + prng.randint(key, 92, tick_us, 2 * tick_us),
+            now + jnp.int32(IDLE_FAR),
+        )
+        return state, out, jnp.where(is_timer, timer_t, jnp.int32(-1))
+
+    def restart_timer(s, nid, now, key):
+        return jnp.where(
+            nid == 0,
+            now + tick_us + prng.randint(key, 94, 0, tick_us),
+            now + jnp.int32(IDLE_FAR),
+        )
+
+    def check_invariants(ns, alive, now):
+        # monotone reads per replica, detected locally by each backup:
+        # the sticky flag is the violation. No cross-node join — wipes
+        # and disk rollbacks reset/rewind the whole plane together, so
+        # the CORRECT spec holds under every chaos axis.
+        return (ns.regress[1:] == 0).all()
+
+    def lane_metrics(node):
+        return {
+            "mean_primary_ver": node.ver[:, 0].astype(jnp.float32),
+            "mean_backup_ver": (
+                node.b_ver[:, 1:].astype(jnp.float32).mean(-1)
+            ),
+            "regressed_lanes": (node.regress[:, 1:] > 0).any(-1),
+        }
+
+    return {
+        "on_event": on_event,
+        "first_timer": first_timer,
+        "restart_timer": restart_timer,
+        "check_invariants": check_invariants,
+        "lane_metrics": lane_metrics,
+    }
+
+
+def _workload(spec, p, virtual_secs, loss_rate):
+    # the bug's axes: duplicates and reorder (plus loss to create the
+    # version gaps stale re-applies land in); plain crash/restart rides
+    # along to prove the durable plane keeps the invariant wipe-safe
+    return SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        **pool_kw_for(
+            spec,
+            fused=dict(msg_depth_msg=2, msg_spare_slots=2),
+            two_handler=dict(msg_depth_msg=2, msg_depth_timer=2),
+        ),
+        loss_rate=loss_rate,
+        crash_interval_lo_us=500_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=900_000,
+        nem_dup_rate=0.1,
+        # the window must span several REPL gaps (a mint every
+        # tick..2*tick, REPL on ~60% of fires => ~100_000 us apart):
+        # a reordered stale REPL has to land AFTER a newer apply for
+        # the planted guard to regress b_ver
+        nem_reorder_rate=0.25,
+        nem_reorder_window_us=250_000,
+    )
+
+
+PROTOCOL = Protocol(
+    name="backup",
+    messages=("REPL", "ACK", "READ", "RESP"),
+    payload_width=PAYLOAD_WIDTH,
+    params=dict(
+        n_nodes=5,
+        tick_us=40_000,
+        repl_rate=0.6,
+        buggy=False,
+    ),
+    fields=_fields,
+    body=_body,
+    fused=True,
+    max_out=lambda p: p.n_nodes,
+    disk=DiskPlane(
+        fields=("ver", "val", "b_ver", "b_val", "served_max", "regress"),
+        sync_field="syncs",
+    ),
+    buggy_param="buggy",
+    workload=_workload,
+    doc="primary-backup log shipping with monotone-read replicas",
+)

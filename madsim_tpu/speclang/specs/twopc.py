@@ -1,0 +1,354 @@
+"""Two-Phase Commit, as a speclang spec source.
+
+The same protocol as the hand-written `tpu/twopc.py` (presumed abort,
+cooperative termination, static coordinator on node 0 — see that
+module's header for the full protocol narrative), re-derived: the
+handler bodies below are the hand module's fused `on_event` verbatim
+(same ops, same PRNG sites 31-35, same state field order), while
+everything the hand module re-states by hand — the state NamedTuple,
+init, on_restart, narrow_fields, rate_floors, narrow_horizon_us,
+msg_kind_names — is DERIVED from the `Field` declarations by
+`speclang.device`. tests/test_speclang.py pins the generated spec
+against the hand spec's canonical golden digest: bit-identical
+trajectories, or the build is wrong.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tpu import prng
+from ...tpu.spec import Outbox, SimConfig
+from ..lang import Field, KnobDecl, Protocol, Rate
+
+NONE, COMMIT, ABORT = 0, 1, 2
+PREPARE, VOTE, OUTCOME, DREQ = 0, 1, 2, 3
+PAYLOAD_WIDTH = 3  # (tid, flag, spare)
+
+_TID_WHY = (
+    "a mint needs a coordinator timer fire; every re-arm "
+    "(init, post-start, retry, restart) draws >= 1_000 us"
+)
+
+
+def _fields(p):
+    N, TXN = p.n_nodes, p.txn_ring
+    # the i16 tid bound is a RATE argument (one global mint per 1 ms
+    # hard floor, ratchet=1 — only the coordinator mints); o_tid/v_tid
+    # hold COPIES of minted tids, so tid_cur's bound is theirs too.
+    # 32_767 mints ~ 32.7 nonstop virtual seconds before the engine
+    # refuses the soak (skew derating shaves it further).
+    tid_rate = Rate(floor_us=1_000, ratchet=1, inc=1, why=_TID_WHY)
+    return (
+        Field("tid_cur", init=-1, narrow="i16", rate=tid_rate,
+              doc="coordinator: last txn started"),
+        Field("vote_mask", durable=False,
+              narrow=("u8" if N <= 8 else "u16" if N <= 16 else None),
+              doc="coordinator: yes-voter bitmask (volatile)"),
+        Field("o_tid", init=-1, shape=(TXN,), narrow="i16", rate=tid_rate,
+              doc="outcome ring: absolute tid, -1 empty (slot = tid % TXN)"),
+        Field("o_val", shape=(TXN,), narrow="u8",
+              doc="outcome ring: COMMIT/ABORT"),
+        Field("v_tid", init=-1, shape=(TXN,), narrow="i16", rate=tid_rate,
+              doc="own-vote ring: absolute tid, -1 empty"),
+        Field("v_val", shape=(TXN,), narrow="u8",
+              doc="own-vote ring: COMMIT(yes)/ABORT(no)"),
+        Field("decided", doc="outcomes recorded (diagnostics, stays i32)"),
+    )
+
+
+def _body(p, State):
+    N, TXN = p.n_nodes, p.txn_ring
+    assert N >= 3
+    txn_gap_us = p.txn_gap_us
+    prepare_timeout_us = p.prepare_timeout_us
+    doubt_retry_us = p.doubt_retry_us
+    vote_yes_p = p.vote_yes_p
+    peers = jnp.arange(N, dtype=jnp.int32)
+    tidx = jnp.arange(TXN, dtype=jnp.int32)
+    ALL_YES = (1 << N) - 2  # bits 1..N-1
+    IDLE_FAR = 2**28  # "unarmed" participant timer offset (ns-safe int32)
+
+    def record_outcome(s, do, tid, outcome):
+        """Claim slot tid%TXN for (tid, outcome) when `do`; first write
+        for a given tid wins; a tid >= TXN behind the newest recorded
+        one is dropped rather than allowed to evict a newer txn's
+        slot."""
+        at = tidx == (tid % TXN)
+        not_stale = tid > s.o_tid.max() - TXN
+        fresh = do & not_stale & ~(at & (s.o_tid == tid)).any()
+        w = at & fresh
+        return s._replace(
+            o_tid=jnp.where(w, tid, s.o_tid),
+            o_val=jnp.where(w, outcome, s.o_val),
+            decided=s.decided + fresh.astype(jnp.int32),
+        )
+
+    def record_vote(s, do, tid, vote):
+        at = tidx == (tid % TXN)
+        return s._replace(
+            v_tid=jnp.where(do & at, tid, s.v_tid),
+            v_val=jnp.where(do & at, vote, s.v_val),
+        )
+
+    def outcome_of(s, tid):
+        """Recorded outcome for absolute tid, NONE if absent."""
+        hit = (tidx == (tid % TXN)) & (s.o_tid == tid)
+        return jnp.where(hit, s.o_val, 0).sum()
+
+    def unresolved_yes(s):
+        """[TXN] mask: yes-votes with no recorded outcome — the derived
+        in-doubt set (both rings slot a tid identically)."""
+        voted_yes = (s.v_tid >= 0) & (s.v_val == COMMIT)
+        resolved = (s.v_tid == s.o_tid) & (s.o_tid >= 0)
+        return voted_yes & ~resolved
+
+    def first_timer(key, nid):
+        return jnp.where(
+            nid == 0,
+            prng.randint(key, 31, 1_000, txn_gap_us),
+            jnp.int32(IDLE_FAR),
+        )
+
+    def on_event(s, nid, src, kind, payload, now, key):
+        """ALL events — PREPARE/VOTE/OUTCOME/DREQ and the timer tick
+        (kind == -1) — as ONE masked handler; the direct transcription
+        of tpu/twopc.py's fused form (PRNG sites 32/33/34 unchanged)."""
+        f = payload
+        is_timer = kind == -1
+        is_coord = nid == 0
+        tid_msg = f[0]
+        flag = f[1]
+        out_msg = outcome_of(s, tid_msg)  # recorded outcome for f[0]
+
+        # ====================== timer path (kind == -1) ===================
+        # coordinator: a timer fire with an open undecided txn means the
+        # prepare deadline passed OR post-restart recovery — both are
+        # the presumed-abort case. Otherwise start the next txn.
+        open_undecided = (s.tid_cur >= 0) & (
+            outcome_of(s, s.tid_cur) == NONE
+        )
+        do_abort = is_timer & is_coord & open_undecided
+        do_start = is_timer & is_coord & ~open_undecided
+        new_tid = s.tid_cur + 1
+        # participant: cooperative termination for the OLDEST in-doubt
+        # yes-vote (retries walk the set oldest-first as outcomes land)
+        doubt = unresolved_yes(s)
+        in_doubt = (~is_coord) & doubt.any()
+        dreq_tid = jnp.where(doubt, s.v_tid, jnp.int32(2**30)).min()
+        do_dreq_send = is_timer & in_doubt
+
+        # ====================== message path (kind >= 0) ==================
+        is_prep = kind == PREPARE
+        is_vote = kind == VOTE
+        is_outc = kind == OUTCOME
+        is_dreq = kind == DREQ
+
+        # -- PREPARE: defensive dedupe; NO records a local abort
+        # (presumed abort lets a no-voter forget), YES records the
+        # durable in-doubt vote
+        voted = ((tidx == (tid_msg % TXN)) & (s.v_tid == tid_msg)).any()
+        do_prep = is_prep & (nid != 0) & ~((out_msg != NONE) | voted)
+        yes = (
+            prng.uniform(prng.fold(key.astype(jnp.uint32), tid_msg), 33)
+            < vote_yes_p
+        )
+        vote_flag = jnp.where(yes, COMMIT, ABORT)
+
+        # -- VOTE: the coordinator's one open round; any NO => ABORT,
+        # all N-1 YES => COMMIT, decided in the same event that
+        # broadcasts
+        live = (
+            is_vote & is_coord & (tid_msg == s.tid_cur) & (out_msg == NONE)
+        )
+        no = live & (flag == ABORT)
+        mask = jnp.where(
+            live & (flag == COMMIT), s.vote_mask | (1 << src), s.vote_mask
+        )
+        all_yes = live & (mask == ALL_YES)
+        decide = no | all_yes
+
+        # -- DREQ: the coordinator re-sends a recorded outcome (stays
+        # silent while itself undecided; the participant retries)
+        have = is_dreq & is_coord & (out_msg != NONE)
+
+        # -- merged ring writes: the event masks are mutually exclusive,
+        # so all record_outcome sites collapse to ONE ring pass
+        rec_do = do_abort | (do_prep & ~yes) | decide | is_outc
+        rec_tid = jnp.where(do_abort, s.tid_cur, tid_msg)
+        rec_val = jnp.where(
+            do_abort | (do_prep & ~yes) | no, ABORT,
+            jnp.where(all_yes, COMMIT, flag),
+        )
+        state = s._replace(
+            tid_cur=jnp.where(do_start, new_tid, s.tid_cur),
+            vote_mask=jnp.where(do_start | do_abort | decide, 0, mask),
+        )
+        state = record_vote(state, do_prep, tid_msg, vote_flag)
+        state = record_outcome(state, rec_do, rec_tid, rec_val)
+
+        # ================== merged outbox (E = N rows) ====================
+        # broadcast events (coordinator only) use rows 1..N-1;
+        # single-message events put the payload in outbox ROW dst so
+        # each destination gets its own pool region
+        bcast = do_abort | do_start | decide
+        bc_kind = jnp.where(do_start, PREPARE, OUTCOME)
+        bc_tid = jnp.where(
+            do_abort, s.tid_cur, jnp.where(do_start, new_tid, tid_msg)
+        )
+        bc_flag = jnp.where(
+            do_start, 0, jnp.where(do_abort | no, ABORT, COMMIT)
+        )
+        single = do_prep | have | do_dreq_send
+        s_dst = jnp.where(do_dreq_send, jnp.int32(0), src)
+        s_kind = jnp.where(
+            do_prep, VOTE, jnp.where(have, OUTCOME, DREQ)
+        )
+        s_tid = jnp.where(do_dreq_send, dreq_tid, tid_msg)
+        s_flag = jnp.where(do_prep, vote_flag, jnp.where(have, out_msg, 0))
+        at_row = peers == s_dst  # [N]
+
+        def fields(tid, fl):
+            row = jnp.stack([
+                jnp.asarray(tid, jnp.int32), jnp.asarray(fl, jnp.int32),
+                jnp.int32(0),
+            ])
+            return row  # [P]
+
+        out = Outbox(
+            valid=jnp.where(bcast, peers != 0, single & at_row),
+            dst=jnp.where(
+                bcast, peers,
+                jnp.where(single, jnp.full((N,), 1, jnp.int32) * s_dst, 0),
+            ),
+            kind=jnp.where(
+                bcast, bc_kind, jnp.where(single, s_kind, 0)
+            ) * jnp.ones((N,), jnp.int32),
+            payload=jnp.where(
+                jnp.reshape(bcast, (1, 1)),
+                fields(bc_tid, bc_flag)[None, :],
+                jnp.where(
+                    (single & at_row)[:, None],
+                    fields(s_tid, s_flag)[None, :], 0,
+                ),
+            ),
+        )
+
+        # -- timer: coordinator reschedules every tick; a yes-voting
+        # participant arms its in-doubt retry; a deciding coordinator
+        # schedules the next round; everything else keeps its deadline
+        timer_t = jnp.where(
+            is_coord,
+            jnp.where(
+                do_start,
+                now + prepare_timeout_us,
+                now + prng.randint(key, 32, txn_gap_us // 2, txn_gap_us),
+            ),
+            now + jnp.where(in_doubt, doubt_retry_us, IDLE_FAR),
+        )
+        timer_m = jnp.where(
+            do_prep & yes,
+            now + doubt_retry_us,
+            jnp.where(
+                decide,
+                now + prng.randint(key, 34, txn_gap_us // 2, txn_gap_us),
+                jnp.int32(-1),
+            ),
+        )
+        return state, out, jnp.where(is_timer, timer_t, timer_m)
+
+    def restart_timer(s, nid, now, key):
+        # receives the PRE-reset state: the participant arm inspects the
+        # surviving in-doubt set
+        return jnp.where(
+            nid == 0,
+            # fire soon: an open undecided tid_cur gets presumed-aborted
+            now + prng.randint(key, 35, 1_000, txn_gap_us),
+            now + jnp.where(unresolved_yes(s).any(), doubt_retry_us,
+                            IDLE_FAR),
+        )
+
+    def check_invariants(ns, alive, now):
+        # ns leaves are [N, ...] for one lane; slot-aligned joins only
+        # (equal tids can only ever share a slot)
+        ot, ov = ns.o_tid, ns.o_val  # [N, TXN]
+        # atomicity: same absolute tid on two nodes => same outcome
+        same_tid = (ot[:, None, :] == ot[None, :, :]) & (ot[:, None, :] >= 0)
+        diff_out = ov[:, None, :] != ov[None, :, :]
+        atomicity = ~(same_tid & diff_out).any()
+        # vote respect: a node recording COMMIT for a tid it voted NO on
+        joined = (
+            (ns.o_tid == ns.v_tid)
+            & (ns.o_tid >= 0)
+            & (ns.o_val == COMMIT)
+            & (ns.v_val == ABORT)
+        )
+        vote_respect = ~joined.any()
+        return atomicity & vote_respect
+
+    def lane_metrics(node):
+        voted_yes = (node.v_tid >= 0) & (node.v_val == COMMIT)  # [L,N,TXN]
+        resolved = (
+            (node.v_tid[..., :, None] == node.o_tid[..., None, :])
+            & (node.o_tid[..., None, :] >= 0)
+        ).any(-1)
+        return {
+            "mean_decided_txns": node.decided[:, 0].astype(jnp.float32),
+            "in_doubt_lanes": (
+                voted_yes[:, 1:] & ~resolved[:, 1:]
+            ).any((-2, -1)),
+        }
+
+    return {
+        "on_event": on_event,
+        "first_timer": first_timer,
+        "restart_timer": restart_timer,
+        "check_invariants": check_invariants,
+        "lane_metrics": lane_metrics,
+    }
+
+
+def _workload(spec, p, virtual_secs, loss_rate):
+    # the hand twopc_workload's chaos recipe: loss, coordinator crashes
+    # (the blocking case) and partitions; ring depth 2 for overlapping
+    # OUTCOME re-sends and back-to-back PREPARE/OUTCOME broadcasts
+    return SimConfig(
+        horizon_us=int(virtual_secs * 1e6),
+        msg_depth_msg=2,
+        msg_depth_timer=2,
+        loss_rate=loss_rate,
+        crash_interval_lo_us=400_000,
+        crash_interval_hi_us=2_000_000,
+        restart_delay_lo_us=200_000,
+        restart_delay_hi_us=1_000_000,
+        partition_interval_lo_us=400_000,
+        partition_interval_hi_us=1_500_000,
+        partition_heal_lo_us=300_000,
+        partition_heal_hi_us=1_200_000,
+    )
+
+
+PROTOCOL = Protocol(
+    name="twopc-gen",
+    messages=("PREPARE", "VOTE", "OUTCOME", "DREQ"),
+    payload_width=PAYLOAD_WIDTH,
+    params=dict(
+        n_nodes=5,
+        txn_ring=16,
+        txn_gap_us=40_000,
+        prepare_timeout_us=120_000,
+        doubt_retry_us=80_000,
+        vote_yes_p=0.85,
+    ),
+    fields=_fields,
+    body=_body,
+    fused=True,
+    max_out=lambda p: p.n_nodes,
+    max_out_msg=lambda p: p.n_nodes,  # a VOTE receipt can broadcast
+    knobs=(
+        KnobDecl("txn_ring", param="txn_ring", values=(8, 16, 32),
+                 default=16),
+    ),
+    workload=_workload,
+    doc="two-phase commit (presumed abort, cooperative termination)",
+)

@@ -29,7 +29,9 @@ from .spec import (  # noqa: F401
     REBASE_US,
     SimConfig,
     empty_outbox,
+    fuse_two_handlers,
     replace_handlers,
+    wraps_event,
 )
 from .nemesis import (  # noqa: F401
     assert_device_matches_schedule,

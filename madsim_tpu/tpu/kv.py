@@ -65,7 +65,9 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, RateFloor, majority as majority_of
+from .spec import (
+    Outbox, ProtocolSpec, RateFloor, majority as majority_of, wraps_event,
+)
 
 REPLICA, CLAIMING, PRIMARY = 0, 1, 2
 HB, CLAIM, CLAIM_ACK, WREP, WACK, RPROBE, RACK, CREQ, CRSP = range(9)
@@ -557,9 +559,11 @@ def make_kv_spec(
     # spec whose on_message is REPLACED must also pass on_event=None —
     # use spec.replace_handlers)
 
+    @wraps_event(on_event)
     def on_message(s: KvState, nid, src, kind, payload, now, key):
         return on_event(s, nid, src, kind, payload, now, key)
 
+    @wraps_event(on_event)
     def on_timer(s: KvState, nid, now, key):
         return on_event(
             s, nid, jnp.int32(0), jnp.int32(-1),
@@ -776,8 +780,20 @@ def buggy_local_read_spec(base: ProtocolSpec | None = None, **kw) -> ProtocolSpe
         )
         return state, out, timer
 
-    # on_message shares on_event's signature, so the buggy body serves both
-    return dataclasses.replace(spec, on_event=on_event, on_message=on_event)
+    # on_message shares on_event's signature, so the buggy body serves both;
+    # on_timer must be re-derived from the NEW fused body (the stale-wrapper
+    # guard rejects keeping the original spec's wrapper here — behaviorally
+    # identical since kind == -1 never matches CREQ, but visibly so)
+    @wraps_event(on_event)
+    def on_timer(s, nid, now, key):
+        return on_event(
+            s, nid, jnp.int32(0), jnp.int32(-1),
+            jnp.zeros((spec.payload_width,), jnp.int32), now, key,
+        )
+
+    return dataclasses.replace(
+        spec, on_event=on_event, on_message=on_event, on_timer=on_timer
+    )
 
 
 def kv_workload(

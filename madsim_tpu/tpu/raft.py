@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, RateFloor, tree_select
+from .spec import Outbox, ProtocolSpec, RateFloor, tree_select, wraps_event
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 REQUEST_VOTE, VOTE_RESP, APPEND, APPEND_RESP, SNAP = 0, 1, 2, 3, 4
@@ -543,9 +543,11 @@ def make_raft_spec(
     # (for direct calls in tests and the engine's non-fused fallback: a
     # spec whose on_message is REPLACED must also pass on_event=None)
 
+    @wraps_event(on_event)
     def on_message(s: RaftState, nid, src, kind, payload, now, key):
         return on_event(s, nid, src, kind, payload, now, key)
 
+    @wraps_event(on_event)
     def on_timer(s: RaftState, nid, now, key):
         return on_event(
             s, nid, jnp.int32(0), jnp.int32(-1),

@@ -225,6 +225,9 @@ def fuse_two_handlers(spec: "ProtocolSpec") -> "ProtocolSpec":
             jnp.where(is_timer, tm_t, tm_m),
         )
 
+    # record which two-handler bodies this fused body was derived from, so
+    # the ProtocolSpec stale-wrapper guard accepts the resulting spec
+    on_event.__fused_from__ = (spec.on_message, spec.on_timer)
     return dataclasses.replace(spec, on_event=on_event)
 
 
@@ -236,6 +239,29 @@ def pool_kw_for(spec: "ProtocolSpec", fused: dict, two_handler: dict) -> dict:
     a `replace_handlers` spec variant keeps working through the stock
     workload (kv_workload/paxos_workload)."""
     return dict(fused if spec.on_event is not None else two_handler)
+
+
+def wraps_event(on_event: Callable) -> Callable:
+    """Decorator marking a derived on_message/on_timer wrapper as
+    delegating to the given fused `on_event` body.
+
+    Hand-fused specs (raft, kv) define on_event first and derive thin
+    two-handler wrappers from it; the mark is what lets the ProtocolSpec
+    stale-wrapper guard distinguish those legitimate wrappers from a bare
+    `dataclasses.replace(spec, on_message=...)` that silently never runs
+    (the engine keeps executing the fused body). Apply it at the wrapper
+    def site:
+
+        @wraps_event(on_event)
+        def on_message(s, nid, src, kind, payload, now, key):
+            return on_event(s, nid, src, kind, payload, now, key)
+    """
+
+    def mark(fn: Callable) -> Callable:
+        fn.__wraps_event__ = on_event
+        return fn
+
+    return mark
 
 
 def replace_handlers(spec: "ProtocolSpec", **overrides) -> "ProtocolSpec":
@@ -374,6 +400,38 @@ class ProtocolSpec:
     # durable_fields set = use durable_state with init's timer verbatim;
     # no durable_fields at all = disk recovery degenerates to a wipe.
     on_recover: Any = None
+
+    def __post_init__(self):
+        # Stale-wrapper guard (the fuse_two_handlers footgun): on a fused
+        # spec the engine runs ONLY on_event, so a bare
+        # `dataclasses.replace(spec, on_message=...)` produces a spec whose
+        # replacement handler never executes — historically a documented
+        # silent no-op. Refuse such a spec at construction: every
+        # on_message/on_timer on a fused spec must visibly derive from THIS
+        # on_event — be the fused body itself, carry the `wraps_event`
+        # mark for it, or be one of the two bodies `fuse_two_handlers`
+        # fused. Use `replace_handlers` (clears on_event) to override a
+        # wrapper, or override on_event too and mark the new wrappers.
+        if self.on_event is None:
+            return
+        fused_from = getattr(self.on_event, "__fused_from__", ())
+        for role in ("on_message", "on_timer"):
+            w = getattr(self, role)
+            ok = (
+                w is self.on_event
+                or getattr(w, "__wraps_event__", None) is self.on_event
+                or any(w is f for f in fused_from)
+            )
+            if not ok:
+                raise ValueError(
+                    f"{self.name}: {role} does not derive from this "
+                    "spec's fused on_event, so the engine would silently "
+                    f"never run it (a bare dataclasses.replace(spec, "
+                    f"{role}=...) on a fused spec is the classic form). "
+                    "Use replace_handlers(...) to override handlers on a "
+                    "fused spec, or replace on_event as well and mark "
+                    "derived wrappers with @wraps_event(on_event)."
+                )
 
 
 @dataclasses.dataclass(frozen=True)

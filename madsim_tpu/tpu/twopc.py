@@ -63,7 +63,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from . import prng
-from .spec import Outbox, ProtocolSpec, RateFloor
+from .spec import Outbox, ProtocolSpec, RateFloor, wraps_event
 
 NONE, COMMIT, ABORT = 0, 1, 2
 PREPARE, VOTE, OUTCOME, DREQ = 0, 1, 2, 3
@@ -328,9 +328,11 @@ def make_twopc_spec(
     # spec whose on_message is REPLACED must also clear on_event — use
     # spec.replace_handlers)
 
+    @wraps_event(on_event)
     def on_message(s: TpcState, nid, src, kind, payload, now, key):
         return on_event(s, nid, src, kind, payload, now, key)
 
+    @wraps_event(on_event)
     def on_timer(s: TpcState, nid, now, key):
         return on_event(
             s, nid, jnp.int32(0), jnp.int32(-1),

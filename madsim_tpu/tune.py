@@ -1038,13 +1038,22 @@ def apply_tier_b(config, entry: TunedEntry):
 # --------------------------------------------------------------------------
 
 
-WORKLOADS = ("raft", "kv", "twopc", "paxos", "chain")
+def _tune_workloads() -> Tuple[str, ...]:
+    # CLI sweep membership comes from the consolidated workload registry
+    from . import workloads as registry
+
+    return registry.names(tunable=True)
+
+
+WORKLOADS = _tune_workloads()
 
 
 def _spec_knobs_for(name: str, virtual_secs: float) -> Tuple[SpecKnob, ...]:
     """The in-tree Tier-B spec hooks: raft's LOG window and kv's OPS
     history ring, rebuilt through the same factories the named workloads
-    use (docs/tuning.md)."""
+    use (docs/tuning.md); any other workload's hooks come from its
+    registry row (speclang-generated entries derive them from the spec
+    source's knob declarations)."""
     import dataclasses as dc
 
     if name == "raft":
@@ -1075,7 +1084,12 @@ def _spec_knobs_for(name: str, virtual_secs: float) -> Tuple[SpecKnob, ...]:
             tuple(sorted({24, base, min(128, base * 2)})),
             rebuild, default=base,
         ),)
-    return ()
+    from . import workloads as registry
+
+    try:
+        return tuple(registry.spec_knobs(name, virtual_secs))
+    except KeyError:
+        return ()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -1,0 +1,227 @@
+"""The consolidated workload registry: one enumeration, every face.
+
+Before this existed, wiring a protocol into the tree meant editing five
+scattered tables by hand — the explore/campaign CLI factory dict
+(`explore._named_workload`), the analysis target tuple
+(`analysis.WORKLOADS`), the jaxpr-verifier factory map
+(`analysis.jaxpr_check.spec_factories`), the oracle's plan-mode twin
+table (`oracle.HOST_TWINS`) and the tune sweep list (`tune.WORKLOADS`) —
+and nothing but review discipline kept them agreeing. Those tables are
+now all DERIVED from the `WorkloadEntry` rows here, and the mirror lint
+(`analysis.lint.check_workload_registry`) checks each row resolves to
+real factories/host twins and that the consumers actually read this
+registry rather than re-growing private lists.
+
+Speclang-generated protocols (madsim_tpu/speclang/) register through the
+same rows — `generated=True` marks entries whose device/host modules are
+emitted from a single spec source and drift-checked against it — so a
+new protocol is ONE spec file plus ONE row, not two modules and five
+table edits.
+
+The module must stay import-light: entries hold dotted module paths and
+attribute names, resolved lazily on first use (importing this package
+must not pull in jax — the analysis lint tier and CLI help paths read it
+without tracing anything).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadEntry:
+    """One protocol's complete wiring, every face in one row."""
+
+    name: str
+    # device face: the module exposing the spec factory + BatchWorkload
+    # factory (hand-written `tpu/<x>.py` or a speclang-generated module)
+    module: str
+    spec_attr: str
+    workload_attr: str
+    # host face: module exposing `fuzz_one_seed` (+ `InvariantViolation`)
+    host_module: Optional[str] = None
+    # schedule-matched plan-mode twin for the differential oracle
+    # (oracle.HOST_TWINS): fuzz_one_seed must accept plan=/occ_off=/
+    # lineage= and return the "nemesis" artifact bundle
+    oracle_twin: bool = False
+    # member of the `python -m madsim_tpu.tune` CLI sweep list
+    tunable: bool = False
+    # member of the explore/campaign CLI factory table
+    explorable: bool = True
+    # analysis target: jaxpr verifier + range certifier trace this name
+    analysis: bool = True
+    # emitted by speclang from a spec source (drift-checked by lint +
+    # `make speclang-smoke`); `source_module` names that spec source
+    generated: bool = False
+    source_module: Optional[str] = None
+    # optional Tier-B SpecKnob hook: `knobs_attr(virtual_secs)` on
+    # `module` returns tune.SpecKnob rows derived from the spec source
+    knobs_attr: Optional[str] = None
+
+
+_GEN = "madsim_tpu.speclang.generated"
+_SRC = "madsim_tpu.speclang.specs"
+
+ENTRIES: Tuple[WorkloadEntry, ...] = (
+    WorkloadEntry(
+        "raft", "madsim_tpu.tpu.raft", "make_raft_spec", "raft_workload",
+        host_module="madsim_tpu.workloads.raft_host",
+        oracle_twin=True, tunable=True,
+    ),
+    WorkloadEntry(
+        "kv", "madsim_tpu.tpu.kv", "make_kv_spec", "kv_workload",
+        host_module="madsim_tpu.workloads.kv_host", tunable=True,
+    ),
+    WorkloadEntry(
+        "twopc", "madsim_tpu.tpu.twopc", "make_twopc_spec",
+        "twopc_workload",
+        host_module="madsim_tpu.workloads.twopc_host", tunable=True,
+    ),
+    WorkloadEntry(
+        "paxos", "madsim_tpu.tpu.paxos", "make_paxos_spec",
+        "paxos_workload",
+        host_module="madsim_tpu.workloads.paxos_host", tunable=True,
+    ),
+    WorkloadEntry(
+        "chain", "madsim_tpu.tpu.chain", "make_chain_spec",
+        "chain_workload",
+        host_module="madsim_tpu.workloads.chain_host",
+        oracle_twin=True, tunable=True,
+    ),
+    WorkloadEntry(
+        "isr", "madsim_tpu.tpu.isr", "make_isr_spec", "isr_workload",
+        host_module="madsim_tpu.workloads.isr_host",
+    ),
+    WorkloadEntry(
+        "lease", "madsim_tpu.tpu.lease", "make_lease_spec",
+        "lease_workload",
+        host_module="madsim_tpu.workloads.lease_host",
+    ),
+    # wal is an analysis + twin-test workload, not an explore CLI target
+    # (historical parity: the explore factory table never carried it —
+    # its durability plane is exercised by the disk-fault twin tests)
+    WorkloadEntry(
+        "wal", "madsim_tpu.tpu.wal", "make_wal_spec", "wal_workload",
+        host_module="madsim_tpu.workloads.wal_host", explorable=False,
+    ),
+    # --- speclang-generated (single spec source, both faces emitted) ---
+    WorkloadEntry(
+        "twopc-gen", f"{_GEN}.twopc_device", "make_spec", "make_workload",
+        host_module=f"{_GEN}.twopc_host",
+        generated=True, source_module=f"{_SRC}.twopc",
+        knobs_attr="spec_knobs",
+    ),
+    WorkloadEntry(
+        "lease-gen", f"{_GEN}.lease_device", "make_spec", "make_workload",
+        host_module=f"{_GEN}.lease_host",
+        generated=True, source_module=f"{_SRC}.lease",
+    ),
+    WorkloadEntry(
+        "backup", f"{_GEN}.backup_device", "make_spec", "make_workload",
+        host_module=f"{_GEN}.backup_host",
+        generated=True, source_module=f"{_SRC}.backup",
+    ),
+)
+
+_BY_NAME: Dict[str, WorkloadEntry] = {e.name: e for e in ENTRIES}
+if len(_BY_NAME) != len(ENTRIES):  # pragma: no cover - authoring error
+    raise RuntimeError("duplicate workload registry names")
+
+
+def get(name: str) -> WorkloadEntry:
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown workload {name!r} (choose from {sorted(_BY_NAME)})"
+        ) from None
+
+
+def names(
+    *,
+    explorable: Optional[bool] = None,
+    tunable: Optional[bool] = None,
+    analysis: Optional[bool] = None,
+    oracle_twin: Optional[bool] = None,
+    generated: Optional[bool] = None,
+) -> Tuple[str, ...]:
+    """Registry names filtered by face flags (None = don't filter);
+    registry order (= the historical hand-list order) is preserved."""
+    out = []
+    for e in ENTRIES:
+        if explorable is not None and e.explorable != explorable:
+            continue
+        if tunable is not None and e.tunable != tunable:
+            continue
+        if analysis is not None and e.analysis != analysis:
+            continue
+        if oracle_twin is not None and e.oracle_twin != oracle_twin:
+            continue
+        if generated is not None and e.generated != generated:
+            continue
+        out.append(e.name)
+    return tuple(out)
+
+
+def _resolve(module: str, attr: str):
+    return getattr(importlib.import_module(module), attr)
+
+
+def spec_factory(name: str) -> Callable:
+    e = get(name)
+    return _resolve(e.module, e.spec_attr)
+
+
+def workload_factory(name: str) -> Callable:
+    e = get(name)
+    return _resolve(e.module, e.workload_attr)
+
+
+def spec_factories(**filters) -> Dict[str, Callable]:
+    """{name -> spec factory} for every (filtered) registry entry — the
+    map the jaxpr verifier keys its shared traces on."""
+    return {n: spec_factory(n) for n in names(**filters)}
+
+
+def host_fuzz(name: str) -> Callable:
+    """The host twin's fuzz_one_seed for one entry (KeyError if the
+    entry ships no host face)."""
+    e = get(name)
+    if e.host_module is None:
+        raise KeyError(f"workload {name!r} has no host twin module")
+    return _resolve(e.host_module, "fuzz_one_seed")
+
+
+def _plan_twin(host_module: str) -> Callable[..., dict]:
+    def run(seed, plan, occ_off, n_nodes, virtual_secs, loss_rate):
+        fuzz = _resolve(host_module, "fuzz_one_seed")
+        return fuzz(
+            seed, n_nodes=n_nodes, virtual_secs=virtual_secs,
+            loss_rate=loss_rate, chaos=False, plan=plan, occ_off=occ_off,
+            lineage=True,
+        )
+
+    return run
+
+
+def oracle_twins() -> Dict[str, Callable[..., dict]]:
+    """{spec-name prefix -> plan-mode twin runner} for oracle.HOST_TWINS:
+    every entry flagged oracle_twin, run with NemesisDriver plan mode and
+    lineage on (the artifact surface the comparator consumes)."""
+    return {
+        e.name: _plan_twin(e.host_module)
+        for e in ENTRIES
+        if e.oracle_twin and e.host_module is not None
+    }
+
+
+def spec_knobs(name: str, virtual_secs: float) -> tuple:
+    """The entry's Tier-B SpecKnob hooks ((), if it declares none) —
+    generated entries derive these from their spec source."""
+    e = get(name)
+    if e.knobs_attr is None:
+        return ()
+    return tuple(_resolve(e.module, e.knobs_attr)(virtual_secs))

@@ -1,0 +1,52 @@
+"""Deliberately-invalid speclang spec source for tests/test_speclang.py.
+
+Every construct in `_body` is one the restriction walk
+(`speclang.lang.validate_protocol`) exists to refuse at AUTHORING time:
+an unbounded `while`, a host callback, a computed prng draw site, and an
+ambient-entropy import. The body is never executed — validation parses
+this module's source, so the undefined names below are irrelevant.
+"""
+
+import random  # noqa: F401  (the ambient-entropy import under test)
+
+from madsim_tpu.speclang.lang import Field, Protocol
+
+
+def _fields(p):
+    return (Field("x"),)
+
+
+def _body(p, State):
+    def on_event(s, nid, src, kind, payload, now, key):
+        while nid > 0:  # unbounded control flow
+            break
+        site = 7
+        draw = prng.uniform(key, site)  # noqa: F821  computed draw site
+        io_callback(print, None)  # noqa: F821  host re-entry
+        return s, None, now + draw
+
+    def first_timer(key, nid):
+        return nid
+
+    def restart_timer(s, nid, now, key):
+        return now
+
+    def check_invariants(ns, alive, now):
+        return True
+
+    return {
+        "on_event": on_event,
+        "first_timer": first_timer,
+        "restart_timer": restart_timer,
+        "check_invariants": check_invariants,
+    }
+
+
+PROTOCOL = Protocol(
+    name="bad-spec",
+    messages=("PING",),
+    payload_width=1,
+    params=dict(n_nodes=3),
+    fields=_fields,
+    body=_body,
+)

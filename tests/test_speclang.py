@@ -1,0 +1,276 @@
+"""Speclang: one spec source compiles to BOTH faces, provably.
+
+The bar these tests pin (docs/speclang.md):
+
+  * re-derivation is EXACT — the twopc and lease spec sources compile to
+    device programs bit-identical to the hand-written `tpu/<x>.py`
+    modules, witnessed by the canonical golden trajectory digests of
+    tests/test_state_layout.py (same chaotic plan, same lanes, same
+    steps — same sha256);
+  * derivation replaces restatement — narrow tables, rate floors, the
+    safe narrow horizon, kind vocabulary and the durable plane all come
+    from declarations, and they agree with what the hand modules state
+    by hand;
+  * the generated modules are pinned to their sources — `emit --check`
+    is clean and every `SPECLANG_DIGEST` matches the current source
+    sha256 (the registry mirror lint enforces the same thing in CI);
+  * the restricted language refuses at authoring time exactly what the
+    verifier tiers exist to catch at trace time;
+  * the one speclang-NATIVE protocol (primary-backup log shipping,
+    specs/backup.py) carries a plantable stale-read bug that the
+    explorer finds and ddmin shrinks to its message-clause axis.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import nemesis, triage
+from madsim_tpu import workloads as registry
+from madsim_tpu.analysis import lint
+from madsim_tpu.speclang import device, emit, lang
+from madsim_tpu.speclang.specs import PROTOCOLS
+from madsim_tpu.speclang.specs import backup as s_backup
+from madsim_tpu.speclang.specs import lease as s_lease
+from madsim_tpu.speclang.specs import twopc as s_twopc
+from madsim_tpu.tpu import nemesis as tpu_nemesis
+from madsim_tpu.tpu.engine import BatchedSim, summarize
+from madsim_tpu.tpu.lease import make_lease_spec
+from madsim_tpu.tpu.spec import SimConfig
+from madsim_tpu.tpu.twopc import make_twopc_spec
+from tests import test_state_layout as tsl
+
+
+def _chaos_run(spec, plan=None, lanes=16, steps=1500):
+    cfg = tpu_nemesis.compile_plan(
+        plan or tsl.CHAOS_PLAN, SimConfig(horizon_us=30_000_000)
+    )
+    sim = BatchedSim(spec, cfg)
+    return sim.run(
+        jnp.arange(lanes, dtype=jnp.uint32),
+        max_steps=steps, dispatch_steps=steps,
+    )
+
+
+# ------------------------------------------------------ bit-identity bar
+
+
+@pytest.mark.chaos
+def test_generated_twopc_matches_golden_digest():
+    """The compiler bar: the twopc re-derivation runs bit-identically to
+    the hand module — its chaotic trajectory hashes to the SAME pinned
+    golden constant (layout-version r8) the hand spec is held to."""
+    st = _chaos_run(device.build(s_twopc.PROTOCOL))
+    assert tsl.canonical_digest(st) == tsl.GOLDEN["twopc"], (
+        "speclang twopc re-derivation diverged from the hand module's "
+        "pinned golden trajectory"
+    )
+    assert summarize(st)["total_events"] > 0
+
+
+# every message clause armed on top of the layout plan: digest equality
+# below then covers nemesis fire counters and dup/reorder draw streams,
+# not just node state
+RICH_PLAN = nemesis.FaultPlan(
+    name="speclang-rich",
+    clauses=tsl.CHAOS_PLAN.clauses + (
+        nemesis.Duplicate(rate=0.1),
+        nemesis.Reorder(rate=0.2, window_us=120_000),
+    ),
+)
+
+
+@pytest.mark.chaos
+def test_generated_lease_matches_hand_digest():
+    """lease authors as two handlers (fused=False) and the compiler
+    routes it through fuse_two_handlers — still bit-identical to the
+    hand spec, under a plan arming every message clause."""
+    hand = _chaos_run(make_lease_spec(), plan=RICH_PLAN)
+    gen = _chaos_run(device.build(s_lease.PROTOCOL), plan=RICH_PLAN)
+    assert tsl.canonical_digest(gen) == tsl.canonical_digest(hand)
+    assert summarize(gen)["total_events"] > 0
+
+
+def _floor_view(floors):
+    out = {}
+    for name, fl in (floors or {}).items():
+        out[name] = (
+            type(fl).__name__,
+            tuple(
+                (a, getattr(fl, a))
+                for a in ("floor_us", "ratchet", "inc", "cap")
+                if hasattr(fl, a)
+            ),
+        )
+    return out
+
+
+@pytest.mark.parametrize(
+    "proto,hand_factory",
+    [(s_twopc.PROTOCOL, make_twopc_spec),
+     (s_lease.PROTOCOL, make_lease_spec)],
+    ids=["twopc", "lease"],
+)
+def test_derived_tables_match_hand_specs(proto, hand_factory):
+    """Every table the hand modules restate by hand is DERIVED from the
+    declarations — and lands on the same values (the `why` prose is the
+    one field allowed to differ)."""
+    gen, hand = device.build(proto), hand_factory()
+    assert gen.n_nodes == hand.n_nodes
+    assert gen.payload_width == hand.payload_width
+    assert (gen.max_out, gen.max_out_msg) == (hand.max_out, hand.max_out_msg)
+    assert gen.narrow_fields == hand.narrow_fields
+    assert gen.narrow_horizon_us == hand.narrow_horizon_us
+    assert tuple(gen.time_fields or ()) == tuple(hand.time_fields or ())
+    assert tuple(gen.msg_kind_names) == tuple(hand.msg_kind_names)
+    assert _floor_view(gen.rate_floors) == _floor_view(hand.rate_floors)
+    assert tuple(gen.durable_fields or ()) == tuple(hand.durable_fields or ())
+    assert gen.sync_field == hand.sync_field
+
+
+# --------------------------------------------------- emit + registry pins
+
+
+def test_emit_check_clean():
+    """The checked-in generated modules are exactly what the current
+    spec sources render to (the `make speclang-smoke` drift gate)."""
+    clean, drifted = emit.emit(check=True)
+    assert not drifted, f"generated modules drifted: {drifted}"
+    assert len(clean) == 2 * len(PROTOCOLS)
+
+
+def test_generated_modules_pin_source_digest():
+    from madsim_tpu.speclang.generated import (
+        backup_device, backup_host, lease_device, lease_host,
+        twopc_device, twopc_host,
+    )
+
+    for mod, src in (
+        (twopc_device, "twopc"), (twopc_host, "twopc"),
+        (lease_device, "lease"), (lease_host, "lease"),
+        (backup_device, "backup"), (backup_host, "backup"),
+    ):
+        assert mod.SPECLANG_DIGEST == emit.source_digest(src)
+
+
+def test_workload_registry_mirror_lint_clean():
+    """The registry mirror lint (analysis.lint.check_workload_registry):
+    every row resolves on every declared face, the consumers import the
+    registry, and the generated rows' digests pin their sources."""
+    res = lint.check_workload_registry()
+    assert res.rule == "mirror"
+    assert res.checked >= 20
+    assert not res.violations, res.violations
+
+
+def test_registry_generated_rows_resolve():
+    assert registry.names(generated=True) == (
+        "twopc-gen", "lease-gen", "backup",
+    )
+    spec = registry.spec_factory("backup")()
+    assert spec.name == "backup5"
+    assert spec.durable_fields  # the spec source's disk plane landed
+    # Tier-B knob hooks derive from the spec source's KnobDecl rows
+    knobs = registry.spec_knobs("twopc-gen", 2.0)
+    assert [k.name for k in knobs] == ["txn_ring"]
+    wl = registry.workload_factory("twopc-gen")(virtual_secs=2.0)
+    wl8 = knobs[0].rebuild(wl, 8)
+    assert wl8.spec.name == wl.spec.name
+    assert wl8.config == wl.config  # knobs rebuild the spec, not the plan
+
+
+# ------------------------------------------------- language restrictions
+
+
+def test_restriction_walk_refuses_bad_bodies():
+    """The restricted language refuses at authoring time what the
+    verifier tiers catch at trace time: unbounded loops, host
+    callbacks, computed draw sites, ambient entropy."""
+    from tests.fixtures import speclang_bad
+
+    with pytest.raises(ValueError) as ei:
+        lang.validate_protocol(speclang_bad.PROTOCOL)
+    msg = str(ei.value)
+    for needle in (
+        "while loop",
+        "host callback",
+        "site must be an int literal",
+        "ambient-entropy import",
+    ):
+        assert needle in msg, f"missing restriction finding: {needle!r}"
+
+
+def test_resolve_refuses_unknown_params():
+    with pytest.raises(ValueError, match="unknown spec params"):
+        device.build(s_backup.PROTOCOL, nonesuch=3)
+
+
+def test_fused_spec_stale_wrapper_guard():
+    """Regression for the fuse_two_handlers footgun: a bare
+    `dataclasses.replace(spec, on_message=...)` on a fused spec used to
+    produce a handler the engine silently never ran; it must now refuse
+    at construction (ProtocolSpec.__post_init__)."""
+    spec = device.build(s_twopc.PROTOCOL)
+
+    def patched(s, nid, src, kind, payload, now, key):
+        return spec.on_message(s, nid, src, kind, payload, now, key)
+
+    with pytest.raises(ValueError, match="does not derive"):
+        dataclasses.replace(spec, on_message=patched)
+
+
+# ------------------------------------- the speclang-native protocol's bug
+
+
+@pytest.mark.chaos
+def test_backup_planted_bug_fires_only_when_planted():
+    """specs/backup.py's stale-read bug (apply guard `!=` instead of
+    `>`): the buggy build violates monotone reads across many lanes
+    under its dup/reorder workload; the correct build stays clean under
+    the identical plan."""
+    wl = device.build_workload(s_backup.PROTOCOL, buggy=True)
+    st = BatchedSim(wl.spec, wl.config).run(
+        jnp.arange(64, dtype=jnp.uint32),
+        max_steps=2000, dispatch_steps=2000,
+    )
+    assert int(np.asarray(st.violated).sum()) >= 5
+
+    wl0 = device.build_workload(s_backup.PROTOCOL)
+    st0 = BatchedSim(wl0.spec, wl0.config).run(
+        jnp.arange(64, dtype=jnp.uint32),
+        max_steps=2000, dispatch_steps=2000,
+    )
+    assert int(np.asarray(st0.violated).sum()) == 0
+    assert int(np.asarray(st0.events).sum()) > 0
+
+
+@pytest.mark.deep
+@pytest.mark.chaos
+def test_backup_bug_explorer_finds_and_ddmin_shrinks(tmp_path):
+    """The full pipeline over the generated workload: the explorer
+    surfaces the planted bug, ddmin shrinks it, and the shrunk plan
+    keeps the message-clause axis the bug actually needs (a stale REPL
+    landing after a newer apply) — crash/restart alone cannot fire it."""
+    from madsim_tpu.explore import Explorer
+
+    wl = device.build_workload(s_backup.PROTOCOL, buggy=True)
+    ex = Explorer(
+        wl, meta_seed=0, lanes=64, shrink_violations=True,
+        max_shrinks=1, shrink_kwargs={"out_dir": str(tmp_path)},
+    )
+    rep = ex.run(1)
+    assert rep.violations, "planted stale-read bug not found in 64 lanes"
+    shrunk = [v for v in rep.violations if v.get("bundle_path")]
+    assert shrunk
+    bundle = triage.ReproBundle.load(shrunk[0]["bundle_path"])
+    assert bundle.violation_step > 0
+    kept = {
+        type(c).__name__
+        for c in triage.plan_from_json(bundle.plan).clauses
+    }
+    assert kept & {"Duplicate", "Reorder"}, (
+        f"shrunk plan {sorted(kept)} lost the message-clause axis the "
+        "stale-read bug requires"
+    )
